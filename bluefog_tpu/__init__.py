@@ -1,0 +1,97 @@
+"""bluefog_tpu: decentralized distributed training, TPU-native.
+
+A from-scratch JAX/XLA implementation of BlueFog's capability surface
+(reference: github Bluefog-Lib/bluefog, mounted at /root/reference):
+decentralized data-parallel optimization over static and dynamic virtual
+graph topologies, one-sided gossip windows, hierarchical averaging, classic
+collectives, optimizer wrappers, a launcher, a timeline profiler.
+
+Usage mirrors ``import bluefog.torch as bf`` (reference: torch/__init__.py:35-62):
+
+    import bluefog_tpu as bf
+    bf.init(bf.topology_util.ExponentialTwoGraph)
+    x = ...  # rank-stacked array [bf.size(), ...], slice r on device r
+    y = bf.neighbor_allreduce(x)
+
+Ranks are devices of a ``jax.sharding.Mesh``; every op runs as one SPMD
+program with ``ppermute``/``psum`` collectives over ICI.
+"""
+
+from . import topology as topology_util
+from .version import __version__
+
+# lifecycle + introspection
+from .runtime.state import (
+    init,
+    shutdown,
+    size,
+    local_size,
+    local_rank,
+    rank,
+    num_machines,
+    machine_size,
+    is_homogeneous,
+    mesh,
+    machine_mesh,
+    set_topology,
+    load_topology,
+    is_topo_weighted,
+    in_neighbor_ranks,
+    out_neighbor_ranks,
+    set_skip_negotiate_stage,
+)
+
+# handles
+from .runtime.handles import poll, synchronize, wait
+
+# timeline
+from .runtime.timeline import (
+    start_timeline,
+    stop_timeline,
+    timeline_start_activity,
+    timeline_end_activity,
+    timeline_context,
+)
+
+# ops
+from .ops import (
+    allgather,
+    allgather_nonblocking,
+    allgather_v,
+    allreduce,
+    allreduce_nonblocking,
+    barrier,
+    broadcast,
+    broadcast_nonblocking,
+    pair_gossip,
+    pair_gossip_nonblocking,
+    hierarchical_neighbor_allreduce,
+    hierarchical_neighbor_allreduce_nonblocking,
+    neighbor_allgather,
+    neighbor_allgather_nonblocking,
+    neighbor_allreduce,
+    neighbor_allreduce_nonblocking,
+    CombinePlan,
+    apply_plan,
+    rank_sharding,
+    shard_rank_stacked,
+    get_win_version,
+    turn_off_win_ops_with_associated_p,
+    turn_on_win_ops_with_associated_p,
+    win_accumulate,
+    win_accumulate_nonblocking,
+    win_associated_p,
+    win_associated_p_all,
+    win_create,
+    win_free,
+    win_get,
+    win_get_nonblocking,
+    win_lock,
+    win_mutex,
+    win_poll,
+    win_put,
+    win_put_nonblocking,
+    win_update,
+    win_update_then_collect,
+    win_wait,
+)

@@ -1,0 +1,85 @@
+"""``bfrun`` — launcher for bluefog_tpu programs.
+
+TPU-native analog of the reference's ``bfrun`` (reference: run/run.py:198-280).
+The reference assembles an ``mpirun`` command line after ssh-probing hosts and
+discovering a common routed NIC (run/horovod_driver.py). None of that exists
+on TPU: pods already share a control plane, and multi-host JAX bootstraps from
+the coordinator address + process count (`jax.distributed.initialize`). So the
+launcher's job collapses to:
+
+  * single host: exec the script (devices = local chips), optionally
+    simulating an N-device CPU mesh for development (--simulate N).
+  * multi host: export the JAX distributed env (coordinator, process id,
+    process count) and exec the script on this host; run the same command on
+    every host (or let the TPU pod runtime fan it out).
+
+Env parity: --timeline-filename exports BLUEFOG_TIMELINE and --verbose sets
+BLUEFOG_LOG_LEVEL=debug, like run.py:143-174.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bfrun",
+        description="Launch a bluefog_tpu training program.",
+    )
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="total number of processes (multi-host); default: "
+                        "single-process using all local devices")
+    p.add_argument("--coordinator", type=str, default=None,
+                   help="coordinator address host:port for jax.distributed "
+                        "(required when -np > 1)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this host's process index (multi-host)")
+    p.add_argument("--simulate", type=int, default=None, metavar="N",
+                   help="simulate an N-device CPU mesh (development)")
+    p.add_argument("--timeline-filename", type=str, default=None,
+                   help="enable the timeline profiler, writing to this prefix")
+    p.add_argument("--verbose", action="store_true",
+                   help="debug logging (BLUEFOG_LOG_LEVEL=debug)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="program and arguments to run")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.command:
+        build_parser().print_usage()
+        return 1
+
+    env = dict(os.environ)
+    if args.timeline_filename:
+        env["BLUEFOG_TIMELINE"] = args.timeline_filename
+    if args.verbose:
+        env["BLUEFOG_LOG_LEVEL"] = "debug"
+    if args.simulate:
+        env["JAX_PLATFORMS"] = ""
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.simulate}"
+        )
+        env["BLUEFOG_SIMULATE_DEVICES"] = str(args.simulate)
+    if args.num_proc and args.num_proc > 1:
+        if not args.coordinator or args.process_id is None:
+            print("bfrun: -np > 1 requires --coordinator and --process-id",
+                  file=sys.stderr)
+            return 1
+        env["JAX_COORDINATOR_ADDRESS"] = args.coordinator
+        env["JAX_NUM_PROCESSES"] = str(args.num_proc)
+        env["JAX_PROCESS_ID"] = str(args.process_id)
+
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    os.execvpe(cmd[0], cmd, env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

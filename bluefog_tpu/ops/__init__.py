@@ -1,0 +1,44 @@
+"""Collective, neighbor, and window ops over the rank mesh."""
+
+from .collectives import (
+    allgather,
+    allgather_nonblocking,
+    allgather_v,
+    allreduce,
+    allreduce_nonblocking,
+    barrier,
+    broadcast,
+    broadcast_nonblocking,
+    pair_gossip,
+    pair_gossip_nonblocking,
+)
+from .neighbors import (
+    hierarchical_neighbor_allreduce,
+    hierarchical_neighbor_allreduce_nonblocking,
+    neighbor_allgather,
+    neighbor_allgather_nonblocking,
+    neighbor_allreduce,
+    neighbor_allreduce_nonblocking,
+)
+from .plan import CombinePlan, apply_plan, rank_sharding, shard_rank_stacked
+from .windows import (
+    get_win_version,
+    turn_off_win_ops_with_associated_p,
+    turn_on_win_ops_with_associated_p,
+    win_accumulate,
+    win_accumulate_nonblocking,
+    win_associated_p,
+    win_associated_p_all,
+    win_create,
+    win_free,
+    win_get,
+    win_get_nonblocking,
+    win_lock,
+    win_mutex,
+    win_poll,
+    win_put,
+    win_put_nonblocking,
+    win_update,
+    win_update_then_collect,
+    win_wait,
+)

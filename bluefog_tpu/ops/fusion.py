@@ -1,0 +1,76 @@
+"""Pytree fusion: pack many small leaves into one flat exchange buffer.
+
+Analog of BlueFog's tensor-fusion buffer (reference: FusionBufferManager,
+tensor_queue.cc:127-155; fused neighbor-allreduce layout comment,
+mpi_controller.cc:604-609). Within one jitted step XLA already fuses
+collectives it can prove adjacent, but optimizer-level parameter averaging
+wants *one* ppermute per step over a single flat buffer instead of one per
+parameter leaf — fewer collective launches, full ICI packet utilization.
+
+``pack`` flattens a pytree of rank-stacked [n, ...] leaves into a single
+[n, total] buffer (casting to the widest needed dtype); ``unpack`` restores
+the original structure. Both are jit-friendly (static shapes from the spec).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class PackSpec(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]   # per-leaf shape without the rank dim
+    dtypes: Tuple[Any, ...]
+    offsets: Tuple[int, ...]
+    sizes: Tuple[int, ...]
+    total: int
+    buffer_dtype: Any
+
+
+def make_spec(tree, rank_stacked: bool = True) -> PackSpec:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = []
+    dtypes = []
+    sizes = []
+    for leaf in leaves:
+        shape = tuple(leaf.shape[1:]) if rank_stacked else tuple(leaf.shape)
+        shapes.append(shape)
+        dtypes.append(leaf.dtype)
+        sizes.append(int(np.prod(shape)) if shape else 1)
+    offsets = []
+    off = 0
+    for s in sizes:
+        offsets.append(off)
+        off += s
+    # One buffer dtype for the whole exchange: promote to the widest float.
+    buffer_dtype = jnp.result_type(*dtypes) if dtypes else jnp.float32
+    return PackSpec(
+        treedef, tuple(shapes), tuple(dtypes), tuple(offsets), tuple(sizes),
+        off, buffer_dtype,
+    )
+
+
+def pack(tree, spec: PackSpec):
+    """[n, ...] leaves -> [n, total] flat buffer (or [total] if unstacked)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flat = [
+        leaf.reshape(leaf.shape[0], -1).astype(spec.buffer_dtype)
+        for leaf in leaves
+    ]
+    return jnp.concatenate(flat, axis=1)
+
+
+def unpack(buffer, spec: PackSpec):
+    """[n, total] -> original pytree of [n, ...] leaves."""
+    n = buffer.shape[0]
+    leaves = []
+    for shape, dtype, off, size in zip(spec.shapes, spec.dtypes, spec.offsets,
+                                       spec.sizes):
+        chunk = jax.lax.dynamic_slice_in_dim(buffer, off, size, axis=1)
+        leaves.append(chunk.reshape((n,) + shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

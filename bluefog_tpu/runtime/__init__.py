@@ -1,0 +1,3 @@
+"""Host runtime: state, config, handles, timeline, watchdog."""
+
+from . import config, handles, logging, state, timeline, watchdog

@@ -1,0 +1,76 @@
+"""Environment-variable configuration surface.
+
+BlueFog configures itself exclusively through ``BLUEFOG_*`` environment
+variables and function arguments (reference: docs/env_variable.rst,
+operations.cc:42-47). We keep the same names where the concept survives the
+move to TPU and document the ones XLA subsumes.
+
+Knobs kept:
+  BLUEFOG_LOG_LEVEL        trace/debug/info/warn/error/fatal (logging.h:56-80)
+  BLUEFOG_LOG_HIDE_TIME    hide timestamps in log lines
+  BLUEFOG_TIMELINE         path prefix -> enable the chrome-tracing timeline
+  BLUEFOG_FUSION_THRESHOLD bytes; leaf-batching threshold for pytree fusion
+                           (analog of the fusion buffer, tensor_queue.cc:127-155)
+  BLUEFOG_CYCLE_TIME       ms; poll cadence of the host watchdog thread (the
+                           background-loop cadence in operations.cc:459-464)
+  BLUEFOG_STALL_WARNING_TIME seconds between stall warnings (operations.cc:46)
+  BLUEFOG_SKIP_NEGOTIATE   '1' skips eager cross-rank validation (the analog
+                           of bf.set_skip_negotiate_stage, basics.py:293-306;
+                           under jit there is never a negotiation stage)
+
+Knobs with no TPU meaning (accepted, ignored, logged once at init):
+  BLUEFOG_*_BY_MPI routing, BLUEFOG_OPS_ON_CPU, BLUEFOG_WIN_ON_GPU,
+  BLUEFOG_MAX_WIN_SENT_LENGTH, BLUEFOG_NUM_FINALIZER_THREADS,
+  BLUEFOG_SLEEP_USEC_FOR_WIN_PASSIVE, BLUEFOG_MPI_THREAD_LEVEL — all are
+  MPI/NCCL/CUDA transport details; XLA owns transport on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+_IGNORED_KNOBS = (
+    "BLUEFOG_ALLREDUCE_BY_MPI",
+    "BLUEFOG_BROADCAST_BY_MPI",
+    "BLUEFOG_ALLGATHER_BY_MPI",
+    "BLUEFOG_NEIGHBOR_ALLREDUCE_BY_MPI",
+    "BLUEFOG_NEIGHBOR_ALLGATHER_BY_MPI",
+    "BLUEFOG_WIN_OPS_BY_MPI",
+    "BLUEFOG_OPS_ON_CPU",
+    "BLUEFOG_WIN_ON_GPU",
+    "BLUEFOG_MAX_WIN_SENT_LENGTH",
+    "BLUEFOG_NUM_FINALIZER_THREADS",
+    "BLUEFOG_SLEEP_USEC_FOR_WIN_PASSIVE",
+    "BLUEFOG_MPI_THREAD_LEVEL",
+)
+
+
+@dataclasses.dataclass
+class Config:
+    log_level: str = "warn"
+    log_hide_time: bool = False
+    timeline_prefix: Optional[str] = None
+    fusion_threshold_bytes: int = 8 * 1024 * 1024
+    cycle_time_ms: float = 0.5
+    stall_warning_sec: float = 60.0
+    skip_negotiate: bool = False
+    ignored_set: tuple = ()
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        env = os.environ
+        cfg = cls(
+            log_level=env.get("BLUEFOG_LOG_LEVEL", "warn").lower(),
+            log_hide_time=env.get("BLUEFOG_LOG_HIDE_TIME", "0") == "1",
+            timeline_prefix=env.get("BLUEFOG_TIMELINE") or None,
+            fusion_threshold_bytes=int(
+                env.get("BLUEFOG_FUSION_THRESHOLD", 8 * 1024 * 1024)
+            ),
+            cycle_time_ms=float(env.get("BLUEFOG_CYCLE_TIME", 0.5)),
+            stall_warning_sec=float(env.get("BLUEFOG_STALL_WARNING_TIME", 60.0)),
+            skip_negotiate=env.get("BLUEFOG_SKIP_NEGOTIATE", "0") == "1",
+            ignored_set=tuple(k for k in _IGNORED_KNOBS if k in env),
+        )
+        return cfg

@@ -1,0 +1,100 @@
+"""Nonblocking-op handles: poll / wait / synchronize.
+
+Analog of BlueFog's ``HandleManager`` + per-op handles with
+``poll/synchronize/wait`` (reference: torch/handle_manager.{h,cc},
+torch/mpi_ops.py:823-869). JAX dispatch is already asynchronous — a collective
+returns immediately with futures backing the output arrays — so a handle here
+wraps the dispatched output pytree; ``synchronize`` blocks until the device
+work is done and the stall watchdog tracks handles that never complete
+(reference: CheckForStalledTensors, operations.cc:387-432).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+_counter = itertools.count(1)
+_lock = threading.Lock()
+_handle_map: Dict[int, Tuple[str, float, Any]] = {}  # handle -> (name, t0, outputs)
+
+# Fire-and-forget callers (win_put in a long gossip loop) never synchronize
+# their handles; bound the table so completed entries don't pin device arrays
+# for the life of the process. Oldest *finished* entries are evicted first.
+_MAX_RETAINED = 4096
+
+
+def _evict_completed_locked() -> None:
+    if len(_handle_map) <= _MAX_RETAINED:
+        return
+    for handle in sorted(_handle_map):
+        _, _, outputs = _handle_map[handle]
+        leaves = jax.tree_util.tree_leaves(outputs)
+        if all(l.is_ready() if hasattr(l, "is_ready") else True for l in leaves):
+            del _handle_map[handle]
+            if len(_handle_map) <= _MAX_RETAINED:
+                return
+
+
+def allocate(name: str, outputs: Any) -> int:
+    """Register dispatched outputs; returns an integer handle."""
+    handle = next(_counter)
+    with _lock:
+        _evict_completed_locked()
+        _handle_map[handle] = (name, time.monotonic(), outputs)
+    return handle
+
+
+def clear() -> None:
+    """Drop all handles (called by shutdown)."""
+    with _lock:
+        _handle_map.clear()
+
+
+def poll(handle: int) -> bool:
+    """True when the op backing ``handle`` has finished executing."""
+    with _lock:
+        entry = _handle_map.get(handle)
+    if entry is None:
+        raise ValueError(f"unknown or already-synchronized handle {handle}")
+    _, _, outputs = entry
+    leaves = jax.tree_util.tree_leaves(outputs)
+    return all(
+        leaf.is_ready() if hasattr(leaf, "is_ready") else True for leaf in leaves
+    )
+
+
+def synchronize(handle: int) -> Any:
+    """Block until the op completes and return its output pytree."""
+    with _lock:
+        entry = _handle_map.pop(handle, None)
+    if entry is None:
+        raise ValueError(f"unknown or already-synchronized handle {handle}")
+    _, _, outputs = entry
+    return jax.block_until_ready(outputs)
+
+
+def wait(handle: int) -> Any:
+    """Alias of synchronize (reference: mpi_ops.py:857-869)."""
+    return synchronize(handle)
+
+
+def outstanding() -> Dict[int, Tuple[str, float]]:
+    """Snapshot of unfinished handles: handle -> (op name, age seconds)."""
+    now = time.monotonic()
+    out = {}
+    with _lock:
+        items = list(_handle_map.items())
+    for handle, (name, t0, outputs) in items:
+        leaves = jax.tree_util.tree_leaves(outputs)
+        done = all(
+            leaf.is_ready() if hasattr(leaf, "is_ready") else True
+            for leaf in leaves
+        )
+        if not done:
+            out[handle] = (name, now - t0)
+    return out

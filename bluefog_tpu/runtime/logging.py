@@ -1,0 +1,43 @@
+"""Logging for the bluefog_tpu runtime.
+
+Analog of BlueFog's BFLOG macros (reference: common/logging.{h,cc}); level is
+controlled by BLUEFOG_LOG_LEVEL (trace..fatal) and BLUEFOG_LOG_HIDE_TIME.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG - 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(_LEVELS["trace"], "TRACE")
+
+logger = logging.getLogger("bluefog_tpu")
+
+
+def _configure() -> None:
+    if logger.handlers:
+        return
+    level = _LEVELS.get(os.environ.get("BLUEFOG_LOG_LEVEL", "warn").lower(),
+                        logging.WARNING)
+    hide_time = os.environ.get("BLUEFOG_LOG_HIDE_TIME", "0") == "1"
+    fmt = "[%(levelname)s] %(message)s" if hide_time else \
+        "%(asctime)s [%(levelname)s] %(message)s"
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger.propagate = False
+
+
+_configure()

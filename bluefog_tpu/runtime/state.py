@@ -1,0 +1,316 @@
+"""Global runtime state: device mesh, topology, windows.
+
+This is the TPU-native analog of BlueFog's ``BluefogGlobalState`` +
+``bluefog_init``/``bluefog_set_topology`` C API (reference: common/global_state.h:44-100,
+operations.cc:1165-1304, basics.py:47-65). The big design departure: there is
+no background communication thread and no rank-0 negotiation. Ranks are
+*devices in a jax Mesh* driven by one SPMD program, so op ordering is static
+at compile time — which is exactly the fast path BlueFog exposes as
+``skip_negotiate_stage`` (operations.cc:1113-1135). Validation that the
+negotiation stage performed (shape/dtype/name consistency across ranks) is
+done eagerly in Python in the ops layer instead.
+
+Topology changes are a host-side re-plan followed by fresh jit traces — the
+analog of the reference's 3-flag epoch handshake pausing the background loop
+(operations.cc:1273-1283) is simply cache invalidation here.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, List, Optional
+
+import networkx as nx
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .. import topology as topology_util
+from . import handles
+from .config import Config
+from .logging import logger
+
+
+class BluefogTPUState:
+    """Singleton process state. One per Python process (controller)."""
+
+    def __init__(self) -> None:
+        self.initialized = False
+        self.config: Config = Config()
+        self.devices: List[Any] = []
+        self.size: int = 0
+        self.local_size: int = 1
+        self.mesh: Optional[Mesh] = None
+        self.machine_mesh: Optional[Mesh] = None
+        self.topology: Optional[nx.DiGraph] = None
+        self.is_topo_weighted: bool = False
+        # Window registry: name -> bluefog_tpu.ops.windows.Window
+        self.windows: Dict[str, Any] = {}
+        self.win_mutex_lock = threading.RLock()
+        # Global toggle: win ops also move the associated push-sum scalar p
+        # (reference: mpi_ops.py:1339-1363).
+        self.win_ops_with_associated_p = False
+        self.skip_negotiate: bool = False
+        self.timeline = None  # runtime.timeline.Timeline when enabled
+        self.watchdog = None  # runtime.watchdog.StallWatchdog when enabled
+        self._plan_cache: Dict[Any, Any] = {}  # compiled combine plans
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def check_initialized(self) -> None:
+        if not self.initialized:
+            raise RuntimeError(
+                "bluefog_tpu is not initialized; call bluefog_tpu.init() first."
+            )
+
+
+_state = BluefogTPUState()
+
+
+def _global_state() -> BluefogTPUState:
+    return _state
+
+
+def init(
+    topology_fn=None,
+    is_weighted: bool = False,
+    devices: Optional[List[Any]] = None,
+    local_size: Optional[int] = None,
+) -> None:
+    """Initialize the runtime over the available TPU devices.
+
+    Analog of ``bf.init(topology_fn, is_weighted)`` (reference: basics.py:47-65).
+    Rather than MPI_Init across processes, this builds a 1-D rank mesh (and a
+    2-D machine × local mesh for hierarchical ops) over ``jax.devices()``.
+
+    Args:
+      topology_fn: size -> nx.DiGraph; defaults to ExponentialTwoGraph, the
+        reference default (basics.py:59-65).
+      is_weighted: use the graph's edge weights for averaging instead of
+        uniform 1/(indegree+1).
+      devices: explicit device list (default jax.devices()).
+      local_size: devices per "machine" for hierarchical ops; defaults to
+        jax.local_device_count() (all devices of this host).
+    """
+    st = _state
+    if st.initialized:
+        shutdown()
+
+    st.config = Config.from_env()
+    for knob in st.config.ignored_set:
+        logger.info("env %s has no effect on TPU (transport is XLA-managed)", knob)
+
+    st.devices = list(devices if devices is not None else jax.devices())
+    st.size = len(st.devices)
+    if local_size:
+        st.local_size = int(local_size)
+    else:
+        mine = [
+            d for d in st.devices
+            if getattr(d, "process_index", 0) == jax.process_index()
+        ]
+        st.local_size = max(1, len(mine))
+    if st.size % st.local_size != 0:
+        # Heterogeneous layout: hierarchical ops will refuse to run
+        # (reference requires homogeneity too, mpi_ops.py:693-741).
+        logger.warning(
+            "size %d not divisible by local_size %d; hierarchical ops disabled",
+            st.size, st.local_size,
+        )
+        st.machine_mesh = None
+    st.mesh = Mesh(np.array(st.devices), ("rank",))
+    if st.size % st.local_size == 0 and st.size >= st.local_size:
+        st.machine_mesh = Mesh(
+            np.array(st.devices).reshape(st.size // st.local_size, st.local_size),
+            ("machine", "local"),
+        )
+    st.skip_negotiate = st.config.skip_negotiate
+    st.windows = {}
+    st.win_ops_with_associated_p = False
+    st._plan_cache = {}
+    st.initialized = True
+
+    if topology_fn is not None:
+        topo = topology_fn(st.size)
+    else:
+        topo = topology_util.ExponentialTwoGraph(st.size)
+        is_weighted = False
+    if not set_topology(topo, is_weighted=is_weighted):
+        raise RuntimeError("failed to set initial topology")
+
+    if st.config.timeline_prefix:
+        from .timeline import Timeline
+
+        st.timeline = Timeline(st.config.timeline_prefix)
+
+    from .watchdog import StallWatchdog
+
+    st.watchdog = StallWatchdog(
+        warning_sec=st.config.stall_warning_sec,
+        cycle_ms=st.config.cycle_time_ms,
+    )
+    st.watchdog.start()
+
+    logger.info(
+        "bluefog_tpu initialized: %d rank(s) on %s, local_size=%d",
+        st.size, st.devices[0].platform, st.local_size,
+    )
+
+
+def shutdown() -> None:
+    """Tear down runtime state; analog of ``bf.shutdown`` (operations.cc:1205-1215).
+
+    Outstanding window state is dropped; the stall watchdog and timeline
+    writer threads are joined (the reference's coordinated-shutdown broadcast
+    has no analog because there is no peer process to notify).
+    """
+    st = _state
+    if not st.initialized:
+        return
+    if st.watchdog is not None:
+        st.watchdog.stop()
+        st.watchdog = None
+    if st.timeline is not None:
+        st.timeline.close()
+        st.timeline = None
+    st.windows.clear()
+    st._plan_cache.clear()
+    handles.clear()
+    st.mesh = None
+    st.machine_mesh = None
+    st.topology = None
+    st.initialized = False
+
+
+atexit.register(shutdown)
+
+
+# -- introspection (parity: basics.py:120-186) -----------------------------
+
+def size() -> int:
+    _state.check_initialized()
+    return _state.size
+
+
+def local_size() -> int:
+    _state.check_initialized()
+    return _state.local_size
+
+
+def num_machines() -> int:
+    _state.check_initialized()
+    return _state.size // _state.local_size
+
+
+def machine_size() -> int:
+    return num_machines()
+
+
+def rank() -> int:
+    """Index of this controller process.
+
+    In the reference each process is one rank; on TPU one controller drives
+    many devices, so per-device rank only exists inside SPMD code (as the
+    rank-axis index). This returns the process index for launcher parity.
+    """
+    _state.check_initialized()
+    return jax.process_index()
+
+
+def local_rank() -> int:
+    _state.check_initialized()
+    return 0
+
+
+def is_homogeneous() -> bool:
+    """All machines have the same device count (reference: mpi_controller.cc:71-96)."""
+    _state.check_initialized()
+    return _state.size % _state.local_size == 0
+
+
+def mesh() -> Mesh:
+    _state.check_initialized()
+    return _state.mesh
+
+
+def machine_mesh() -> Mesh:
+    _state.check_initialized()
+    if _state.machine_mesh is None:
+        raise RuntimeError("hierarchical mesh unavailable (heterogeneous layout)")
+    return _state.machine_mesh
+
+
+# -- topology management (parity: basics.py:188-291) -----------------------
+
+def set_topology(topology: Optional[nx.DiGraph] = None, is_weighted: bool = False) -> bool:
+    """Install a new virtual topology; returns False if rejected.
+
+    Mirrors ``bf.set_topology`` semantics (basics.py:188-271): rejected with a
+    warning when windows exist (torch_basics_test.py:63-78 relies on this) or
+    when the node count mismatches; equivalent topology is a cheap no-op.
+    """
+    st = _state
+    st.check_initialized()
+    if topology is None:
+        topology = topology_util.ExponentialTwoGraph(st.size)
+        is_weighted = False
+    if not isinstance(topology, nx.DiGraph):
+        logger.error("set_topology requires a networkx.DiGraph")
+        return False
+    if topology.number_of_nodes() != st.size:
+        logger.error(
+            "topology has %d nodes but runtime has %d ranks",
+            topology.number_of_nodes(), st.size,
+        )
+        return False
+    if st.windows:
+        logger.error(
+            "cannot change topology while windows exist; call win_free first"
+        )
+        return False
+    if (
+        st.topology is not None
+        and topology_util.IsTopologyEquivalent(topology, st.topology)
+        and is_weighted == st.is_topo_weighted
+    ):
+        logger.debug("topology unchanged; skipping re-plan")
+        return True
+    st.topology = topology
+    st.is_topo_weighted = is_weighted
+    st._plan_cache.clear()  # new graph -> new combine plans / jit traces
+    return True
+
+
+def load_topology() -> nx.DiGraph:
+    _state.check_initialized()
+    return _state.topology
+
+
+def is_topo_weighted() -> bool:
+    _state.check_initialized()
+    return _state.is_topo_weighted
+
+
+def in_neighbor_ranks(rank_: Optional[int] = None) -> List[int]:
+    """Sorted in-neighbors of ``rank_`` (default: rank 0 for parity calls)."""
+    _state.check_initialized()
+    r = 0 if rank_ is None else rank_
+    return topology_util.in_neighbor_ranks(_state.topology, r)
+
+
+def out_neighbor_ranks(rank_: Optional[int] = None) -> List[int]:
+    _state.check_initialized()
+    r = 0 if rank_ is None else rank_
+    return topology_util.out_neighbor_ranks(_state.topology, r)
+
+
+def set_skip_negotiate_stage(value: bool) -> None:
+    """Disable eager cross-rank validation in the ops layer.
+
+    Under jit there is never a negotiation stage (op order is compiled); this
+    only controls the eager debug checks (reference: basics.py:293-306).
+    """
+    _state.check_initialized()
+    _state.skip_negotiate = bool(value)

@@ -1,0 +1,175 @@
+"""Chrome-tracing timeline profiler.
+
+Analog of BlueFog's Timeline subsystem (reference: common/timeline.{h,cc}):
+named activities streamed through a lock-free queue to a dedicated writer
+thread producing catapult/chrome-tracing JSON (load in chrome://tracing or
+Perfetto). Enabled by ``BLUEFOG_TIMELINE=<prefix>`` -> one file
+``<prefix><process>.json`` (operations.cc:449-458), or programmatically.
+
+Device-side timing on TPU comes from ``jax.profiler`` xplane traces;
+:func:`trace_context` bridges the two by emitting a named activity and a
+jax.profiler TraceAnnotation for the same span.
+
+When the native host runtime extension is built (csrc/), the writer is backed
+by the C++ spsc-queue implementation; this pure-Python writer (daemon thread +
+queue.SimpleQueue) is the fallback and the semantics are identical.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+import jax
+
+from .logging import logger
+
+
+class Timeline:
+    """Streaming chrome-tracing writer with named activities per (tensor, lane)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, prefix: str, process_index: Optional[int] = None) -> None:
+        pid = jax.process_index() if process_index is None else process_index
+        self.path = f"{prefix}{pid}.json"
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._t0 = time.perf_counter_ns()
+        self._pid = pid
+        self._closed = False
+        self._failed = False  # writer died: stop producing so the queue can't grow
+        self._writer = threading.Thread(
+            target=self._writer_loop, name="bf-timeline-writer", daemon=True
+        )
+        self._writer.start()
+
+    # -- producer side (any thread) ---------------------------------------
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._t0) / 1e3
+
+    def activity_start(self, tensor_name: str, activity: str, tid: int = 0) -> None:
+        if self._failed or self._closed:
+            return
+        self._q.put(
+            {"name": activity, "cat": tensor_name, "ph": "B",
+             "ts": self._now_us(), "pid": self._pid, "tid": tid}
+        )
+
+    def activity_end(self, tensor_name: str, tid: int = 0) -> None:
+        if self._failed or self._closed:
+            return
+        self._q.put(
+            {"ph": "E", "ts": self._now_us(), "pid": self._pid, "tid": tid,
+             "cat": tensor_name}
+        )
+
+    def instant(self, tensor_name: str, activity: str, tid: int = 0) -> None:
+        if self._failed or self._closed:
+            return
+        self._q.put(
+            {"name": activity, "cat": tensor_name, "ph": "i", "s": "t",
+             "ts": self._now_us(), "pid": self._pid, "tid": tid}
+        )
+
+    @contextlib.contextmanager
+    def activity(self, tensor_name: str, activity: str, tid: int = 0):
+        self.activity_start(tensor_name, activity, tid)
+        try:
+            yield
+        finally:
+            self.activity_end(tensor_name, tid)
+
+    # -- writer side -------------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        try:
+            with open(self.path, "w") as f:
+                f.write("[\n")
+                first = True
+                while True:
+                    ev = self._q.get()
+                    if ev is Timeline._SENTINEL:
+                        break
+                    if not first:
+                        f.write(",\n")
+                    f.write(json.dumps(ev))
+                    first = False
+                    f.flush()
+                f.write("\n]\n")
+        except OSError as exc:  # disk full / bad prefix: drop, don't crash train
+            self._failed = True
+            logger.error("timeline writer failed, disabling timeline: %s", exc)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(Timeline._SENTINEL)
+        self._writer.join(timeout=5.0)
+
+
+# -- module-level API mirroring bf.timeline_* (basics.py:308-388) -----------
+
+def _timeline() -> Optional[Timeline]:
+    from .state import _global_state
+
+    return _global_state().timeline
+
+
+def timeline_start_activity(tensor_name: str, activity: str, tid: int = 0) -> bool:
+    tl = _timeline()
+    if tl is None:
+        return False
+    tl.activity_start(tensor_name, activity, tid)
+    return True
+
+
+def timeline_end_activity(tensor_name: str, tid: int = 0) -> bool:
+    tl = _timeline()
+    if tl is None:
+        return False
+    tl.activity_end(tensor_name, tid)
+    return True
+
+
+@contextlib.contextmanager
+def timeline_context(tensor_name: str, activity: str, tid: int = 0):
+    """Named span in the host timeline AND the jax.profiler device trace."""
+    tl = _timeline()
+    with jax.profiler.TraceAnnotation(f"{tensor_name}.{activity}"):
+        if tl is not None:
+            tl.activity_start(tensor_name, activity, tid)
+        try:
+            yield
+        finally:
+            if tl is not None:
+                tl.activity_end(tensor_name, tid)
+
+
+def start_timeline(prefix: str) -> bool:
+    """Enable the timeline at runtime (reference: basics.py timeline start)."""
+    from .state import _global_state
+
+    st = _global_state()
+    if st.timeline is not None:
+        logger.warning("timeline already running; ignoring start_timeline")
+        return False
+    st.timeline = Timeline(prefix)
+    return True
+
+
+def stop_timeline() -> bool:
+    from .state import _global_state
+
+    st = _global_state()
+    if st.timeline is None:
+        return False
+    st.timeline.close()
+    st.timeline = None
+    return True

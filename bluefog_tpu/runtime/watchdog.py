@@ -1,0 +1,60 @@
+"""Stall watchdog: warns about nonblocking ops that never complete.
+
+Analog of BlueFog's coordinator stall check (reference: CheckForStalledTensors,
+operations.cc:387-432, cadence STALL_WARNING_TIME=60s, operations.cc:46-47).
+There is no negotiation table to inspect on TPU; instead the watchdog thread
+polls the handle registry for dispatched-but-unfinished ops. A handle stuck
+longer than the threshold usually means a multi-host collective where some
+host never joined — the TPU equivalent of a missing rank.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import handles
+from .logging import logger
+
+
+class StallWatchdog:
+    def __init__(self, warning_sec: float = 60.0, cycle_ms: float = 0.5) -> None:
+        self.warning_sec = warning_sec
+        # Poll at >= 1s: this thread is observability, not a dispatch loop, so
+        # the reference's 0.5 ms cycle would be pure waste here.
+        self.cycle_sec = max(cycle_ms / 1000.0, 1.0)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._warned: set[int] = set()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="bf-stall-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cycle_sec):
+            try:
+                pending = handles.outstanding()
+            except Exception:  # never let observability kill the process
+                continue
+            stalled = {
+                h: (name, age)
+                for h, (name, age) in pending.items()
+                if age > self.warning_sec and h not in self._warned
+            }
+            for h, (name, age) in stalled.items():
+                self._warned.add(h)
+                logger.warning(
+                    "op '%s' (handle %d) has not completed for %.0f s; "
+                    "likely a hung multi-host collective (some host absent)",
+                    name, h, age,
+                )

@@ -1,0 +1,36 @@
+"""Test harness: 8 virtual CPU devices standing in for an 8-chip mesh.
+
+The reference runs every test as a real multiprocess job under mpirun
+(Makefile:9, test strategy in SURVEY.md §4). The TPU-native analog is an
+8-device CPU-simulated mesh via --xla_force_host_platform_device_count:
+the same SPMD programs, shardings, and collectives that run on a pod,
+executed by the CPU backend. Must configure the env BEFORE jax is imported.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+# The image's sitecustomize force-registers the axon TPU plugin; an empty
+# JAX_PLATFORMS lets both backends register so jax.devices('cpu') works.
+os.environ["JAX_PLATFORMS"] = ""
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+import bluefog_tpu as bf  # noqa: E402
+
+
+def cpu_devices(n=8):
+    devs = jax.devices("cpu")
+    assert len(devs) >= n, f"need {n} cpu devices, got {len(devs)}"
+    return devs[:n]
+
+
+@pytest.fixture()
+def bf8():
+    """bluefog_tpu initialized over 8 virtual devices, default Expo-2 topo."""
+    bf.init(devices=cpu_devices(8), local_size=4)
+    yield bf
+    bf.shutdown()

@@ -1,0 +1,58 @@
+"""Runtime lifecycle + topology management (model: test/torch_basics_test.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+
+
+class TestLifecycle:
+    def test_init_size(self, bf8):
+        assert bf8.size() == 8
+        assert bf8.local_size() == 4
+        assert bf8.num_machines() == 2
+        assert bf8.is_homogeneous()
+
+    def test_requires_init(self):
+        bf.shutdown()
+        with pytest.raises(RuntimeError, match="not initialized"):
+            bf.size()
+
+    def test_default_topology_is_expo2(self, bf8):
+        assert topology_util.IsTopologyEquivalent(
+            bf8.load_topology(), topology_util.ExponentialTwoGraph(8)
+        )
+        assert not bf8.is_topo_weighted()
+
+    def test_set_topology_and_load(self, bf8):
+        # parity: torch_basics_test.py set/load equivalence checks
+        assert bf8.set_topology(topology_util.RingGraph(8))
+        assert topology_util.IsTopologyEquivalent(
+            bf8.load_topology(), topology_util.RingGraph(8)
+        )
+
+    def test_set_topology_wrong_size_rejected(self, bf8):
+        assert not bf8.set_topology(topology_util.RingGraph(4))
+
+    def test_set_topology_blocked_by_windows(self, bf8):
+        # parity: torch_basics_test.py:63-78 — topology change must fail
+        # while a window exists, succeed after win_free.
+        x = jnp.ones((8, 4))
+        assert bf8.win_create(x, "blocker")
+        assert not bf8.set_topology(topology_util.RingGraph(8))
+        assert bf8.win_free("blocker")
+        assert bf8.set_topology(topology_util.RingGraph(8))
+
+    def test_neighbor_queries(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))  # bidirectional
+        assert bf8.in_neighbor_ranks(0) == [1, 7]
+        assert bf8.out_neighbor_ranks(3) == [2, 4]
+
+    def test_reinit(self, bf8):
+        import jax
+
+        bf.init(devices=jax.devices("cpu")[:4], local_size=2)
+        assert bf.size() == 4
+        bf.shutdown()

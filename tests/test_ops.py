@@ -1,0 +1,281 @@
+"""Collective + neighbor op correctness (model: test/torch_ops_test.py).
+
+Same testing philosophy as the reference: exact-value assertions where each
+rank's tensor is a rank-determined constant and expected outputs are computed
+against the known graph.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+
+
+def rank_tensor(n=8, shape=(4,), dtype=jnp.float32):
+    """x[r] = r (rank-determined constant, reference test style)."""
+    base = jnp.arange(n, dtype=dtype).reshape((n,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (n,) + shape)
+
+
+class TestAllreduce:
+    def test_average(self, bf8):
+        x = rank_tensor()
+        out = bf8.allreduce(x, average=True)
+        np.testing.assert_allclose(np.asarray(out), 3.5, atol=1e-6)
+        assert out.shape == x.shape
+
+    def test_sum(self, bf8):
+        out = bf8.allreduce(rank_tensor(), average=False)
+        np.testing.assert_allclose(np.asarray(out), 28.0, atol=1e-6)
+
+    def test_hierarchical_local(self, bf8):
+        # local_size=4: machine 0 = ranks 0-3 (mean 1.5), machine 1 = 4-7 (5.5)
+        out = bf8.allreduce(rank_tensor(), average=True, is_hierarchical_local=True)
+        expected = np.repeat([1.5, 5.5], 4)[:, None] * np.ones((8, 4))
+        np.testing.assert_allclose(np.asarray(out), expected, atol=1e-6)
+
+    def test_pytree(self, bf8):
+        tree = {"a": rank_tensor(), "b": rank_tensor(shape=(2, 3))}
+        out = bf8.allreduce(tree)
+        np.testing.assert_allclose(np.asarray(out["a"]), 3.5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["b"]), 3.5, atol=1e-6)
+
+    def test_nonblocking_poll_synchronize(self, bf8):
+        handle = bf8.allreduce_nonblocking(rank_tensor())
+        out = bf8.synchronize(handle)
+        np.testing.assert_allclose(np.asarray(out), 3.5, atol=1e-6)
+        with pytest.raises(ValueError):
+            bf8.synchronize(handle)  # double-synchronize rejected
+
+    def test_bf16_accumulation(self, bf8):
+        x = rank_tensor(dtype=jnp.bfloat16)
+        out = bf8.allreduce(x)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, dtype=np.float32), 3.5)
+
+
+class TestBroadcast:
+    def test_broadcast_root(self, bf8):
+        out = bf8.broadcast(rank_tensor(), root_rank=3)
+        np.testing.assert_allclose(np.asarray(out), 3.0, atol=1e-6)
+
+    def test_bad_root(self, bf8):
+        with pytest.raises(ValueError):
+            bf8.broadcast(rank_tensor(), root_rank=9)
+
+
+class TestAllgather:
+    def test_allgather(self, bf8):
+        x = rank_tensor(shape=(2,))  # [8, 2]
+        out = bf8.allgather(x)
+        assert out.shape == (8, 16)
+        expected = np.repeat(np.arange(8.0), 2)
+        for r in range(8):
+            np.testing.assert_allclose(np.asarray(out[r]), expected)
+
+    def test_allgather_v_ragged(self, bf8):
+        parts = [jnp.full((r + 1, 2), float(r)) for r in range(8)]
+        out = bf8.allgather_v(parts)
+        assert out.shape == (36, 2)
+        np.testing.assert_allclose(np.asarray(out[:1]), 0.0)
+        np.testing.assert_allclose(np.asarray(out[-8:]), 7.0)
+
+
+class TestNeighborAllreduce:
+    def test_uniform_expo2(self, bf8):
+        # expo2(8): rank r averages {r, r-1, r-2, r-4} with weight 1/4
+        x = rank_tensor()
+        out = bf8.neighbor_allreduce(x)
+        for r in range(8):
+            exp = (r + (r - 1) % 8 + (r - 2) % 8 + (r - 4) % 8) / 4.0
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_ring_uniform(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        out = bf8.neighbor_allreduce(rank_tensor())
+        for r in range(8):
+            exp = (r + (r - 1) % 8 + (r + 1) % 8) / 3.0
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_weighted_topology(self, bf8):
+        bf8.set_topology(topology_util.MeshGrid2DGraph(8), is_weighted=True)
+        W = topology_util.weight_matrix(bf8.load_topology())
+        x = rank_tensor()
+        out = bf8.neighbor_allreduce(x)
+        expected = W.T @ np.arange(8.0)
+        for r in range(8):
+            np.testing.assert_allclose(np.asarray(out[r]), expected[r], atol=1e-5)
+
+    def test_explicit_weights(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        out = bf8.neighbor_allreduce(
+            rank_tensor(),
+            self_weight=0.5,
+            neighbor_weights={r: {(r - 1) % 8: 0.25, (r + 1) % 8: 0.25}
+                              for r in range(8)},
+        )
+        for r in range(8):
+            exp = 0.5 * r + 0.25 * ((r - 1) % 8) + 0.25 * ((r + 1) % 8)
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_invalid_weight_keys_rejected(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        with pytest.raises(ValueError, match="non-in-neighbor"):
+            bf8.neighbor_allreduce(
+                rank_tensor(), self_weight=0.5,
+                neighbor_weights={r: {(r + 3) % 8: 0.5} for r in range(8)},
+            )
+
+    def test_dense_graph_gather_path(self, bf8):
+        bf8.set_topology(topology_util.FullyConnectedGraph(8), is_weighted=True)
+        out = bf8.neighbor_allreduce(rank_tensor())
+        np.testing.assert_allclose(np.asarray(out), 3.5, atol=1e-5)
+
+    def test_star_graph(self, bf8):
+        bf8.set_topology(topology_util.StarGraph(8), is_weighted=True)
+        x = rank_tensor()
+        W = topology_util.weight_matrix(bf8.load_topology())
+        out = bf8.neighbor_allreduce(x)
+        expected = W.T @ np.arange(8.0)
+        np.testing.assert_allclose(
+            np.asarray(out)[:, 0], expected, atol=1e-5
+        )
+
+    def test_pytree(self, bf8):
+        tree = {"w": rank_tensor(), "b": rank_tensor(shape=(3, 2))}
+        out = bf8.neighbor_allreduce(tree)
+        exp0 = (0 + 7 + 6 + 4) / 4.0
+        np.testing.assert_allclose(np.asarray(out["w"][0]), exp0, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["b"][0]), exp0, atol=1e-5)
+
+    def test_average_consensus_converges(self, bf8):
+        # the reference's pytorch_average_consensus.py as a test: repeated
+        # neighbor averaging over expo2 drives everyone to the global mean
+        x = rank_tensor()
+        target = 3.5
+        for _ in range(30):
+            x = bf8.neighbor_allreduce(x)
+        np.testing.assert_allclose(np.asarray(x), target, atol=1e-4)
+
+
+class TestDynamicNeighborAllreduce:
+    def test_one_peer_ring_step(self, bf8):
+        # every rank sends to r+1; recv weight 0.5 / self 0.5
+        sends = {r: [(r + 1) % 8] for r in range(8)}
+        out = bf8.neighbor_allreduce(
+            rank_tensor(),
+            self_weight=0.5,
+            neighbor_weights={r: {(r - 1) % 8: 0.5} for r in range(8)},
+            send_neighbors=sends,
+        )
+        for r in range(8):
+            exp = 0.5 * r + 0.5 * ((r - 1) % 8)
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_topo_check_mismatch(self, bf8):
+        # parity: torch_ops_test.py:429 — mismatched send/recv detected
+        sends = {r: [(r + 1) % 8] for r in range(8)}
+        with pytest.raises(RuntimeError, match="dynamic topology mismatch"):
+            bf8.neighbor_allreduce(
+                rank_tensor(),
+                self_weight=0.5,
+                neighbor_weights={r: {(r - 2) % 8: 0.5} for r in range(8)},
+                send_neighbors=sends,
+            )
+
+    def test_topo_check_disabled_runs(self, bf8):
+        sends = {r: [(r + 1) % 8] for r in range(8)}
+        out = bf8.neighbor_allreduce(
+            rank_tensor(),
+            self_weight=1.0,
+            neighbor_weights={r: {} for r in range(8)},
+            send_neighbors=sends,
+            enable_topo_check=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[:, 0], np.arange(8.0), atol=1e-6
+        )
+
+    def test_dynamic_iterator_full_cycle(self, bf8):
+        # drive the flagship dynamic schedule for several steps and check
+        # the average is preserved every step (column-stochastic W)
+        topo = topology_util.ExponentialTwoGraph(8)
+        gens = [topology_util.GetDynamicSendRecvRanks(topo, r) for r in range(8)]
+        x = rank_tensor()
+        for _ in range(6):
+            steps = [next(g) for g in gens]
+            sends = {r: steps[r][0] for r in range(8)}
+            recv = {r: steps[r][1] for r in range(8)}
+            nw = {r: {src: 0.5 for src in recv[r]} for r in range(8)}
+            sw = {r: 1.0 - 0.5 * len(recv[r]) for r in range(8)}
+            x = bf8.neighbor_allreduce(
+                x, self_weight=sw, neighbor_weights=nw, send_neighbors=sends,
+                enable_topo_check=False,
+            )
+        # mean preserved requires column-stochasticity; here each rank sends
+        # half its mass to one peer: columns sum to 1 by construction
+        np.testing.assert_allclose(np.asarray(x).mean(), 3.5, atol=1e-4)
+
+
+class TestHierarchicalNeighborAllreduce:
+    def test_two_machine_default(self, bf8):
+        # machines: [0-3] avg 1.5, [4-7] avg 5.5; expo2(2) = each machine
+        # averages with the other -> everyone (1.5 + 5.5)/2 = 3.5
+        out = bf8.hierarchical_neighbor_allreduce(rank_tensor())
+        np.testing.assert_allclose(np.asarray(out), 3.5, atol=1e-5)
+
+    def test_machine_weights(self, bf8):
+        out = bf8.hierarchical_neighbor_allreduce(
+            rank_tensor(),
+            self_weight=0.75,
+            neighbor_machine_weights={0: {1: 0.25}, 1: {0: 0.25}},
+            send_neighbor_machines={0: [1], 1: [0]},
+        )
+        expected = np.repeat([0.75 * 1.5 + 0.25 * 5.5,
+                              0.75 * 5.5 + 0.25 * 1.5], 4)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], expected, atol=1e-5)
+
+
+class TestNeighborAllgather:
+    def test_regular_graph(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor(shape=(2,))
+        out = bf8.neighbor_allgather(x)
+        assert out.shape == (8, 4)  # 2 neighbors * b=2
+        # rank 0's in-neighbors sorted: [1, 7]
+        np.testing.assert_allclose(np.asarray(out[0]), [1, 1, 7, 7])
+
+    def test_irregular_graph_returns_list(self, bf8):
+        bf8.set_topology(topology_util.StarGraph(8))
+        out = bf8.neighbor_allgather(rank_tensor(shape=(2,)))
+        assert isinstance(out, list)
+        assert out[0].shape == (14, )  # center: 7 neighbors * 2
+        assert out[3].shape == (2,)
+        np.testing.assert_allclose(np.asarray(out[3]), 0.0)
+
+
+class TestPairGossip:
+    def test_even_odd_pairs(self, bf8):
+        peers = {r: r ^ 1 for r in range(8)}
+        out = bf8.pair_gossip(rank_tensor(), peers)
+        expected = np.repeat(np.arange(0.5, 8, 2), 2)
+        np.testing.assert_allclose(np.asarray(out)[:, 0], expected, atol=1e-6)
+
+    def test_asymmetric_pairs_rejected(self, bf8):
+        peers = {r: (r + 1) % 8 for r in range(8)}
+        with pytest.raises(ValueError, match="mutual"):
+            bf8.pair_gossip(rank_tensor(), peers)
+
+    def test_weights(self, bf8):
+        peers = {r: r ^ 1 for r in range(8)}
+        out = bf8.pair_gossip(rank_tensor(), peers, self_weight=0.75,
+                              pair_weight=0.25)
+        np.testing.assert_allclose(np.asarray(out[0]), 0.25, atol=1e-6)
+
+
+class TestBarrier:
+    def test_barrier(self, bf8):
+        bf8.barrier()  # just must not deadlock/raise

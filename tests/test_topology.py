@@ -1,0 +1,174 @@
+"""Topology zoo parity tests (reference model: test/torch_basics_test.py)."""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+from bluefog_tpu import topology_util as tu
+
+
+class TestStaticGraphs:
+    def test_expo2_neighbors(self):
+        # reference asserts expo2 in-neighbors of rank r are r - 2^k
+        # (torch_basics_test.py topology tests)
+        size = 8
+        topo = tu.ExponentialTwoGraph(size)
+        for r in range(size):
+            expected_in = sorted({(r - 2 ** k) % size for k in range(3)})
+            assert tu.in_neighbor_ranks(topo, r) == expected_in
+            expected_out = sorted({(r + 2 ** k) % size for k in range(3)})
+            assert tu.out_neighbor_ranks(topo, r) == expected_out
+
+    def test_expo2_weights_uniform(self):
+        topo = tu.ExponentialTwoGraph(8)
+        sw, nw = tu.GetRecvWeights(topo, 0)
+        assert sw == pytest.approx(0.25)
+        assert all(w == pytest.approx(0.25) for w in nw.values())
+        assert len(nw) == 3
+
+    def test_expo_graph_nonpow2_size(self):
+        topo = tu.ExponentialGraph(12)
+        # distances 1, 2, 4, 8 are powers of two within 12 nodes
+        assert tu.out_neighbor_ranks(topo, 0) == [1, 2, 4, 8]
+
+    def test_symmetric_exponential(self):
+        topo = tu.SymmetricExponentialGraph(12, base=4)
+        # distances d with d or (12-d) in {1, 4}: 1, 4, 8, 11
+        assert tu.out_neighbor_ranks(topo, 0) == [1, 4, 8, 11]
+
+    def test_ring_styles(self):
+        bi = tu.RingGraph(8, connect_style=0)
+        assert tu.in_neighbor_ranks(bi, 0) == [1, 7]
+        left = tu.RingGraph(8, connect_style=1)
+        assert tu.out_neighbor_ranks(left, 2) == [1]
+        right = tu.RingGraph(8, connect_style=2)
+        assert tu.out_neighbor_ranks(right, 2) == [3]
+
+    def test_ring_small_sizes(self):
+        assert tu.RingGraph(1).number_of_nodes() == 1
+        W = tu.weight_matrix(tu.RingGraph(2))
+        np.testing.assert_allclose(W, [[0.5, 0.5], [0.5, 0.5]])
+
+    def test_mesh_grid_doubly_stochastic(self):
+        W = tu.weight_matrix(tu.MeshGrid2DGraph(6))
+        np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+        np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+
+    def test_mesh_grid_explicit_shape(self):
+        topo = tu.MeshGrid2DGraph(6, shape=(2, 3))
+        assert set(tu.out_neighbor_ranks(topo, 0)) == {1, 3}
+
+    def test_star(self):
+        topo = tu.StarGraph(8)
+        assert tu.in_neighbor_ranks(topo, 3) == [0]
+        assert tu.in_neighbor_ranks(topo, 0) == [1, 2, 3, 4, 5, 6, 7]
+        W = tu.weight_matrix(topo)
+        np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+
+    def test_fully_connected(self):
+        topo = tu.FullyConnectedGraph(5)
+        W = tu.weight_matrix(topo)
+        np.testing.assert_allclose(W, np.full((5, 5), 0.2))
+
+    def test_column_stochastic_all(self):
+        # every graph's combine matrix must preserve the global average
+        for builder in (
+            tu.ExponentialTwoGraph,
+            tu.ExponentialGraph,
+            lambda n: tu.SymmetricExponentialGraph(n, 2),
+            tu.MeshGrid2DGraph,
+            tu.StarGraph,
+            tu.RingGraph,
+            tu.FullyConnectedGraph,
+        ):
+            W = tu.weight_matrix(builder(8))
+            np.testing.assert_allclose(
+                W.sum(axis=1), 1.0, atol=1e-12,
+                err_msg=f"{builder} rows must sum to 1",
+            )
+
+    def test_equivalence(self):
+        assert tu.IsTopologyEquivalent(
+            tu.ExponentialTwoGraph(8), tu.ExponentialGraph(8, 2)
+        )
+        assert not tu.IsTopologyEquivalent(
+            tu.RingGraph(8), tu.ExponentialTwoGraph(8)
+        )
+        assert not tu.IsTopologyEquivalent(None, tu.RingGraph(4))
+
+    def test_is_regular(self):
+        assert tu.IsRegularGraph(tu.RingGraph(8))
+        assert not tu.IsRegularGraph(tu.StarGraph(8))
+
+
+class TestCombinePlans:
+    def test_shift_support_expo2(self):
+        W = tu.weight_matrix(tu.ExponentialTwoGraph(8))
+        assert tu.shift_support(W) == [1, 2, 4]
+
+    def test_shift_support_ring(self):
+        W = tu.weight_matrix(tu.RingGraph(8))
+        assert tu.shift_support(W) == [1, 7]
+
+    def test_dynamic_weight_matrix_uniform(self):
+        sends = {0: [1], 1: [2], 2: [3], 3: [0]}
+        W = tu.dynamic_weight_matrix(4, sends)
+        # each rank receives from exactly one peer: 0.5 / 0.5 split
+        np.testing.assert_allclose(np.diag(W), 0.5)
+        assert W[0, 1] == pytest.approx(0.5)
+        np.testing.assert_allclose(W.sum(axis=1), 1.0)
+
+
+class TestDynamicIterators:
+    def test_dynamic_send_recv_consistency(self):
+        # the send/recv sets of all ranks must mirror each other every step
+        topo = tu.ExponentialTwoGraph(8)
+        gens = [tu.GetDynamicSendRecvRanks(topo, r) for r in range(8)]
+        for _ in range(12):
+            steps = [next(g) for g in gens]
+            for r, (send, _recv) in enumerate(steps):
+                assert len(send) == 1
+                dst = send[0]
+                assert r in steps[dst][1], f"rank {dst} must expect recv from {r}"
+
+    def test_dynamic_send_recv_cycles_through_neighbors(self):
+        topo = tu.ExponentialTwoGraph(8)
+        gen = tu.GetDynamicSendRecvRanks(topo, 0)
+        sends = [next(gen)[0][0] for _ in range(3)]
+        assert sorted(sends) == [1, 2, 4]  # out-neighbors, clockwise order
+
+    def test_exp2_machine_ranks(self):
+        gen = tu.GetExp2DynamicSendRecvMachineRanks(
+            world_size=16, local_size=4, self_rank=5, local_rank=1
+        )
+        (s0, r0) = next(gen)
+        (s1, r1) = next(gen)
+        # machine 1 of 4: distances cycle 1, 2
+        assert s0 == [2] and r0 == [0]
+        assert s1 == [3] and r1 == [3]
+
+    def test_inner_outer_ring_consistency(self):
+        world, local = 12, 4
+        gens = [
+            tu.GetInnerOuterRingDynamicSendRecvRanks(world, local, r)
+            for r in range(world)
+        ]
+        for _ in range(10):
+            steps = [next(g) for g in gens]
+            for r, (send, recv) in enumerate(steps):
+                dst, src = send[0], recv[0]
+                assert steps[dst][1] == [r], "receiver must expect this sender"
+                assert steps[src][0] == [r], "sender must target this receiver"
+
+    def test_inner_outer_expo2_consistency(self):
+        world, local = 16, 4
+        gens = [
+            tu.GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)
+            for r in range(world)
+        ]
+        for _ in range(16):
+            steps = [next(g) for g in gens]
+            for r, (send, recv) in enumerate(steps):
+                dst, src = send[0], recv[0]
+                assert steps[dst][1] == [r]
+                assert steps[src][0] == [r]

@@ -1,0 +1,241 @@
+"""Window / one-sided gossip tests (model: test/torch_win_ops_test.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology_util
+
+
+def rank_tensor(n=8, shape=(4,)):
+    base = jnp.arange(n, dtype=jnp.float32).reshape((n,) + (1,) * len(shape))
+    return jnp.broadcast_to(base, (n,) + shape)
+
+
+class TestWinLifecycle:
+    def test_create_free(self, bf8):
+        assert bf8.win_create(rank_tensor(), "w1")
+        assert not bf8.win_create(rank_tensor(), "w1")  # duplicate rejected
+        assert bf8.win_free("w1")
+        assert not bf8.win_free("w1")
+
+    def test_free_all(self, bf8):
+        bf8.win_create(rank_tensor(), "a")
+        bf8.win_create(rank_tensor(), "b")
+        assert bf8.win_free()
+        assert bf8.win_create(rank_tensor(), "a")
+
+    def test_update_unknown_window(self, bf8):
+        with pytest.raises(ValueError, match="does not exist"):
+            bf8.win_update("nope")
+
+
+class TestWinUpdate:
+    def test_update_initial_is_neighbor_avg(self, bf8):
+        # buffers initialize to local tensor value (zero_init=False), so the
+        # first win_update without any put returns the original tensor
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w")
+        out = bf8.win_update("w")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-6)
+
+    def test_put_then_update_neighbor_avg(self, bf8):
+        # parity: torch_win_ops_test.py win_put tests — after every rank
+        # puts, win_update gives the uniform neighbor average
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w")
+        assert bf8.win_put(x, "w")
+        out = bf8.win_update("w")
+        for r in range(8):
+            exp = (r + (r - 1) % 8 + (r + 1) % 8) / 3.0
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_zero_init(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w", zero_init=True)
+        out = bf8.win_update("w")  # neighbors contribute zeros
+        for r in range(8):
+            np.testing.assert_allclose(np.asarray(out[r]), r / 3.0, atol=1e-5)
+
+    def test_partial_put_weights(self, bf8):
+        # put only to the right neighbor with weight 2.0
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w", zero_init=True)
+        bf8.win_put(x, "w", dst_weights={r: {(r + 1) % 8: 2.0} for r in range(8)})
+        out = bf8.win_update("w", self_weight=0.5,
+                             neighbor_weights={r: {(r - 1) % 8: 0.25}
+                                               for r in range(8)})
+        for r in range(8):
+            exp = 0.5 * r + 0.25 * 2.0 * ((r - 1) % 8)
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_update_clone_leaves_window(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        bf8.win_create(rank_tensor(), "w", zero_init=True)
+        out1 = bf8.win_update("w", clone=True)
+        out2 = bf8.win_update("w", clone=True)
+        np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+    def test_update_then_collect(self, bf8):
+        # sums self + all buffers, then resets buffers
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w", zero_init=True)
+        bf8.win_put(x, "w")
+        out = bf8.win_update_then_collect("w")
+        for r in range(8):
+            exp = r + (r - 1) % 8 + (r + 1) % 8
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+        # buffers were reset: a second collect returns just the stored value
+        out2 = bf8.win_update_then_collect("w")
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(out), atol=1e-5)
+
+
+class TestWinAccumulate:
+    def test_accumulate_sums(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w", zero_init=True)
+        bf8.win_accumulate(x, "w")
+        bf8.win_accumulate(x, "w")
+        out = bf8.win_update("w", self_weight=0.0,
+                             neighbor_weights={r: {s: 1.0 for s in
+                                                   bf8.in_neighbor_ranks(r)}
+                                               for r in range(8)})
+        for r in range(8):
+            exp = 2.0 * ((r - 1) % 8 + (r + 1) % 8)
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_self_weight_scaling(self, bf8):
+        # push-sum style: self down-weight after the send
+        bf8.set_topology(topology_util.RingGraph(8, connect_style=2))
+        x = jnp.ones((8, 2))
+        bf8.win_create(x, "w", zero_init=True)
+        bf8.win_accumulate(x, "w", self_weight=0.5,
+                           dst_weights={r: {(r + 1) % 8: 0.5} for r in range(8)})
+        out = bf8.win_update_then_collect("w")
+        # everyone had 1, kept .5, received .5 -> total restored to 1
+        np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-6)
+
+
+class TestWinGet:
+    def test_get_pulls_current_values(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w", zero_init=True)
+        assert bf8.win_get("w")
+        out = bf8.win_update("w")
+        for r in range(8):
+            exp = (r + (r - 1) % 8 + (r + 1) % 8) / 3.0
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+    def test_get_src_weights(self, bf8):
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w", zero_init=True)
+        bf8.win_get("w", src_weights={r: {(r - 1) % 8: 2.0} for r in range(8)})
+        out = bf8.win_update("w", self_weight=1.0,
+                             neighbor_weights={r: {(r - 1) % 8: 1.0}
+                                               for r in range(8)})
+        for r in range(8):
+            exp = r + 2.0 * ((r - 1) % 8)
+            np.testing.assert_allclose(np.asarray(out[r]), exp, atol=1e-5)
+
+
+class TestWinVersions:
+    def test_version_counting(self, bf8):
+        # parity: torch_win_ops_test.py:268,557 version counter checks
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = rank_tensor()
+        bf8.win_create(x, "w")
+        assert bf8.get_win_version("w", rank=0) == {1: 0, 7: 0}
+        bf8.win_put(x, "w")
+        assert bf8.get_win_version("w", rank=0) == {1: 1, 7: 1}
+        bf8.win_put(x, "w")
+        assert bf8.get_win_version("w", rank=0) == {1: 2, 7: 2}
+        bf8.win_update("w")
+        assert bf8.get_win_version("w", rank=0) == {1: 0, 7: 0}
+
+
+class TestWinMutex:
+    def test_mutex_context(self, bf8):
+        bf8.win_create(rank_tensor(), "w")
+        with bf8.win_mutex("w"):
+            pass
+        with bf8.win_mutex("w", for_self=True):
+            pass
+        with bf8.win_mutex("w", ranks=[2, 5]):
+            pass
+
+    def test_win_lock(self, bf8):
+        bf8.win_create(rank_tensor(), "w")
+        with bf8.win_lock("w"):
+            pass
+        with pytest.raises(ValueError):
+            with bf8.win_lock("nope"):
+                pass
+
+    def test_mutex_blocks_concurrent_update(self, bf8):
+        import threading
+
+        bf8.win_create(rank_tensor(), "w")
+        order = []
+
+        def holder():
+            with bf8.win_mutex("w", ranks=list(range(8))):
+                order.append("acquired")
+                ev.wait(timeout=5)
+
+        ev = threading.Event()
+        t = threading.Thread(target=holder)
+        t.start()
+        while not order:
+            pass
+        # update with require_mutex must wait until the holder releases
+        done = []
+
+        def updater():
+            bf8.win_update("w", require_mutex=True)
+            done.append(True)
+
+        t2 = threading.Thread(target=updater)
+        t2.start()
+        t2.join(timeout=0.3)
+        assert not done, "win_update should be blocked by held mutexes"
+        ev.set()
+        t2.join(timeout=5)
+        t.join(timeout=5)
+        assert done
+
+
+class TestPushSum:
+    def test_associated_p_invariant(self, bf8):
+        # parity: torch_win_ops_test.py:762-845 push-sum invariants —
+        # sum of p stays n, and x/p converges to the true average.
+        bf8.set_topology(topology_util.ExponentialTwoGraph(8))
+        bf8.turn_on_win_ops_with_associated_p()
+        try:
+            x = rank_tensor()
+            bf8.win_create(x, "ps", zero_init=True)
+            rng = np.random.RandomState(0)
+            cur = x
+            for it in range(50):
+                # each rank picks one out-neighbor: send half mass there
+                dst_w = {}
+                for r in range(8):
+                    outs = bf8.out_neighbor_ranks(r)
+                    dst_w[r] = {outs[it % len(outs)]: 0.5}
+                bf8.win_accumulate(cur, "ps", self_weight=0.5,
+                                   dst_weights=dst_w, require_mutex=True)
+                cur = bf8.win_update_then_collect("ps")
+            p = bf8.win_associated_p_all("ps")
+            np.testing.assert_allclose(p.sum(), 8.0, atol=1e-6)
+            ratio = np.asarray(cur)[:, 0] / p
+            np.testing.assert_allclose(ratio, 3.5, atol=1e-2)
+        finally:
+            bf8.turn_off_win_ops_with_associated_p()
