@@ -1,0 +1,98 @@
+"""Benchmark: ResNet-50 decentralized training throughput.
+
+Port of the reference harness methodology (examples/pytorch_benchmark.py:
+synthetic ImageNet batches, warmup batches, timed iterations of 10 batches,
+img/sec mean) running the flagship fused train step —
+per-chip grad -> SGD-momentum update -> Expo-2 neighbor averaging — over all
+available chips. Baseline for vs_baseline: the reference's published
+`Total img/sec on 16 GPU(s): 4310.6` => 269.4 img/sec per V100
+(docs/performance.rst:20-24), batch 64 per device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu.models import ResNet50
+
+BATCH_PER_CHIP = 64
+IMAGE = 224
+WARMUP = 3
+ITERS = 10
+BATCHES_PER_ITER = 3
+BASELINE_IMG_SEC_PER_DEVICE = 4310.6 / 16  # reference 16xV100 result
+
+
+def main() -> None:
+    n = len(jax.devices())
+    topo = bf.topology_util.ExponentialTwoGraph(n) if n > 1 else \
+        bf.topology_util.FullyConnectedGraph(1)
+    bf.init(topology_fn=lambda size: topo)
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((BATCH_PER_CHIP, IMAGE, IMAGE, 3), jnp.float32)
+    variables = model.init(rng, sample, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p, ms, batch):
+        images, labels = batch
+        logits, updates = model.apply(
+            {"params": p, "batch_stats": ms}, images, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, (updates["batch_stats"], {})
+
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.1, momentum=0.9), loss_fn, with_model_state=True)
+    state = opt.init(params, model_state=batch_stats)
+
+    images = jax.device_put(
+        jax.random.normal(rng, (n, BATCH_PER_CHIP, IMAGE, IMAGE, 3),
+                          jnp.float32),
+        bf.rank_sharding(bf.mesh()))
+    labels = jax.device_put(
+        jnp.zeros((n, BATCH_PER_CHIP), jnp.int32),
+        bf.rank_sharding(bf.mesh()))
+    batch = (images, labels)
+
+    def sync(m):
+        # A host transfer is the only reliable completion barrier over the
+        # remote-device tunnel (block_until_ready can return early there).
+        return float(np.asarray(m["loss"])[0])
+
+    for _ in range(WARMUP):
+        state, metrics = opt.step(state, batch)
+    sync(metrics)
+
+    img_secs = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        for _ in range(BATCHES_PER_ITER):
+            state, metrics = opt.step(state, batch)
+        sync(metrics)
+        dt = time.perf_counter() - t0
+        img_secs.append(n * BATCH_PER_CHIP * BATCHES_PER_ITER / dt)
+
+    per_device = float(np.mean(img_secs)) / n
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": round(per_device, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_device / BASELINE_IMG_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -95,3 +95,26 @@ from .ops import (
     win_update_then_collect,
     win_wait,
 )
+
+# optimizer wrappers (reference: torch/optimizers.py)
+from .optimizers import (
+    TrainState,
+    replicate,
+    unreplicate,
+    DistributedGradientAllreduceOptimizer,
+    DistributedAllreduceOptimizer,
+    DistributedNeighborAllreduceOptimizer,
+    DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedWinPutOptimizer,
+    DistributedPullGetOptimizer,
+    DistributedPushSumOptimizer,
+)
+
+# parameter/optimizer-state sync utilities (reference: torch/utility.py)
+from .utils import (
+    broadcast_parameters,
+    allreduce_parameters,
+    broadcast_optimizer_state,
+)
+
+from . import models

@@ -1,0 +1,23 @@
+"""Model zoo for bluefog_tpu benchmarks, examples, and tests.
+
+The reference framework has no model code of its own — its examples pull
+torchvision models (reference: examples/pytorch_benchmark.py uses
+``torchvision.models.resnet50``, examples/pytorch_mnist.py defines a small
+CNN). A standalone TPU framework cannot lean on torchvision, so the
+equivalents live here as flax modules designed for the MXU: bfloat16 compute
+with float32 parameters/batch-stats, channel counts that are multiples of
+128 where the architecture allows, and no data-dependent Python control flow.
+"""
+
+from .mlp import MLP, LeNet5
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
+
+__all__ = [
+    "MLP",
+    "LeNet5",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+]

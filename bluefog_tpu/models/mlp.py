@@ -1,0 +1,55 @@
+"""Small models for examples and tests.
+
+Counterparts of the reference's example networks: the MNIST CNN defined
+inline in examples/pytorch_mnist.py (two convs + two dense) and the linear /
+logistic-regression models of examples/pytorch_least_square.py. Small enough
+to train on a simulated CPU mesh in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MLP(nn.Module):
+    """Plain MLP; default geometry suits flattened-MNIST consensus tests."""
+
+    features: Sequence[int] = (128, 128, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, param_dtype=jnp.float32)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x.astype(jnp.float32)
+
+
+class LeNet5(nn.Module):
+    """Conv net of the reference MNIST example (examples/pytorch_mnist.py)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

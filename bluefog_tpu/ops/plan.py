@@ -81,6 +81,40 @@ class CombinePlan:
         return self.W if self.use_gather else self.rows
 
 
+def spmd_combine(w, tree, *, axis: str, n: int, shifts: Tuple[int, ...],
+                 use_gather: bool = False, stacked: bool = True):
+    """Weighted neighbor combine, callable INSIDE shard_map per-rank code.
+
+    ``w`` is the plan's traced weight array (``CombinePlan.weight_array()``):
+    ``[k+1, n]`` rows for the ppermute strategy or the full ``[n, n]`` matrix
+    for the gather strategy. ``shifts`` must be static. ``stacked=True`` means
+    leaves carry the size-1 rank-block dim shard_map produces for
+    rank-stacked arrays; ``stacked=False`` operates on bare per-rank values
+    (the fused-train-step path in optimizers.py).
+    """
+    me = lax.axis_index(axis)
+
+    def one(x):
+        blk = x if stacked else x[None]
+        acc_t = _acc_dtype(blk.dtype)
+        if use_gather:
+            col = jnp.take(w, me, axis=1)  # my combine column [n]
+            xg = lax.all_gather(blk[0], axis, axis=0, tiled=False)  # [n, ...]
+            out = jnp.tensordot(col.astype(acc_t), xg.astype(acc_t), axes=(0, 0))
+            out = out.astype(x.dtype)[None]
+        else:
+            wm = jnp.take(w, me, axis=1)  # my weights [k+1]
+            acc = wm[0].astype(acc_t) * blk.astype(acc_t)
+            for k, s in enumerate(shifts):
+                perm = [(i, (i + s) % n) for i in range(n)]
+                moved = lax.ppermute(blk, axis, perm)
+                acc = acc + wm[k + 1].astype(acc_t) * moved.astype(acc_t)
+            out = acc.astype(x.dtype)
+        return out if stacked else out[0]
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 @functools.lru_cache(maxsize=256)
 def _combine_fn(mesh: Mesh, axis: str, shifts: Tuple[int, ...], use_gather: bool,
                 n_axis: int):
@@ -89,26 +123,11 @@ def _combine_fn(mesh: Mesh, axis: str, shifts: Tuple[int, ...], use_gather: bool
     n = n_axis
 
     def per_rank(w, *leaves):
-        me = lax.axis_index(axis)
-        outs = []
-        if use_gather:
-            col = jnp.take(w, me, axis=1)  # w: [n, n] -> my combine column
-            for x in leaves:
-                acc_t = _acc_dtype(x.dtype)
-                xg = lax.all_gather(x[0], axis, axis=0, tiled=False)  # [n, ...]
-                out = jnp.tensordot(col.astype(acc_t), xg.astype(acc_t), axes=(0, 0))
-                outs.append(out.astype(x.dtype)[None])
-        else:
-            wm = jnp.take(w, me, axis=1)  # w: [k+1, n] -> my weights [k+1]
-            for x in leaves:
-                acc_t = _acc_dtype(x.dtype)
-                acc = wm[0].astype(acc_t) * x.astype(acc_t)
-                for k, s in enumerate(shifts):
-                    perm = [(i, (i + s) % n) for i in range(n)]
-                    moved = lax.ppermute(x, axis, perm)
-                    acc = acc + wm[k + 1].astype(acc_t) * moved.astype(acc_t)
-                outs.append(acc.astype(x.dtype))
-        return tuple(outs)
+        return tuple(
+            spmd_combine(w, x, axis=axis, n=n, shifts=shifts,
+                         use_gather=use_gather)
+            for x in leaves
+        )
 
     # shard_map specs must match the number of leaves; rebuild per leaf-count
     # (traced once per shape signature under the jit below).

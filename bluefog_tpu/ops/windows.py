@@ -5,29 +5,32 @@ torch/mpi_ops.py:890-1363; CPU transport mpi_controller.cc:796-1393; GPU
 emulation nccl_controller.cc:1113-1238). True one-sided RMA does not exist on
 TPU, and the reference itself proves emulation is acceptable — its NCCL path
 is a two-sided protocol with a passive-recv thread. Here the emulation is a
-**mailbox model**: every window keeps, per graph edge (src -> dst), a buffer
-holding the last value src put/accumulated for dst — exactly the
-clone-per-in-neighbor layout of WinTorchStorageManager
-(mpi_win_ops.cc:83-105) — plus the rank's own window tensor. Put/get/
-accumulate write mailboxes; ``win_update`` reads them and computes the
-weighted combine locally, like DoWinSync's Sum/AvgWithNeighbor
-(mpi_win_ops.cc:185-238).
+**mailbox model**: every window keeps, per rank, one receive slot per
+in-neighbor — exactly the clone-per-in-neighbor layout of
+WinTorchStorageManager (mpi_win_ops.cc:83-105) — plus the rank's own window
+tensor.
+
+Execution model: one window op = ONE compiled SPMD program over the rank
+mesh. The mailbox is a rank-sharded array ``mail[n, d_max, ...]`` (slot k of
+rank r belongs to its k-th sorted in-neighbor, the MPI_Dist_graph ordering
+contract); put/get/accumulate decompose the active edge set into circulant
+shifts, move data with one ``ppermute`` per shift, and blend it into the
+destination slot. Per-call weights and active-edge masks are *traced*
+operands, so dynamic partial-destination puts reuse the same compiled
+program. ``win_update`` is a second one-program combine:
+``out[r] = sw[r]*self[r] + sum_k nw[r,k]*mail[r,k]``
+(DoWinSync's Sum/AvgWithNeighbor, mpi_win_ops.cc:185-238).
 
 Semantics preserved from the reference:
   * ``self_weight`` on put/accumulate rescales the locally stored window
     tensor after the send (the push-sum "self down-weighting").
   * per-edge version counters: bumped on put/get/accumulate, cleared when
     win_update reads the buffer (mpi_controller.cc:1281-1393).
-  * per-rank mutexes with ``for_self`` / explicit rank lists
-    (the MPI_Fetch_and_op spin-lock, mpi_controller.cc:1532-1602, becomes a
-    host-side lock table owned by the controller).
-  * associated-p scalars: optional parallel window carrying the push-sum
-    weight, toggled globally (mpi_controller.cc:1009-1022).
-
-On a multi-controller deployment the mailbox writes ride device-to-device
-transfers scheduled by the host runtime; mutex/version state lives with the
-controller, which is the natural owner the way BlueFog's coordinator owned
-negotiation.
+  * per-rank mutexes with host-side lock tables (the MPI_Fetch_and_op
+    spin-lock, mpi_controller.cc:1532-1602, owned by the controller).
+  * associated-p scalars: optional parallel channel carrying the push-sum
+    weight, toggled globally (mpi_ops.py:1339-1363); tiny host-side numpy
+    mirror of the same edge algebra.
 """
 
 from __future__ import annotations
@@ -39,6 +42,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import topology as topology_util
 from ..runtime import handles as _handles
@@ -47,6 +52,55 @@ from ..runtime.timeline import timeline_context
 from .neighbors import _auto_name, _check_rank_stacked, _per_rank
 
 Weights = Union[float, Dict[int, float], Dict[int, Dict[int, float]]]
+
+
+def _win_acc_dtype(dtype):
+    """Accumulation dtype for weighted mailbox math.
+
+    Fractional edge weights demand float arithmetic even for integer
+    windows (the replaced eager implementation got this from JAX's weak
+    python-float promotion); low-precision floats accumulate in f32.
+    """
+    dtype = jnp.dtype(dtype)
+    if not jnp.issubdtype(dtype, jnp.floating):
+        return jnp.float32
+    return jnp.float32 if dtype.itemsize < 4 else dtype
+
+
+class _GraphLayout:
+    """Static decomposition of the window's edge set into circulant shifts."""
+
+    def __init__(self, topology, n: int) -> None:
+        self.n = n
+        self.in_nbrs = {
+            r: topology_util.in_neighbor_ranks(topology, r) for r in range(n)
+        }
+        self.out_nbrs = {
+            r: topology_util.out_neighbor_ranks(topology, r) for r in range(n)
+        }
+        self.d_max = max((len(v) for v in self.in_nbrs.values()), default=0) or 1
+        shifts = sorted({
+            (dst - src) % n
+            for dst, srcs in self.in_nbrs.items() for src in srcs
+        })
+        self.shifts: Tuple[int, ...] = tuple(shifts)
+        self.shift_index = {s: i for i, s in enumerate(shifts)}
+        S = max(len(shifts), 1)
+        # slot[si, dst] = mailbox slot of src=(dst-si_shift)%n at dst; 0 when
+        # the edge doesn't exist (guarded by a zero active mask at runtime).
+        self.slot = np.zeros((S, n), np.int32)
+        self.has_edge = np.zeros((S, n), bool)
+        self.slot_of = {
+            r: {src: k for k, src in enumerate(self.in_nbrs[r])}
+            for r in range(n)
+        }
+        for si, s in enumerate(shifts):
+            for dst in range(n):
+                src = (dst - s) % n
+                k = self.slot_of[dst].get(src)
+                if k is not None:
+                    self.slot[si, dst] = k
+                    self.has_edge[si, dst] = True
 
 
 class Window:
@@ -59,30 +113,117 @@ class Window:
         # Edges are frozen at creation time, like MPI_Win_create against the
         # GRAPH communicator; topology changes are rejected while windows
         # exist (state.set_topology).
-        self.in_neighbors = {
-            r: topology_util.in_neighbor_ranks(st.topology, r)
-            for r in range(st.size)
-        }
-        self.out_neighbors = {
-            r: topology_util.out_neighbor_ranks(st.topology, r)
-            for r in range(st.size)
-        }
-        self.self_value = jnp.asarray(tensor)
-        # mailbox[(dst, src)] = last value src pushed for dst
-        self.mail: Dict[Tuple[int, int], jax.Array] = {}
-        self.version: Dict[Tuple[int, int], int] = {}
-        for dst in range(st.size):
-            for src in self.in_neighbors[dst]:
-                init = jnp.zeros_like(tensor[dst]) if zero_init else \
-                    jnp.asarray(tensor[dst])
-                self.mail[(dst, src)] = init
-                self.version[(dst, src)] = 0
-        # associated-p scalars (push-sum weights), one per rank + mailboxes
+        self.layout = _GraphLayout(st.topology, st.size)
+        self.in_neighbors = self.layout.in_nbrs
+        self.out_neighbors = self.layout.out_nbrs
+        sh = NamedSharding(st.mesh, P("rank"))
+        tensor = jnp.asarray(tensor)
+        self.self_value = jax.device_put(tensor, sh)
+        d = self.layout.d_max
+        # Mailboxes for integer windows store floats: weighted contributions
+        # stay exact until win_update casts the combined result back.
+        mail_dtype = tensor.dtype if jnp.issubdtype(tensor.dtype, jnp.floating) \
+            else jnp.float32
+        if zero_init:
+            mail = jnp.zeros((st.size, d) + tensor.shape[1:], mail_dtype)
+        else:
+            # Neighbor buffers start as a copy of the local tensor
+            # (mpi_ops.py:890-915 zero_init=False default).
+            mail = jnp.broadcast_to(
+                tensor[:, None], (st.size, d) + tensor.shape[1:]
+            ).astype(mail_dtype)
+        self.mail = jax.device_put(mail, sh)
+        self.version = np.zeros((st.size, d), np.int64)
+        # associated-p scalars (push-sum weights) — host numpy mirror
         self.p = np.ones(st.size, dtype=np.float64)
-        self.p_mail: Dict[Tuple[int, int], float] = {
-            edge: 0.0 for edge in self.mail
-        }
+        self.p_mail = np.zeros((st.size, d), dtype=np.float64)
         self.mutexes = [threading.RLock() for _ in range(st.size)]
+        # Serializes the whole-array read-modify-write of mail/self_value:
+        # ops touching disjoint edges hold disjoint rank mutexes yet still
+        # reassign the same arrays, so every op takes this lock around its
+        # dispatch (the rank mutexes keep their reference semantics of
+        # protecting a rank's buffers across ops).
+        self.state_mu = threading.RLock()
+        self._exchange_cache: Dict[Tuple, object] = {}
+        self._update_cache: Dict[Tuple, object] = {}
+
+    # -- compiled programs -------------------------------------------------
+
+    def _exchange_fn(self, accumulate: bool):
+        """One-program put/get/accumulate: ppermute per shift + slot blend."""
+        key = ("xchg", accumulate)
+        fn = self._exchange_cache.get(key)
+        if fn is not None:
+            return fn
+        st = _global_state()
+        lay = self.layout
+        n, shifts = lay.n, lay.shifts
+        slot_c = jnp.asarray(lay.slot)
+
+        def per_rank(x, mail, w, active, self_w):
+            me = lax.axis_index("rank")
+            xb = x[0]
+            mb = mail[0]
+            acc_t = _win_acc_dtype(xb.dtype)
+            for si, s in enumerate(shifts):
+                perm = [(i, (i + s) % n) for i in range(n)]
+                moved = lax.ppermute(xb, "rank", perm)  # from (me - s) % n
+                wk = w[si, me].astype(acc_t)
+                ak = active[si, me]
+                k = slot_c[si, me]
+                cur = lax.dynamic_index_in_dim(mb, k, axis=0, keepdims=False)
+                contrib = moved.astype(acc_t) * wk
+                if accumulate:
+                    # accumulate in acc_t: bf16 mailboxes would otherwise
+                    # round small contributions away (256 + 0.5 -> 256)
+                    val = (cur.astype(acc_t) + contrib).astype(mb.dtype)
+                else:
+                    val = contrib.astype(mb.dtype)
+                new = jnp.where(ak > 0, val, cur)
+                mb = lax.dynamic_update_index_in_dim(mb, new, k, axis=0)
+            new_self = (xb.astype(acc_t) * self_w[me].astype(acc_t)).astype(xb.dtype)
+            return new_self[None], mb[None]
+
+        mapped = jax.shard_map(
+            per_rank,
+            mesh=st.mesh,
+            in_specs=(P("rank"), P("rank"), P(), P(), P()),
+            out_specs=(P("rank"), P("rank")),
+        )
+        fn = jax.jit(mapped)
+        self._exchange_cache[key] = fn
+        return fn
+
+    def _update_fn(self):
+        """One-program combine: out = sw*self + nw . mail, + slot reset."""
+        key = ("upd",)
+        fn = self._update_cache.get(key)
+        if fn is not None:
+            return fn
+        st = _global_state()
+
+        def per_rank(self_v, mail, sw, nw, reset_mask):
+            me = lax.axis_index("rank")
+            sv = self_v[0]
+            mb = mail[0]
+            acc_t = _win_acc_dtype(sv.dtype)
+            w_me = nw[me].astype(acc_t)  # [d_max]
+            combined = sw[me].astype(acc_t) * sv.astype(acc_t) + jnp.tensordot(
+                w_me, mb.astype(acc_t), axes=(0, 0))
+            keep = (1.0 - reset_mask[me]).reshape(
+                (mb.shape[0],) + (1,) * (mb.ndim - 1))
+            mail_new = (mb.astype(acc_t) * keep).astype(mb.dtype)
+            return combined.astype(sv.dtype)[None], mail_new[None]
+
+        mapped = jax.shard_map(
+            per_rank,
+            mesh=st.mesh,
+            in_specs=(P("rank"), P("rank"), P(), P(), P()),
+            out_specs=(P("rank"), P("rank")),
+        )
+        fn = jax.jit(mapped)
+        self._update_cache[key] = fn
+        return fn
 
 
 def _get_window(name: str) -> Window:
@@ -132,6 +273,48 @@ def _edge_weights(
     return table
 
 
+def _edge_arrays(win: Window, table: Dict[int, Dict[int, float]]):
+    """[S, n] weight + active arrays for an edge-weight table keyed by src."""
+    lay = win.layout
+    S = max(len(lay.shifts), 1)
+    w = np.zeros((S, lay.n), np.float32)
+    active = np.zeros((S, lay.n), np.float32)
+    for src in range(lay.n):
+        for dst, wt in table[src].items():
+            si = lay.shift_index[(dst - src) % lay.n]
+            w[si, dst] = wt
+            active[si, dst] = 1.0
+    return w, active
+
+
+def _bump_host_state(win: Window, table: Dict[int, Dict[int, float]],
+                     accumulate: bool) -> None:
+    """Mirror version counters and associated-p scalars for touched edges."""
+    st = _global_state()
+    for src in range(win.size):
+        for dst, wt in table[src].items():
+            k = win.layout.slot_of[dst][src]
+            win.version[dst, k] += 1
+            if st.win_ops_with_associated_p:
+                contrib = win.p[src] * wt
+                if accumulate:
+                    win.p_mail[dst, k] += contrib
+                else:
+                    win.p_mail[dst, k] = contrib
+
+
+def _acquire(win: Window, ranks, require_mutex: bool):
+    if require_mutex:
+        for r in sorted(set(ranks)):
+            win.mutexes[r].acquire()
+
+
+def _release(win: Window, ranks, require_mutex: bool):
+    if require_mutex:
+        for r in sorted(set(ranks), reverse=True):
+            win.mutexes[r].release()
+
+
 # ---------------------------------------------------------------------------
 # lifecycle
 # ---------------------------------------------------------------------------
@@ -169,6 +352,37 @@ def win_free(name: Optional[str] = None) -> bool:
 # put / accumulate / get
 # ---------------------------------------------------------------------------
 
+def _do_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
+                 require_mutex: bool, activity: str, from_get: bool = False):
+    st = _global_state()
+    w, active = _edge_arrays(win, table)
+    if from_get:
+        # A get READS the source ranks' window tensors: lock the sources
+        # (the reference locks win.mutexes[src] in WinGet).
+        touched = [src for src in range(win.size) if table[src]]
+    else:
+        # A put/accumulate WRITES the destinations' mailboxes: lock the dsts.
+        touched = [dst for src in range(win.size) for dst in table[src]]
+    source = None if from_get else jnp.asarray(tensor)  # get reads under lock
+    sw_arr = jnp.asarray(sw_list, jnp.float32)
+    fn = win._exchange_fn(accumulate)
+    _acquire(win, touched, require_mutex)
+    try:
+        with timeline_context(win.name, activity), win.state_mu:
+            new_self, new_mail = fn(
+                source if not from_get else win.self_value, win.mail,
+                jnp.asarray(w), jnp.asarray(active), sw_arr)
+            if not from_get:
+                win.self_value = new_self
+            win.mail = new_mail
+            _bump_host_state(win, table, accumulate)
+            if st.win_ops_with_associated_p and not from_get:
+                win.p = win.p * np.asarray(sw_list, np.float64)
+    finally:
+        _release(win, touched, require_mutex)
+    return _handles.allocate(f"{activity.lower()}.{win.name}", win.self_value)
+
+
 def win_put_nonblocking(
     tensor,
     name: str,
@@ -187,28 +401,8 @@ def win_put_nonblocking(
     _check_rank_stacked(tensor, st.size, "win_put")
     table = _edge_weights(dst_weights, win.out_neighbors, 1.0, "dst_weights", st.size)
     sw = _per_rank(1.0 if self_weight is None else self_weight, st.size, "self_weight")
-    tensor = jnp.asarray(tensor)
-
-    with timeline_context(name, "WIN_PUT"):
-        for src in range(st.size):
-            for dst, w in table[src].items():
-                if require_mutex:
-                    win.mutexes[dst].acquire()
-                try:
-                    win.mail[(dst, src)] = tensor[src] * w
-                    win.version[(dst, src)] += 1
-                    if st.win_ops_with_associated_p:
-                        win.p_mail[(dst, src)] = win.p[src] * w
-                finally:
-                    if require_mutex:
-                        win.mutexes[dst].release()
-        sw_arr = jnp.asarray(sw, dtype=jnp.result_type(tensor.dtype, jnp.float32))
-        win.self_value = (
-            tensor * sw_arr.reshape((st.size,) + (1,) * (tensor.ndim - 1))
-        ).astype(tensor.dtype)
-        if st.win_ops_with_associated_p:
-            win.p = win.p * np.asarray(sw)
-    return _handles.allocate(f"win_put.{name}", win.self_value)
+    return _do_exchange(win, tensor, table, sw, accumulate=False,
+                        require_mutex=require_mutex, activity="WIN_PUT")
 
 
 def win_put(tensor, name: str, self_weight=None, dst_weights=None,
@@ -231,28 +425,8 @@ def win_accumulate_nonblocking(
     _check_rank_stacked(tensor, st.size, "win_accumulate")
     table = _edge_weights(dst_weights, win.out_neighbors, 1.0, "dst_weights", st.size)
     sw = _per_rank(1.0 if self_weight is None else self_weight, st.size, "self_weight")
-    tensor = jnp.asarray(tensor)
-
-    with timeline_context(name, "WIN_ACCUMULATE"):
-        for src in range(st.size):
-            for dst, w in table[src].items():
-                if require_mutex:
-                    win.mutexes[dst].acquire()
-                try:
-                    win.mail[(dst, src)] = win.mail[(dst, src)] + tensor[src] * w
-                    win.version[(dst, src)] += 1
-                    if st.win_ops_with_associated_p:
-                        win.p_mail[(dst, src)] += win.p[src] * w
-                finally:
-                    if require_mutex:
-                        win.mutexes[dst].release()
-        sw_arr = jnp.asarray(sw, dtype=jnp.result_type(tensor.dtype, jnp.float32))
-        win.self_value = (
-            tensor * sw_arr.reshape((st.size,) + (1,) * (tensor.ndim - 1))
-        ).astype(tensor.dtype)
-        if st.win_ops_with_associated_p:
-            win.p = win.p * np.asarray(sw)
-    return _handles.allocate(f"win_accumulate.{name}", win.self_value)
+    return _do_exchange(win, tensor, table, sw, accumulate=True,
+                        require_mutex=require_mutex, activity="WIN_ACCUMULATE")
 
 
 def win_accumulate(tensor, name: str, self_weight=None, dst_weights=None,
@@ -275,22 +449,18 @@ def win_get_nonblocking(
     """
     win = _get_window(name)
     st = _global_state()
-    table = _edge_weights(src_weights, win.in_neighbors, 1.0, "src_weights", st.size)
-
-    with timeline_context(name, "WIN_GET"):
-        for dst in range(st.size):
-            for src, w in table[dst].items():
-                if require_mutex:
-                    win.mutexes[src].acquire()
-                try:
-                    win.mail[(dst, src)] = win.self_value[src] * w
-                    win.version[(dst, src)] += 1
-                    if st.win_ops_with_associated_p:
-                        win.p_mail[(dst, src)] = win.p[src] * w
-                finally:
-                    if require_mutex:
-                        win.mutexes[src].release()
-    return _handles.allocate(f"win_get.{name}", win.self_value)
+    # src-keyed table: entry (dst pulls from src with weight w) is an edge
+    # src -> dst, same wire direction as a put.
+    recv_table = _edge_weights(src_weights, win.in_neighbors, 1.0,
+                               "src_weights", st.size)
+    table: Dict[int, Dict[int, float]] = {r: {} for r in range(st.size)}
+    for dst in range(st.size):
+        for src, wt in recv_table[dst].items():
+            table[src][dst] = wt
+    sw = [1.0] * st.size  # get leaves the stored window tensor unchanged
+    return _do_exchange(win, None, table, sw, accumulate=False,
+                        require_mutex=require_mutex, activity="WIN_GET",
+                        from_get=True)
 
 
 def win_get(name: str, src_weights=None, require_mutex: bool = False) -> bool:
@@ -347,39 +517,41 @@ def win_update(
             neighbor_weights, win.in_neighbors, 1.0, "neighbor_weights", n
         )
 
+    lay = win.layout
+    nw = np.zeros((n, lay.d_max), np.float32)
+    read_mask = np.zeros((n, lay.d_max), np.float32)
+    for r, wmap in nw_table.items():
+        for src, wt in wmap.items():
+            k = lay.slot_of[r][src]
+            nw[r, k] = wt
+            read_mask[r, k] = 1.0
+
     with timeline_context(name, "WIN_UPDATE"):
-        if require_mutex:
-            for r in range(n):
-                win.mutexes[r].acquire()
+        _acquire(win, range(n), require_mutex)
+        win.state_mu.acquire()
         try:
-            slices = []
-            new_p = np.array(win.p)
-            for r in range(n):
-                acc = sw_list[r] * win.self_value[r].astype(jnp.float32)
-                for src, w in nw_table[r].items():
-                    acc = acc + w * win.mail[(r, src)].astype(jnp.float32)
-                slices.append(acc.astype(win.self_value.dtype))
-                if st.win_ops_with_associated_p:
-                    p_acc = sw_list[r] * win.p[r]
-                    for src, w in nw_table[r].items():
-                        p_acc += w * win.p_mail[(r, src)]
-                    new_p[r] = p_acc
-            result = jnp.stack(slices, axis=0)
-            for r in range(n):
-                for src in nw_table[r]:
-                    win.version[(r, src)] = 0
-                    if reset:
-                        win.mail[(r, src)] = jnp.zeros_like(win.mail[(r, src)])
-                        if st.win_ops_with_associated_p:
-                            win.p_mail[(r, src)] = 0.0
+            fn = win._update_fn()
+            result, new_mail = fn(
+                win.self_value, win.mail,
+                jnp.asarray(sw_list, jnp.float32), jnp.asarray(nw),
+                jnp.asarray(read_mask if reset else np.zeros_like(read_mask)))
+            if st.win_ops_with_associated_p:
+                new_p = np.asarray(sw_list, np.float64) * win.p + np.sum(
+                    nw.astype(np.float64) * win.p_mail, axis=1)
+            # versions of read buffers reset; optionally clear the buffers
+            for r, wmap in nw_table.items():
+                for src in wmap:
+                    win.version[r, lay.slot_of[r][src]] = 0
+            win.mail = new_mail
+            if reset and st.win_ops_with_associated_p:
+                win.p_mail = win.p_mail * (1.0 - read_mask.astype(np.float64))
             if not clone:
                 win.self_value = result
                 if st.win_ops_with_associated_p:
                     win.p = new_p
         finally:
-            if require_mutex:
-                for r in range(n):
-                    win.mutexes[r].release()
+            win.state_mu.release()
+            _release(win, range(n), require_mutex)
     return result
 
 
@@ -416,7 +588,10 @@ def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
     """
     win = _get_window(name)
     r = 0 if rank is None else rank
-    return {src: win.version[(r, src)] for src in win.in_neighbors[r]}
+    return {
+        src: int(win.version[r, win.layout.slot_of[r][src]])
+        for src in win.in_neighbors[r]
+    }
 
 
 class win_mutex:

@@ -1,0 +1,538 @@
+"""Distributed optimizer wrappers — the training-loop layer.
+
+TPU-native rebuild of BlueFog's optimizer family (reference:
+torch/optimizers.py, 1073 LoC). The reference wraps a torch optimizer and
+hooks module forward/backward passes to launch nonblocking communication,
+synchronizing in ``step()``. In JAX the idiomatic equivalent is *fusion*: each
+wrapper here compiles ONE SPMD program per step that performs
+
+    per-rank grad  ->  optax update  ->  communication (pmean / weighted
+                                          neighbor combine / nothing)
+
+so XLA overlaps the backward matmuls with the ICI collective traffic — the
+same overlap BlueFog gets from its background thread, but scheduled by the
+compiler instead of a negotiation protocol.
+
+The seven strategies mirror the reference surface (optimizers.py:776-1073):
+
+  * ``DistributedGradientAllreduceOptimizer``  — allreduce gradients
+    (Horovod style; reference optimizers.py:1026).
+  * ``DistributedAllreduceOptimizer``          — allreduce parameters after
+    the local update (reference optimizers.py:895).
+  * ``DistributedNeighborAllreduceOptimizer``  — weighted neighbor averaging
+    of parameters over the virtual topology; per-iteration dynamic knobs
+    ``self_weight / neighbor_weights / send_neighbors / enable_topo_check``
+    (reference optimizers.py:943 & 298-304).
+  * ``DistributedHierarchicalNeighborAllreduceOptimizer`` — intra-machine
+    allreduce + machine-graph neighbor averaging (reference
+    optimizers.py:971); knobs ``neighbor_machine_weights /
+    send_neighbor_machines``.
+  * ``DistributedWinPutOptimizer``             — push-style asynchronous
+    gossip over windows (reference optimizers.py:867).
+  * ``DistributedPullGetOptimizer``            — pull-style (reference
+    optimizers.py:821).
+  * ``DistributedPushSumOptimizer``            — push-sum with associated
+    weight scalar (reference optimizers.py:776 & 624-773).
+
+All support ``num_steps_per_communication`` (local-SGD delayed communication,
+reference optimizers.py:152-155).
+
+Canonical usage::
+
+    opt = bf.DistributedNeighborAllreduceOptimizer(
+        optax.sgd(0.01, momentum=0.9), loss_fn=loss_fn)
+    state = opt.init(params)                 # replicates across the mesh
+    state, metrics = opt.step(state, batch)  # batch is rank-stacked [n, b, ...]
+
+``loss_fn(params, batch) -> loss`` (or ``(loss, aux)`` with ``has_aux=True``;
+or ``loss_fn(params, model_state, batch) -> (loss, (model_state, aux))`` with
+``with_model_state=True`` for batch-norm models).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import topology as topology_util
+from .ops import windows as _windows
+from .ops.neighbors import _dynamic_weight_matrix, _static_weight_matrix
+from .ops.plan import CombinePlan, spmd_combine
+from .runtime.state import _global_state
+from .runtime.timeline import timeline_context
+
+
+@struct.dataclass
+class TrainState:
+    """Rank-stacked training state: leaf ``x[r]`` lives on device r."""
+
+    params: Any
+    opt_state: Any
+    model_state: Any = None
+
+
+def replicate(tree, mesh=None, axis: str = "rank"):
+    """Broadcast a single-rank pytree to a rank-stacked, mesh-sharded one.
+
+    The analog of ``bf.broadcast_parameters(..., root_rank=0)`` at t=0
+    (reference: torch/utility.py:22-56): every rank starts from identical
+    values.
+    """
+    st = _global_state()
+    st.check_initialized()
+    mesh = mesh or st.mesh
+    n = mesh.devices.size
+    sh = NamedSharding(mesh, P(mesh.axis_names))
+
+    def rep(x):
+        x = jnp.asarray(x)
+        return jax.device_put(jnp.broadcast_to(x[None], (n,) + x.shape), sh)
+
+    return jax.tree_util.tree_map(rep, tree)
+
+
+def unreplicate(tree, rank: int = 0):
+    """Slice one rank's copy out of a rank-stacked pytree."""
+    return jax.tree_util.tree_map(lambda x: x[rank], tree)
+
+
+def _canon_loss(loss_fn, has_aux: bool, with_model_state: bool):
+    """Normalize to (params, model_state, batch) -> (loss, (model_state, aux))."""
+    if with_model_state:
+        return loss_fn
+    if has_aux:
+        def f(p, ms, b):
+            loss, aux = loss_fn(p, b)
+            return loss, (ms, aux)
+        return f
+
+    def g(p, ms, b):
+        return loss_fn(p, b), (ms, {})
+    return g
+
+
+_unstack = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+_restack = lambda t: jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], t)
+
+
+class _FusedOptimizer:
+    """Shared machinery: fused per-step SPMD program with cached jits."""
+
+    _comm_kind = "none"  # overridden: gradient_allreduce | allreduce |
+    #                       neighbor_allreduce | hierarchical | none
+
+    def __init__(
+        self,
+        optimizer: optax.GradientTransformation,
+        loss_fn: Callable,
+        *,
+        has_aux: bool = False,
+        with_model_state: bool = False,
+        num_steps_per_communication: int = 1,
+        name: Optional[str] = None,
+    ) -> None:
+        st = _global_state()
+        st.check_initialized()
+        self.base = optimizer
+        self._loss = _canon_loss(loss_fn, has_aux, with_model_state)
+        self.num_steps_per_communication = int(num_steps_per_communication)
+        self._counter = 0
+        self._step_cache: Dict[Any, Any] = {}
+        self.name = name or type(self).__name__
+
+    # -- state ------------------------------------------------------------
+
+    def init(self, params, model_state=None) -> TrainState:
+        """Replicate single-rank params (+ model state) and init optax state."""
+        opt_state = self.base.init(params)
+        return TrainState(
+            params=replicate(params),
+            opt_state=replicate(opt_state),
+            model_state=None if model_state is None else replicate(model_state),
+        )
+
+    # -- plan hooks (overridden per strategy) -----------------------------
+
+    def _plan(self) -> Optional[CombinePlan]:
+        return None
+
+    def _mesh_axes(self) -> Tuple[Any, Any]:
+        st = _global_state()
+        return st.mesh, "rank"
+
+    # -- the fused step ---------------------------------------------------
+
+    def _build(self, key, plan: Optional[CombinePlan], do_comm: bool):
+        st = _global_state()
+        mesh, _ = self._mesh_axes()
+        kind = self._comm_kind if do_comm else "none"
+        loss = self._loss
+        opt = self.base
+        shifts = plan.shifts if plan is not None else ()
+        use_gather = plan.use_gather if plan is not None else False
+        pn = plan.n if plan is not None else 0
+        hier = kind == "hierarchical"
+        axis = "machine" if hier else "rank"
+
+        def per_rank(w, params, opt_state, model_state, batch):
+            p = _unstack(params)
+            os_ = _unstack(opt_state)
+            ms = _unstack(model_state)
+            b = _unstack(batch)
+
+            (l, (new_ms, aux)), grads = jax.value_and_grad(
+                lambda p_: loss(p_, ms, b), has_aux=True)(p)
+            if kind == "gradient_allreduce":
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, mesh.axis_names), grads)
+            updates, new_os = opt.update(grads, os_, p)
+            p = optax.apply_updates(p, updates)
+            if kind == "allreduce":
+                p = jax.tree_util.tree_map(
+                    lambda x: lax.pmean(x, mesh.axis_names), p)
+            elif kind == "neighbor_allreduce":
+                p = spmd_combine(w, p, axis=axis, n=pn, shifts=shifts,
+                                 use_gather=use_gather, stacked=False)
+            elif kind == "hierarchical":
+                p = jax.tree_util.tree_map(lambda x: lax.pmean(x, "local"), p)
+                p = spmd_combine(w, p, axis="machine", n=pn, shifts=shifts,
+                                 use_gather=use_gather, stacked=False)
+            metrics = {"loss": l, "aux": aux}
+            return (_restack(p), _restack(new_os), _restack(new_ms),
+                    _restack(metrics))
+
+        spec = P(mesh.axis_names)
+        mapped = jax.shard_map(
+            per_rank,
+            mesh=mesh,
+            in_specs=(P(), spec, spec, spec, spec),
+            out_specs=(spec, spec, spec, spec),
+        )
+        return jax.jit(mapped)
+
+    def _weights_and_key(self):
+        plan = self._plan()
+        if plan is None:
+            return None, jnp.zeros((1, 1), jnp.float32), ("none",)
+        return plan, jnp.asarray(plan.weight_array()), (plan.shifts, plan.use_gather)
+
+    def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        """One training iteration over the whole mesh."""
+        k = self.num_steps_per_communication
+        self._counter += 1
+        do_comm = (self._counter % k) == 0
+        plan, w, wkey = self._weights_and_key() if do_comm else (None, jnp.zeros((1, 1), jnp.float32), ("skip",))
+        key = (do_comm,) + wkey
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build(key, plan, do_comm)
+            self._step_cache[key] = fn
+        with timeline_context(self.name, "STEP"):
+            params, opt_state, model_state, metrics = fn(
+                w, state.params, state.opt_state, state.model_state, batch)
+        return TrainState(params, opt_state, model_state), metrics
+
+
+class DistributedGradientAllreduceOptimizer(_FusedOptimizer):
+    """Global gradient averaging before the update (Horovod-style).
+
+    Reference: optimizers.py:1026 / the backward accumulator hooks at
+    optimizers.py:161-186. ``lax.pmean`` over the mesh is the whole transport.
+    """
+
+    _comm_kind = "gradient_allreduce"
+
+
+class DistributedAllreduceOptimizer(_FusedOptimizer):
+    """Global parameter averaging after the local update.
+
+    Reference: optimizers.py:895 (_DistributedReduceOptimizer, forward hook).
+    """
+
+    _comm_kind = "allreduce"
+
+
+class DistributedNeighborAllreduceOptimizer(_FusedOptimizer):
+    """Parameter averaging with in-neighbors over the virtual topology (CTA).
+
+    The flagship decentralized strategy (reference: optimizers.py:943).
+    Mutate ``self_weight`` / ``neighbor_weights`` / ``send_neighbors`` between
+    steps for dynamic topologies (reference: optimizers.py:298-304); each
+    distinct edge-shift set compiles once and is cached — Expo-2's one-peer
+    schedule has ceil(log2 n) distinct sets.
+    """
+
+    _comm_kind = "neighbor_allreduce"
+
+    def __init__(self, *args, **kw) -> None:
+        super().__init__(*args, **kw)
+        self.self_weight: Optional[float] = None
+        self.neighbor_weights: Optional[Dict] = None
+        self.send_neighbors = None
+        self.enable_topo_check: bool = True
+
+    def _plan(self) -> CombinePlan:
+        st = _global_state()
+        if self.send_neighbors is None:
+            W = _static_weight_matrix(self.self_weight, self.neighbor_weights)
+        else:
+            W = _dynamic_weight_matrix(
+                st.size, self.send_neighbors, self.self_weight,
+                self.neighbor_weights, self.enable_topo_check)
+        return CombinePlan(W)
+
+
+class DistributedHierarchicalNeighborAllreduceOptimizer(_FusedOptimizer):
+    """Intra-machine allreduce + machine-level neighbor averaging.
+
+    Reference: optimizers.py:971 / mpi_controller.cc:455-515's 3-phase scheme,
+    which collapses on TPU to ``pmean(local)`` + weighted ppermute over the
+    machine mesh axis (the broadcast phase is free — all local devices compute
+    identical combines).
+    """
+
+    _comm_kind = "hierarchical"
+
+    def __init__(self, *args, **kw) -> None:
+        st = _global_state()
+        if st.machine_mesh is None:
+            raise RuntimeError(
+                "hierarchical optimizer requires a homogeneous machine layout")
+        super().__init__(*args, **kw)
+        self.self_weight: Optional[float] = None
+        self.neighbor_machine_weights: Optional[Dict] = None
+        self.send_neighbor_machines = None
+        self.enable_topo_check: bool = False
+
+    def _mesh_axes(self):
+        st = _global_state()
+        return st.machine_mesh, "machine"
+
+    def _plan(self) -> CombinePlan:
+        st = _global_state()
+        m = st.size // st.local_size
+        if self.send_neighbor_machines is None:
+            if self.neighbor_machine_weights is None:
+                mtopo = topology_util.ExponentialTwoGraph(m) if m > 1 else \
+                    topology_util.FullyConnectedGraph(1)
+                W = np.zeros((m, m))
+                for r in range(m):
+                    nbrs = topology_util.in_neighbor_ranks(mtopo, r)
+                    u = 1.0 / (len(nbrs) + 1)
+                    W[r, r] = u
+                    for src in nbrs:
+                        W[src, r] = u
+            else:
+                raise ValueError(
+                    "neighbor_machine_weights requires send_neighbor_machines")
+        else:
+            W = _dynamic_weight_matrix(
+                m, self.send_neighbor_machines, self.self_weight,
+                self.neighbor_machine_weights, self.enable_topo_check)
+        return CombinePlan(W)
+
+    def init(self, params, model_state=None) -> TrainState:
+        st = _global_state()
+        opt_state = self.base.init(params)
+        mesh = st.machine_mesh
+        return TrainState(
+            params=replicate(params, mesh),
+            opt_state=replicate(opt_state, mesh),
+            model_state=None if model_state is None else replicate(model_state, mesh),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Window (asynchronous gossip) optimizers
+# ---------------------------------------------------------------------------
+
+class _WindowOptimizer(_FusedOptimizer):
+    """Local fused update + host-scheduled window gossip.
+
+    Where the fused strategies compile communication into the step, the
+    window strategies keep the reference's asynchronous shape: the update is
+    a compiled local step ("none" comm kind), and parameter mixing happens
+    through the mailbox window subsystem (reference: _DistributedWinOptimizer,
+    optimizers.py:465-621). Window names are ``<opt>.<leaf index>`` — one
+    window per parameter tensor, exactly the reference's per-parameter
+    win_create (optimizers.py:509-520).
+    """
+
+    _comm_kind = "none"
+    _zero_init = False  # push-sum mailboxes must start empty (no stale mass)
+
+    _instance_counter = [0]  # id() can recycle after GC; a counter cannot
+
+    def __init__(self, *args, window_prefix: Optional[str] = None, **kw) -> None:
+        super().__init__(*args, **kw)
+        _WindowOptimizer._instance_counter[0] += 1
+        self._prefix = window_prefix or \
+            f"{self.name}.{_WindowOptimizer._instance_counter[0]}"
+        self._win_names: list = []
+        self._treedef = None
+        self.require_mutex = True
+
+    def init(self, params, model_state=None) -> TrainState:
+        state = super().init(params, model_state)
+        leaves, self._treedef = jax.tree_util.tree_flatten(state.params)
+        self._win_names = [f"{self._prefix}.{i}" for i in range(len(leaves))]
+        for nm, leaf in zip(self._win_names, leaves):
+            if not _windows.win_create(leaf, nm, zero_init=self._zero_init):
+                raise RuntimeError(f"window {nm} already exists")
+        return state
+
+    def free(self) -> None:
+        for nm in self._win_names:
+            _windows.win_free(nm)
+        self._win_names = []
+        self._restore_flags()
+
+    def _restore_flags(self) -> None:
+        pass  # push-sum restores the global associated-p toggle
+
+    def _local_step(self, state, batch):
+        key = (False, "none")
+        fn = self._step_cache.get(key)
+        if fn is None:
+            fn = self._build(key, None, False)
+            self._step_cache[key] = fn
+        params, opt_state, model_state, metrics = fn(
+            jnp.zeros((1, 1), jnp.float32),
+            state.params, state.opt_state, state.model_state, batch)
+        return TrainState(params, opt_state, model_state), metrics
+
+    def _gossip(self, leaves):  # -> mixed leaves
+        raise NotImplementedError
+
+    def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        self._counter += 1
+        with timeline_context(self.name, "STEP"):
+            state, metrics = self._local_step(state, batch)
+            if (self._counter % self.num_steps_per_communication) == 0:
+                leaves = jax.tree_util.tree_flatten(state.params)[0]
+                mixed = self._gossip(leaves)
+                params = jax.tree_util.tree_unflatten(self._treedef, mixed)
+                state = TrainState(params, state.opt_state, state.model_state)
+        return state, metrics
+
+
+class DistributedWinPutOptimizer(_WindowOptimizer):
+    """Push-style gossip: put fresh params into out-neighbors' mailboxes,
+    then combine self + received values under mutex (reference:
+    optimizers.py:867, pull_style=False)."""
+
+    def __init__(self, *args, **kw) -> None:
+        super().__init__(*args, **kw)
+        self.dst_weights = None
+        self.self_weight = None
+        self.neighbor_weights = None
+
+    def _gossip(self, leaves):
+        out = []
+        for nm, leaf in zip(self._win_names, leaves):
+            _windows.win_put(leaf, nm, dst_weights=self.dst_weights,
+                             require_mutex=self.require_mutex)
+            out.append(_windows.win_update(
+                nm, self_weight=self.self_weight,
+                neighbor_weights=self.neighbor_weights,
+                require_mutex=self.require_mutex))
+        return out
+
+
+class DistributedPullGetOptimizer(_WindowOptimizer):
+    """Pull-style gossip: publish own params, pull neighbors' current values,
+    combine locally (reference: optimizers.py:821, pull_style=True)."""
+
+    def __init__(self, *args, **kw) -> None:
+        super().__init__(*args, **kw)
+        self.src_weights = None
+        self.self_weight = None
+        self.neighbor_weights = None
+
+    def _gossip(self, leaves):
+        st = _global_state()
+        out = []
+        for nm, leaf in zip(self._win_names, leaves):
+            st.windows[nm].self_value = jnp.asarray(leaf)  # publish
+            _windows.win_get(nm, src_weights=self.src_weights,
+                             require_mutex=self.require_mutex)
+            out.append(_windows.win_update(
+                nm, self_weight=self.self_weight,
+                neighbor_weights=self.neighbor_weights,
+                require_mutex=self.require_mutex))
+        return out
+
+
+class DistributedPushSumOptimizer(_WindowOptimizer):
+    """Push-sum gossip with associated weights (column-stochastic sends).
+
+    Reference: optimizers.py:624-773. Each rank's window holds the push-sum
+    numerator; the associated-p scalar rides the same ops (the reference
+    concatenates it to the flattened parameter; here it is the window
+    subsystem's associated-p channel, mpi_ops.py:1339-1363). Parameters for
+    the next gradient evaluation are numerator / p.
+    """
+
+    _zero_init = True  # reference creates push-sum windows with zero_init
+
+    def __init__(self, *args, **kw) -> None:
+        super().__init__(*args, **kw)
+        st = _global_state()
+        self._prior_associated_p = st.win_ops_with_associated_p
+        _windows.turn_on_win_ops_with_associated_p()
+        self._outdeg = {
+            r: len(topology_util.out_neighbor_ranks(st.topology, r))
+            for r in range(st.size)
+        }
+
+    def _restore_flags(self) -> None:
+        _global_state().win_ops_with_associated_p = self._prior_associated_p
+
+    def _gossip(self, leaves):
+        st = _global_state()
+        n = st.size
+        # Column-stochastic weights: each rank splits mass 1/(outdeg+1)
+        # between itself and every out-neighbor (optimizers.py:700-717).
+        sw = {r: 1.0 / (self._outdeg[r] + 1) for r in range(n)}
+        dw = {
+            r: {dst: 1.0 / (self._outdeg[r] + 1)
+                for dst in topology_util.out_neighbor_ranks(st.topology, r)}
+            for r in range(n)
+        }
+        out = []
+        for nm, leaf in zip(self._win_names, leaves):
+            win = st.windows[nm]
+            # numerator = x * p  (x is the de-biased parameter)
+            p_col = np.asarray(win.p, dtype=np.float64)
+            numer = leaf * jnp.asarray(p_col, leaf.dtype).reshape(
+                (n,) + (1,) * (leaf.ndim - 1))
+            _windows.win_accumulate(numer, nm, self_weight=sw, dst_weights=dw,
+                                    require_mutex=self.require_mutex)
+            collected = _windows.win_update_then_collect(
+                nm, require_mutex=self.require_mutex)
+            p_new = _windows.win_associated_p_all(nm)
+            out.append(collected / jnp.asarray(p_new, collected.dtype).reshape(
+                (n,) + (1,) * (collected.ndim - 1)))
+        return out
+
+
+__all__ = [
+    "TrainState",
+    "replicate",
+    "unreplicate",
+    "DistributedGradientAllreduceOptimizer",
+    "DistributedAllreduceOptimizer",
+    "DistributedNeighborAllreduceOptimizer",
+    "DistributedHierarchicalNeighborAllreduceOptimizer",
+    "DistributedWinPutOptimizer",
+    "DistributedPullGetOptimizer",
+    "DistributedPushSumOptimizer",
+]

@@ -1,0 +1,32 @@
+"""Long-context / sequence parallelism over the device mesh.
+
+The reference framework predates long-context work and has no attention code
+(SURVEY.md §5.7) — but its core machinery, ring/exponential-graph neighbor
+exchange, is exactly the substrate context parallelism rides on. This package
+makes that substrate a first-class capability of the rebuild:
+
+  * ``ring_attention`` — blockwise flash attention with K/V blocks rotating
+    around the mesh ring by ``ppermute`` (one ICI hop per step), online
+    softmax renormalization, O(S/n) memory per chip.
+  * ``ulysses_attention`` — all-to-all sequence parallelism: re-shard
+    sequence -> heads, run dense local attention, re-shard back.
+  * ``sequence_sharding`` — place [B, S, H, D] arrays sequence-sharded.
+"""
+
+from .context import (
+    reference_attention,
+    ring_attention,
+    ring_attention_shard,
+    sequence_sharding,
+    ulysses_attention,
+    ulysses_attention_shard,
+)
+
+__all__ = [
+    "ring_attention",
+    "ring_attention_shard",
+    "ulysses_attention",
+    "ulysses_attention_shard",
+    "reference_attention",
+    "sequence_sharding",
+]

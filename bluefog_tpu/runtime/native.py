@@ -1,0 +1,157 @@
+"""ctypes bindings for the native host-runtime extension (csrc/bf_runtime.cc).
+
+The native library provides the C++ subsystems of the rebuild (the analog of
+the reference's C++ core, cf. SURVEY.md §2.1): the timeline writer
+(timeline.cc) and the control-plane scalar protocols (distributed mutex /
+fetch-and-op / barrier — mpi_controller.cc:1532-1602's window mutexes and
+version counters, served over TCP for multi-controller deployments).
+
+Built lazily with g++ on first use; every consumer must degrade gracefully
+when the toolchain is unavailable (``load()`` returns None).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from .logging import logger
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_SO = os.path.join(_CSRC, "build", "libbf_runtime.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.bf_timeline_open.restype = ctypes.c_void_p
+    lib.bf_timeline_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.bf_timeline_event.restype = None
+    lib.bf_timeline_event.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char,
+        ctypes.c_int64, ctypes.c_int,
+    ]
+    lib.bf_timeline_close.restype = None
+    lib.bf_timeline_close.argtypes = [ctypes.c_void_p]
+
+    lib.bf_cp_serve.restype = ctypes.c_void_p
+    lib.bf_cp_serve.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.bf_cp_server_port.restype = ctypes.c_int
+    lib.bf_cp_server_port.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_server_stop.restype = None
+    lib.bf_cp_server_stop.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_connect.restype = ctypes.c_void_p
+    lib.bf_cp_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    for fname in ("bf_cp_barrier", "bf_cp_lock", "bf_cp_unlock", "bf_cp_get"):
+        fn = getattr(lib, fname)
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    for fname in ("bf_cp_fetch_add", "bf_cp_put"):
+        fn = getattr(lib, fname)
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.bf_cp_disconnect.restype = None
+    lib.bf_cp_disconnect.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO):
+            script = os.path.join(_CSRC, "build.sh")
+            if not os.path.exists(script):
+                return None
+            try:
+                subprocess.run(["sh", script], check=True,
+                               capture_output=True, timeout=120)
+            except (subprocess.SubprocessError, OSError) as exc:
+                logger.info("native runtime build failed (%s); "
+                            "using pure-Python fallbacks", exc)
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_SO))
+        except OSError as exc:
+            logger.info("native runtime load failed (%s)", exc)
+            _lib = None
+        return _lib
+
+
+class ControlPlaneServer:
+    """Coordinator side of the scalar control plane (one per job)."""
+
+    def __init__(self, world: int, port: int = 0) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.bf_cp_serve(port, world)
+        if not self._h:
+            raise OSError(f"control plane failed to bind port {port}")
+        self.port = lib.bf_cp_server_port(self._h)
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.bf_cp_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class ControlPlaneClient:
+    """Per-controller client: mutexes, counters, barriers, scalar KV."""
+
+    def __init__(self, host: str, port: int, rank: int) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.bf_cp_connect(host.encode(), port, rank)
+        if not self._h:
+            raise OSError(f"control plane connect to {host}:{port} failed")
+
+    def barrier(self, name: str = "default") -> int:
+        return self._lib.bf_cp_barrier(self._h, name.encode())
+
+    def lock(self, name: str) -> None:
+        self._lib.bf_cp_lock(self._h, name.encode())
+
+    def unlock(self, name: str) -> None:
+        self._lib.bf_cp_unlock(self._h, name.encode())
+
+    def fetch_add(self, name: str, delta: int = 1) -> int:
+        """Atomic fetch-then-add; returns the pre-add value
+        (MPI_Fetch_and_op semantics, mpi_controller.cc:1532-1602)."""
+        return self._lib.bf_cp_fetch_add(self._h, name.encode(), delta)
+
+    def put(self, name: str, value: int) -> None:
+        self._lib.bf_cp_put(self._h, name.encode(), value)
+
+    def get(self, name: str) -> int:
+        return self._lib.bf_cp_get(self._h, name.encode())
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.bf_cp_disconnect(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -35,18 +35,34 @@ class Timeline:
 
     _SENTINEL = object()
 
-    def __init__(self, prefix: str, process_index: Optional[int] = None) -> None:
+    def __init__(self, prefix: str, process_index: Optional[int] = None,
+                 use_native: bool = True) -> None:
         pid = jax.process_index() if process_index is None else process_index
         self.path = f"{prefix}{pid}.json"
-        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._t0 = time.perf_counter_ns()
         self._pid = pid
         self._closed = False
         self._failed = False  # writer died: stop producing so the queue can't grow
-        self._writer = threading.Thread(
-            target=self._writer_loop, name="bf-timeline-writer", daemon=True
-        )
-        self._writer.start()
+        self._native = None
+        self._native_lib = None
+        # Serializes native event emission against close(): bf_timeline_close
+        # frees the C++ writer, so no producer may hold the handle across it.
+        self._native_mu = threading.Lock()
+        if use_native:
+            from . import native as _native_mod
+
+            lib = _native_mod.load()
+            if lib is not None:
+                handle = lib.bf_timeline_open(self.path.encode(), pid)
+                if handle:
+                    self._native = handle
+                    self._native_lib = lib
+        if self._native is None:
+            self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="bf-timeline-writer", daemon=True
+            )
+            self._writer.start()
 
     # -- producer side (any thread) ---------------------------------------
 
@@ -56,6 +72,13 @@ class Timeline:
     def activity_start(self, tensor_name: str, activity: str, tid: int = 0) -> None:
         if self._failed or self._closed:
             return
+        if self._native is not None:
+            with self._native_mu:
+                if self._native is not None:
+                    self._native_lib.bf_timeline_event(
+                        self._native, activity.encode(), tensor_name.encode(),
+                        b"B", int(self._now_us()), tid)
+            return
         self._q.put(
             {"name": activity, "cat": tensor_name, "ph": "B",
              "ts": self._now_us(), "pid": self._pid, "tid": tid}
@@ -64,6 +87,13 @@ class Timeline:
     def activity_end(self, tensor_name: str, tid: int = 0) -> None:
         if self._failed or self._closed:
             return
+        if self._native is not None:
+            with self._native_mu:
+                if self._native is not None:
+                    self._native_lib.bf_timeline_event(
+                        self._native, b"", tensor_name.encode(),
+                        b"E", int(self._now_us()), tid)
+            return
         self._q.put(
             {"ph": "E", "ts": self._now_us(), "pid": self._pid, "tid": tid,
              "cat": tensor_name}
@@ -71,6 +101,13 @@ class Timeline:
 
     def instant(self, tensor_name: str, activity: str, tid: int = 0) -> None:
         if self._failed or self._closed:
+            return
+        if self._native is not None:
+            with self._native_mu:
+                if self._native is not None:
+                    self._native_lib.bf_timeline_event(
+                        self._native, activity.encode(), tensor_name.encode(),
+                        b"i", int(self._now_us()), tid)
             return
         self._q.put(
             {"name": activity, "cat": tensor_name, "ph": "i", "s": "t",
@@ -110,6 +147,11 @@ class Timeline:
         if self._closed:
             return
         self._closed = True
+        if self._native is not None:
+            with self._native_mu:
+                handle, self._native = self._native, None
+            self._native_lib.bf_timeline_close(handle)
+            return
         self._q.put(Timeline._SENTINEL)
         self._writer.join(timeout=5.0)
 

@@ -1,0 +1,20 @@
+"""Parameter/state synchronization helpers.
+
+TPU-native rebuild of the reference's ``torch/utility.py``:
+``broadcast_parameters`` (utility.py:22-56), ``allreduce_parameters``
+(utility.py:59-80), ``broadcast_optimizer_state`` (utility.py:83-160). The
+reference walks a torch ``state_dict``; here the arguments are rank-stacked
+pytrees and each helper is one collective over the mesh.
+"""
+
+from .params import (
+    allreduce_parameters,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+
+__all__ = [
+    "broadcast_parameters",
+    "allreduce_parameters",
+    "broadcast_optimizer_state",
+]

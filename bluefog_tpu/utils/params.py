@@ -1,0 +1,42 @@
+"""Broadcast / average whole parameter pytrees across the mesh."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..ops import collectives as _collectives
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Overwrite every rank's slice with ``root_rank``'s values.
+
+    The initial-state synchronization of decentralized training (reference:
+    utility.py:22-56; called at the top of every example script). ``params``
+    is a rank-stacked pytree; returns the broadcast result (functional — JAX
+    arrays are immutable, unlike the in-place torch version).
+    """
+    return _collectives.broadcast(params, root_rank, name="broadcast.parameters")
+
+
+def allreduce_parameters(params: Any) -> Any:
+    """Replace every rank's slice with the global average.
+
+    Reference: utility.py:59-80 (used to synchronize models periodically or
+    before evaluation in decentralized runs).
+    """
+    return _collectives.allreduce(params, average=True, name="allreduce.parameters")
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Broadcast an optax state pytree from ``root_rank``.
+
+    The reference version (utility.py:83-160) walks torch optimizer
+    ``state_dict`` entries and special-cases non-tensor scalars by wrapping
+    them in tensors; optax states are already pytrees of arrays, so this is
+    the same one collective as ``broadcast_parameters``.
+    """
+    return _collectives.broadcast(
+        opt_state, root_rank, name="broadcast.optimizer_state"
+    )

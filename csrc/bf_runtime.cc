@@ -1,0 +1,450 @@
+// bf_runtime: native host-runtime extension for bluefog_tpu.
+//
+// TPU-native analog of the reference's C++ host runtime. Two subsystems:
+//
+//  1. Timeline writer — chrome-tracing JSON streamed through an in-memory
+//     queue to a dedicated writer thread (reference: common/timeline.{h,cc},
+//     whose boost spsc_queue + WriterLoop this mirrors with a mutex-guarded
+//     MPMC queue: producers here are arbitrary Python threads).
+//
+//  2. Control plane — small-scalar coordination protocols that XLA
+//     collectives cannot express: distributed mutexes, fetch-and-op
+//     counters (version windows / push-sum bookkeeping), named barriers,
+//     and key-value scalar exchange. This is the analog of the reference's
+//     MPI_Fetch_and_op spin-lock windows (mpi_controller.cc:1532-1602) and
+//     version windows (mpi_controller.cc:1281-1393) for deployments with
+//     one controller process per host, riding TCP/DCN instead of MPI RMA.
+//
+// Exposed as a C ABI consumed from Python via ctypes (no pybind11 in the
+// image). Build: csrc/build.sh (g++ -O2 -shared -fPIC).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Timeline
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct TimelineEvent {
+  std::string name;
+  std::string cat;
+  char phase;      // 'B', 'E', 'i'
+  int64_t ts_us;
+  int tid;
+};
+
+struct Timeline {
+  FILE* f = nullptr;
+  int pid = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<TimelineEvent> q;
+  std::thread writer;
+  bool closing = false;
+  bool first = true;
+
+  void WriterLoop() {
+    std::fputs("[\n", f);
+    for (;;) {
+      std::deque<TimelineEvent> batch;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return closing || !q.empty(); });
+        if (q.empty() && closing) break;
+        batch.swap(q);
+      }
+      for (const auto& ev : batch) Write(ev);
+      std::fflush(f);
+    }
+    std::fputs("\n]\n", f);
+    std::fclose(f);
+  }
+
+  static void JsonEscape(const std::string& s, std::string* out) {
+    for (char c : s) {
+      if (c == '"' || c == '\\') { out->push_back('\\'); out->push_back(c); }
+      else if ((unsigned char)c < 0x20) { out->append("?"); }
+      else out->push_back(c);
+    }
+  }
+
+  void Write(const TimelineEvent& ev) {
+    std::string name, cat;
+    JsonEscape(ev.name, &name);
+    JsonEscape(ev.cat, &cat);
+    if (!first) std::fputs(",\n", f);
+    first = false;
+    switch (ev.phase) {
+      case 'B':
+        std::fprintf(f,
+            "{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"B\", "
+            "\"ts\": %lld, \"pid\": %d, \"tid\": %d}",
+            name.c_str(), cat.c_str(), (long long)ev.ts_us, pid, ev.tid);
+        break;
+      case 'E':
+        std::fprintf(f,
+            "{\"ph\": \"E\", \"cat\": \"%s\", \"ts\": %lld, "
+            "\"pid\": %d, \"tid\": %d}",
+            cat.c_str(), (long long)ev.ts_us, pid, ev.tid);
+        break;
+      default:
+        std::fprintf(f,
+            "{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"i\", \"s\": \"t\", "
+            "\"ts\": %lld, \"pid\": %d, \"tid\": %d}",
+            name.c_str(), cat.c_str(), (long long)ev.ts_us, pid, ev.tid);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bf_timeline_open(const char* path, int pid) {
+  FILE* f = std::fopen(path, "w");
+  if (!f) return nullptr;
+  auto* tl = new Timeline();
+  tl->f = f;
+  tl->pid = pid;
+  tl->writer = std::thread([tl] { tl->WriterLoop(); });
+  return tl;
+}
+
+void bf_timeline_event(void* handle, const char* name, const char* cat,
+                       char phase, int64_t ts_us, int tid) {
+  auto* tl = static_cast<Timeline*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(tl->mu);
+    if (tl->closing) return;
+    tl->q.push_back(TimelineEvent{name ? name : "", cat ? cat : "",
+                                  phase, ts_us, tid});
+  }
+  tl->cv.notify_one();
+}
+
+void bf_timeline_close(void* handle) {
+  auto* tl = static_cast<Timeline*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(tl->mu);
+    tl->closing = true;
+  }
+  tl->cv.notify_one();
+  tl->writer.join();
+  delete tl;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Control plane
+// ---------------------------------------------------------------------------
+//
+// Wire format (all little-endian, client -> server):
+//   u32 payload_len | u8 op | i32 rank | u16 key_len | key bytes | i64 arg
+// Server -> client: u32 payload_len(=8) | i64 value
+// Ops: 1=barrier 2=lock 3=unlock 4=fetch_add 5=put 6=get 7=shutdown.
+// Barrier and lock block server-side (each connection owns a handler
+// thread, the MPI "passive target" made explicit — cf. the reference's
+// passive-recv thread design, nccl_controller.cc:1113-1238).
+
+namespace {
+
+enum Op : uint8_t {
+  kBarrier = 1, kLock = 2, kUnlock = 3, kFetchAdd = 4, kPut = 5, kGet = 6,
+  kShutdown = 7,
+};
+
+struct ControlServer {
+  int listen_fd = -1;
+  int world = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::vector<int> handler_fds;
+  std::atomic<bool> stopping{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, int64_t> kv;
+  std::map<std::string, int> lock_owner;           // key -> rank (or -1)
+  std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
+  std::map<std::string, int> barrier_count;
+
+  void Handle(int fd) {
+    for (;;) {
+      uint32_t len;
+      if (!ReadAll(fd, &len, 4)) break;
+      if (len < 15 || len > 4096) break;
+      std::vector<char> buf(len);
+      if (!ReadAll(fd, buf.data(), len)) break;
+      uint8_t op = buf[0];
+      int32_t rank;
+      std::memcpy(&rank, buf.data() + 1, 4);
+      uint16_t klen;
+      std::memcpy(&klen, buf.data() + 5, 2);
+      if (7 + klen + 8 > len) break;
+      std::string key(buf.data() + 7, klen);
+      int64_t arg;
+      std::memcpy(&arg, buf.data() + 7 + klen, 8);
+      int64_t reply = 0;
+      bool quit = false;
+      switch (op) {
+        case kBarrier: {
+          std::unique_lock<std::mutex> lk(mu);
+          int64_t gen = barrier_gen[key];
+          if (++barrier_count[key] >= world) {
+            barrier_count[key] = 0;
+            barrier_gen[key] = gen + 1;
+            cv.notify_all();
+          } else {
+            cv.wait(lk, [&] {
+              return stopping.load() || barrier_gen[key] != gen;
+            });
+          }
+          reply = barrier_gen[key];
+          break;
+        }
+        case kLock: {
+          std::unique_lock<std::mutex> lk(mu);
+          cv.wait(lk, [&] {
+            auto it = lock_owner.find(key);
+            return stopping.load() ||
+                   it == lock_owner.end() || it->second == -1 ||
+                   it->second == rank;  // re-entrant per rank
+          });
+          lock_owner[key] = rank;
+          reply = 1;
+          break;
+        }
+        case kUnlock: {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = lock_owner.find(key);
+          if (it != lock_owner.end() && it->second == rank) it->second = -1;
+          cv.notify_all();
+          reply = 1;
+          break;
+        }
+        case kFetchAdd: {
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t& slot = kv[key];
+          reply = slot;
+          slot += arg;
+          break;
+        }
+        case kPut: {
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = arg;
+          reply = 1;
+          break;
+        }
+        case kGet: {
+          std::lock_guard<std::mutex> lk(mu);
+          reply = kv.count(key) ? kv[key] : 0;
+          break;
+        }
+        case kShutdown:
+          quit = true;
+          reply = 1;
+          break;
+        default:
+          break;
+      }
+      uint32_t rlen = 8;
+      char out[12];
+      std::memcpy(out, &rlen, 4);
+      std::memcpy(out + 4, &reply, 8);
+      if (!WriteAll(fd, out, 12)) break;
+      if (quit) {
+        stopping.store(true);
+        cv.notify_all();
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  static bool ReadAll(int fd, void* p, size_t n) {
+    char* c = static_cast<char*>(p);
+    while (n) {
+      ssize_t r = ::recv(fd, c, n, 0);
+      if (r <= 0) return false;
+      c += r;
+      n -= r;
+    }
+    return true;
+  }
+
+  static bool WriteAll(int fd, const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    while (n) {
+      ssize_t r = ::send(fd, c, n, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      c += r;
+      n -= r;
+    }
+    return true;
+  }
+
+  void AcceptLoop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu);
+      if (stopping.load()) {
+        ::close(fd);
+        break;
+      }
+      handler_fds.push_back(fd);
+      handlers.emplace_back([this, fd] { Handle(fd); });
+    }
+  }
+};
+
+struct ControlClient {
+  int fd = -1;
+  int rank = 0;
+  std::mutex mu;
+
+  int64_t Call(uint8_t op, const std::string& key, int64_t arg) {
+    std::lock_guard<std::mutex> lk(mu);
+    uint16_t klen = static_cast<uint16_t>(key.size());
+    uint32_t len = 1 + 4 + 2 + klen + 8;
+    std::vector<char> buf(4 + len);
+    std::memcpy(buf.data(), &len, 4);
+    buf[4] = static_cast<char>(op);
+    std::memcpy(buf.data() + 5, &rank, 4);
+    std::memcpy(buf.data() + 9, &klen, 2);
+    std::memcpy(buf.data() + 11, key.data(), klen);
+    std::memcpy(buf.data() + 11 + klen, &arg, 8);
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+    uint32_t rlen;
+    int64_t reply;
+    if (!ControlServer::ReadAll(fd, &rlen, 4)) return -1;
+    if (rlen != 8) return -1;
+    if (!ControlServer::ReadAll(fd, &reply, 8)) return -1;
+    return reply;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bf_cp_serve(int port, int world) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* srv = new ControlServer();
+  srv->listen_fd = fd;
+  srv->world = world;
+  srv->accept_thread = std::thread([srv] { srv->AcceptLoop(); });
+  return srv;
+}
+
+int bf_cp_server_port(void* handle) {
+  auto* srv = static_cast<ControlServer*>(handle);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+                    &len) < 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void bf_cp_server_stop(void* handle) {
+  auto* srv = static_cast<ControlServer*>(handle);
+  srv->stopping.store(true);
+  srv->cv.notify_all();
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  srv->accept_thread.join();
+  // Wake every blocked handler (recv returns 0 after shutdown; cv waiters
+  // see `stopping`), then JOIN them all before freeing the server — each
+  // handler closes its own fd on exit, so no fd is closed twice and no
+  // thread can touch freed state.
+  std::vector<std::thread> hs;
+  {
+    std::lock_guard<std::mutex> lk(srv->mu);
+    for (int fd : srv->handler_fds) ::shutdown(fd, SHUT_RDWR);
+    hs.swap(srv->handlers);
+  }
+  for (auto& t : hs)
+    if (t.joinable()) t.join();
+  delete srv;
+}
+
+void* bf_cp_connect(const char* host, int port, int rank) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* cl = new ControlClient();
+  cl->fd = fd;
+  cl->rank = rank;
+  return cl;
+}
+
+int64_t bf_cp_barrier(void* h, const char* key) {
+  return static_cast<ControlClient*>(h)->Call(kBarrier, key, 0);
+}
+int64_t bf_cp_lock(void* h, const char* key) {
+  return static_cast<ControlClient*>(h)->Call(kLock, key, 0);
+}
+int64_t bf_cp_unlock(void* h, const char* key) {
+  return static_cast<ControlClient*>(h)->Call(kUnlock, key, 0);
+}
+int64_t bf_cp_fetch_add(void* h, const char* key, int64_t delta) {
+  return static_cast<ControlClient*>(h)->Call(kFetchAdd, key, delta);
+}
+int64_t bf_cp_put(void* h, const char* key, int64_t value) {
+  return static_cast<ControlClient*>(h)->Call(kPut, key, value);
+}
+int64_t bf_cp_get(void* h, const char* key) {
+  return static_cast<ControlClient*>(h)->Call(kGet, key, 0);
+}
+void bf_cp_disconnect(void* h) {
+  auto* cl = static_cast<ControlClient*>(h);
+  ::close(cl->fd);
+  delete cl;
+}
+
+}  // extern "C"

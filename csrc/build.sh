@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native host-runtime extension (libbf_runtime.so).
+# Invoked lazily by bluefog_tpu.runtime.native; safe to run by hand.
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+exec g++ -O2 -shared -fPIC -std=c++17 -pthread \
+    -o build/libbf_runtime.so bf_runtime.cc

@@ -13,6 +13,8 @@ On a real TPU slice just run it plainly: ranks are the local chips.
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import numpy as np
 
 import jax

@@ -19,6 +19,10 @@ os.environ["JAX_PLATFORMS"] = ""
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Exact-value assertions: keep MXU matmuls in full f32 (the default TPU
+# precision rounds operands to bf16, which breaks 1e-5-level oracles).
+jax.config.update("jax_default_matmul_precision", "highest")
+
 import bluefog_tpu as bf  # noqa: E402
 
 

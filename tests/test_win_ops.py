@@ -239,3 +239,24 @@ class TestPushSum:
             np.testing.assert_allclose(ratio, 3.5, atol=1e-2)
         finally:
             bf8.turn_off_win_ops_with_associated_p()
+
+
+def test_win_put_integer_window_fractional_weights(bf8):
+    # Regression: fractional edge weights on an integer window must not
+    # truncate in the mailbox (mail stores f32; cast happens at win_update).
+    import jax.numpy as jnp
+    import numpy as np
+    x = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32)[:, None] * 8, (8, 4))
+    assert bf8.win_create(x, "int_win")
+    bf8.win_put(x, "int_win", dst_weights={r: 0.5 for r in range(8)})
+    out = bf8.win_update("int_win", self_weight=0.0,
+                         neighbor_weights={r: {s: 1.0 for s in
+                             bf8.in_neighbor_ranks(r)} for r in range(8)})
+    got = np.asarray(out)
+    # rank r receives 0.5 * x[src] summed over its in-neighbors
+    for r in range(8):
+        srcs = bf8.in_neighbor_ranks(r)
+        expect = sum(0.5 * s * 8 for s in srcs)
+        np.testing.assert_allclose(got[r], int(expect) * np.ones(4), atol=1)
+    assert out.dtype == jnp.int32
+    bf8.win_free("int_win")
