@@ -117,4 +117,6 @@ from .utils import (
     broadcast_optimizer_state,
 )
 
+from . import checkpoint
 from . import models
+from . import parallel

@@ -11,6 +11,7 @@ with float32 parameters/batch-stats, channel counts that are multiples of
 
 from .mlp import MLP, LeNet5
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
+from .transformer import TransformerLM, apply_rope
 
 __all__ = [
     "MLP",
@@ -20,4 +21,6 @@ __all__ = [
     "ResNet34",
     "ResNet50",
     "ResNet101",
+    "TransformerLM",
+    "apply_rope",
 ]

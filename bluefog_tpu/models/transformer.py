@@ -1,0 +1,96 @@
+"""Decoder-only transformer LM with pluggable attention.
+
+Net-new vs the reference (which predates attention entirely, SURVEY.md
+§5.7): the long-context workhorse of the rebuild. The attention inner
+function is injectable so the SAME module runs
+
+  * dense single-device attention (default, the correctness oracle), or
+  * ring attention / Ulysses inside a sequence-sharded ``shard_map``
+    (``bluefog_tpu.parallel.cp_apply``), where each device holds S/n tokens.
+
+Positions are explicit arguments so sequence-sharded calls can feed global
+token positions to the rotary embedding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel.context import reference_attention
+
+
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding over [B, S, H, D] with positions [S] or [B, S]."""
+    d2 = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(0, d2, dtype=jnp.float32) / d2)
+    if positions.ndim == 1:
+        positions = positions[None]
+    ang = positions[..., None].astype(jnp.float32) * freqs  # [B, S, d2]
+    sin = jnp.sin(ang)[:, :, None, :]
+    cos = jnp.cos(ang)[:, :, None, :]
+    x1, x2 = x[..., :d2].astype(jnp.float32), x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Block(nn.Module):
+    num_heads: int
+    d_ff: int
+    dtype: Any
+    attn_fn: Callable
+
+    @nn.compact
+    def __call__(self, x, positions):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
+                        use_bias=False)
+        h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        qkv = dense(3 * d_model, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = q.shape[:2] + (self.num_heads, head_dim)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+        a = self.attn_fn(q, k, v)
+        a = a.reshape(a.shape[:2] + (d_model,))
+        x = x + dense(d_model, name="out")(a)
+        h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        h = dense(self.d_ff, name="up")(h)
+        h = nn.gelu(h)
+        x = x + dense(d_model, name="down")(h)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM. ``attn_fn(q, k, v) -> out`` defaults to dense attention."""
+
+    vocab_size: int
+    num_layers: int = 2
+    num_heads: int = 4
+    d_model: int = 128
+    d_ff: int = 512
+    dtype: Any = jnp.float32
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None):
+        attn = self.attn_fn or partial(reference_attention, causal=True)
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="embed")(tokens)
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.d_ff, self.dtype, attn,
+                      name=f"block_{i}")(x, positions)
+        x = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                       name="final_norm")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          param_dtype=jnp.float32, use_bias=False,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)

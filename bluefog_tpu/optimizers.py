@@ -216,7 +216,10 @@ class _FusedOptimizer:
             in_specs=(P(), spec, spec, spec, spec),
             out_specs=(spec, spec, spec, spec),
         )
-        return jax.jit(mapped)
+        # Donate params/opt_state/model_state: the caller always replaces
+        # them with the step outputs, and donation lets XLA update in place
+        # instead of double-buffering the model in HBM.
+        return jax.jit(mapped, donate_argnums=(1, 2, 3))
 
     def _weights_and_key(self):
         plan = self._plan()

@@ -21,12 +21,18 @@ from .context import (
     ulysses_attention,
     ulysses_attention_shard,
 )
+from .flash import flash_attention, flash_block
+from .lm import cp_apply, cp_loss_fn
 
 __all__ = [
+    "flash_attention",
+    "flash_block",
     "ring_attention",
     "ring_attention_shard",
     "ulysses_attention",
     "ulysses_attention_shard",
     "reference_attention",
     "sequence_sharding",
+    "cp_apply",
+    "cp_loss_fn",
 ]

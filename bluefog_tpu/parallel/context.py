@@ -40,7 +40,8 @@ def reference_attention(q, k, v, causal: bool = False):
     return out.astype(q.dtype)
 
 
-def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False):
+def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
+                         use_flash: bool = False, interpret: bool = False):
     """Per-device ring attention body; call INSIDE shard_map.
 
     ``q/k/v``: this chip's sequence shard [B, S/n, H, D]. K and V make one
@@ -48,7 +49,15 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False):
     against the currently held K/V block and renormalizes the running
     (max, sum, out) accumulators — flash attention's streaming update with
     the stream order given by ring position.
+
+    ``use_flash=True`` computes each block's partials with the pallas VMEM
+    kernel (parallel.flash.flash_block) instead of XLA einsums: scores never
+    reach HBM, which is what lets per-chip K/V blocks grow long. ``interpret``
+    runs that kernel in interpreter mode (CPU test meshes).
     """
+    if use_flash:
+        return _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                     causal=causal, interpret=interpret)
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -92,6 +101,39 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False):
     return out.astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool,
+                          interpret: bool):
+    """Ring loop whose per-block compute is the pallas flash kernel."""
+    from .flash import flash_block
+
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    q_off = me * Sq
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(t, carry):
+        o, m, l, kc, vc = carry
+        blk = (me - t) % n
+        bo, bm, bl = flash_block(q, kc, vc, q_off, blk * Sk,
+                                 causal=causal, interpret=interpret)
+        m_new = jnp.maximum(m, bm)                      # [B, Sq, H]
+        c_old = jnp.exp(m - m_new)
+        c_blk = jnp.exp(bm - m_new)
+        l_new = l * c_old + bl * c_blk
+        o_new = o * c_old[..., None] + bo * c_blk[..., None]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return o_new, m_new, l_new, kc, vc
+
+    o0 = lax.pvary(jnp.zeros((B, Sq, H, D), jnp.float32), (axis_name,))
+    m0 = lax.pvary(jnp.full((B, Sq, H), _NEG, jnp.float32), (axis_name,))
+    l0 = lax.pvary(jnp.zeros((B, Sq, H), jnp.float32), (axis_name,))
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    return (o / l[..., None]).astype(q.dtype)
+
+
 def ulysses_attention_shard(q, k, v, *, axis_name: str, causal: bool = False):
     """Per-device Ulysses body; call INSIDE shard_map.
 
@@ -118,12 +160,18 @@ def sequence_sharding(mesh: Mesh, axis: str = "rank") -> NamedSharding:
 
 
 @functools.lru_cache(maxsize=32)
-def _cp_fn(mesh: Mesh, axis: str, causal: bool, kind: str):
-    body = {"ring": ring_attention_shard,
-            "ulysses": ulysses_attention_shard}[kind]
+def _cp_fn(mesh: Mesh, axis: str, causal: bool, kind: str,
+           use_flash: bool = False, interpret: bool = False):
+    if kind == "ring":
+        body = functools.partial(ring_attention_shard, axis_name=axis,
+                                 causal=causal, use_flash=use_flash,
+                                 interpret=interpret)
+    else:
+        body = functools.partial(ulysses_attention_shard, axis_name=axis,
+                                 causal=causal)
     spec = P(None, axis)
     mapped = jax.shard_map(
-        functools.partial(body, axis_name=axis, causal=causal),
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
@@ -132,7 +180,7 @@ def _cp_fn(mesh: Mesh, axis: str, causal: bool, kind: str):
 
 
 def _cp_call(kind: str, q, k, v, mesh: Optional[Mesh], axis: str,
-             causal: bool):
+             causal: bool, use_flash: bool = False, interpret: bool = False):
     if mesh is None:
         from ..runtime.state import _global_state
         st = _global_state()
@@ -147,16 +195,18 @@ def _cp_call(kind: str, q, k, v, mesh: Optional[Mesh], axis: str,
     if kind == "ulysses" and q.shape[2] % n:
         raise ValueError(
             f"ulysses needs heads % {n} == 0; got {q.shape[2]} heads")
-    return _cp_fn(mesh, axis, causal, kind)(q, k, v)
+    return _cp_fn(mesh, axis, causal, kind, use_flash, interpret)(q, k, v)
 
 
 def ring_attention(q, k, v, mesh: Optional[Mesh] = None, axis: str = "rank",
-                   causal: bool = False):
+                   causal: bool = False, use_flash: bool = False,
+                   interpret: bool = False):
     """Ring attention over global [B, S, H, D] arrays (S sharded on ``axis``).
 
     Uses the initialized runtime's rank mesh when ``mesh`` is None.
+    ``use_flash`` routes each block through the pallas VMEM kernel.
     """
-    return _cp_call("ring", q, k, v, mesh, axis, causal)
+    return _cp_call("ring", q, k, v, mesh, axis, causal, use_flash, interpret)
 
 
 def ulysses_attention(q, k, v, mesh: Optional[Mesh] = None,

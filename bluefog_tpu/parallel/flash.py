@@ -1,0 +1,134 @@
+"""Pallas flash-attention block kernel.
+
+The MXU hot path for attention: one fused kernel computes, per query tile,
+the unnormalized attention partials
+
+    o = exp(s - m) @ V,   m = rowmax(s),   l = rowsum(exp(s - m))
+
+against one K/V block held in VMEM — scores never touch HBM, which is the
+whole point of flash attention (XLA would materialize the [Sq, Sk] score
+tensor for long sequences). Returning (o, m, l) instead of normalized output
+makes the kernel the *inner step* of ring attention: the XLA-level ring loop
+(context.py) merges the per-block statistics exactly as it does for its
+einsum fallback.
+
+Global-position offsets are scalar-prefetch operands so the SAME compiled
+kernel serves every ring step (block positions are runtime values, not
+trace constants). Off-TPU the kernel runs in interpret mode, keeping the
+CPU-mesh test suite meaningful.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAVE_PLTPU = False
+
+_NEG = -1e30
+
+
+def _q_tile(sq: int) -> int:
+    for t in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if sq % t == 0:
+            return t
+    return 1
+
+
+def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
+            causal: bool, scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale      # [TQ, D]
+    k = k_ref[0].astype(jnp.float32)              # [Sk, D]
+    v = v_ref[0].astype(jnp.float32)
+    tq, sk = q.shape[0], k.shape[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = offs_ref[0] + qi * tq + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, sk), 0)
+        k_pos = offs_ref[1] + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 1)
+        allowed = q_pos >= k_pos
+        s = jnp.where(allowed, s, _NEG)
+    m = jnp.max(s, axis=-1)                       # [TQ]
+    p = jnp.exp(s - m[:, None])
+    if causal:
+        p = jnp.where(allowed, p, 0.0)
+    l = jnp.sum(p, axis=-1)                       # [TQ]
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    o_ref[0] = o
+    # m/l carry a size-8 lane dim purely for TPU tiling (sublane x lane
+    # constraints); consumers read lane 0.
+    m_ref[0] = jnp.broadcast_to(m[:, None], (tq, 8))
+    l_ref[0] = jnp.broadcast_to(l[:, None], (tq, 8))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
+                interpret: bool = False):
+    """Attention partials of q against one K/V block.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]; q_off/k_off: scalar global
+    positions of element 0 (for causal masking across ring steps).
+    Returns (o, m, l): [B, Sq, H, D] f32 unnormalized output and [B, Sq, H]
+    f32 row max / row sum. Final output = o / l after merging blocks.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    tq = _q_tile(Sq)
+
+    def bhsd(x):  # [B, S, H, D] -> [B*H, S, D]
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    offs = jnp.asarray([q_off, k_off], jnp.int32)
+    grid = (B * H, Sq // tq)
+    kernel = functools.partial(_kernel, causal=causal, scale=scale)
+    out_shape = (
+        jax.ShapeDtypeStruct((B * H, Sq, D), jnp.float32),
+        jax.ShapeDtypeStruct((B * H, Sq, 8), jnp.float32),
+        jax.ShapeDtypeStruct((B * H, Sq, 8), jnp.float32),
+    )
+    if _HAVE_PLTPU:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, tq, D), lambda bh, qi, offs: (bh, qi, 0)),
+                pl.BlockSpec((1, Sk, D), lambda bh, qi, offs: (bh, 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda bh, qi, offs: (bh, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tq, D), lambda bh, qi, offs: (bh, qi, 0)),
+                pl.BlockSpec((1, tq, 8), lambda bh, qi, offs: (bh, qi, 0)),
+                pl.BlockSpec((1, tq, 8), lambda bh, qi, offs: (bh, qi, 0)),
+            ],
+        )
+        o, m, l = pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape,
+            interpret=interpret,
+        )(offs, bhsd(q), bhsd(k), bhsd(v))
+    else:  # pragma: no cover - pltpu always importable in this image
+        raise RuntimeError("pallas TPU backend unavailable")
+
+    def sbhd(x):  # [B*H, Sq, C] -> [B, Sq, H, C]
+        return x.reshape((B, H) + x.shape[1:]).transpose(0, 2, 1, 3)
+
+    return sbhd(o), sbhd(m)[..., 0], sbhd(l)[..., 0]
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    interpret: bool = False):
+    """Single-device flash attention over [B, S, H, D] (normalized output)."""
+    o, m, l = flash_block(q, k, v, 0, 0, causal=causal, interpret=interpret)
+    return (o / l[..., None]).astype(q.dtype)

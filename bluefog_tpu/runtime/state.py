@@ -73,6 +73,37 @@ def _global_state() -> BluefogTPUState:
     return _state
 
 
+_distributed_initialized = False
+
+
+def _maybe_init_distributed() -> None:
+    """Join the multi-host job when the launcher exported coordinator env.
+
+    The analog of the reference's MPI_Init across ranks (operations.cc
+    :1165-1182): ``bfrun -np K --coordinator host:port --process-id i``
+    exports JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID
+    (launcher.py), and jax.distributed stitches the hosts into one global
+    device set. On TPU pods with the runtime's own metadata, argument-free
+    initialize() also works; we only force it when the env is present so
+    single-host usage stays zero-config.
+    """
+    global _distributed_initialized
+    import os
+
+    if _distributed_initialized or "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return
+    jax.distributed.initialize(
+        coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+    _distributed_initialized = True
+    logger.info(
+        "joined distributed job: process %d/%d",
+        jax.process_index(), jax.process_count(),
+    )
+
+
 def init(
     topology_fn=None,
     is_weighted: bool = False,
@@ -102,6 +133,7 @@ def init(
     for knob in st.config.ignored_set:
         logger.info("env %s has no effect on TPU (transport is XLA-managed)", knob)
 
+    _maybe_init_distributed()
     st.devices = list(devices if devices is not None else jax.devices())
     st.size = len(st.devices)
     if local_size:
