@@ -25,6 +25,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _NEG = jnp.float32(-1e30)
 
+if hasattr(lax, "pcast"):
+    def _pvary(x, axes):
+        return lax.pcast(x, axes, to="varying")
+else:  # jax < 0.9: pcast absent, pvary not yet deprecated
+    def _pvary(x, axes):
+        return lax.pvary(x, axes)
+
 
 def reference_attention(q, k, v, causal: bool = False):
     """Dense single-device attention; the correctness oracle for the tests."""
@@ -93,9 +100,9 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
 
     # pvary: the accumulators are device-varying from step 0 (shard_map's
     # varying-manual-axes check requires carry types to match body outputs).
-    o0 = lax.pvary(jnp.zeros((B, Sq, H, D), jnp.float32), (axis_name,))
-    m0 = lax.pvary(jnp.full((B, H, Sq), _NEG, jnp.float32), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((B, H, Sq), jnp.float32), (axis_name,))
+    o0 = _pvary(jnp.zeros((B, Sq, H, D), jnp.float32), (axis_name,))
+    m0 = _pvary(jnp.full((B, H, Sq), _NEG, jnp.float32), (axis_name,))
+    l0 = _pvary(jnp.zeros((B, H, Sq), jnp.float32), (axis_name,))
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
     out = o / jnp.moveaxis(l, 1, -1)[..., None]
     return out.astype(q.dtype)
@@ -127,9 +134,9 @@ def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool,
         vc = lax.ppermute(vc, axis_name, perm)
         return o_new, m_new, l_new, kc, vc
 
-    o0 = lax.pvary(jnp.zeros((B, Sq, H, D), jnp.float32), (axis_name,))
-    m0 = lax.pvary(jnp.full((B, Sq, H), _NEG, jnp.float32), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((B, Sq, H), jnp.float32), (axis_name,))
+    o0 = _pvary(jnp.zeros((B, Sq, H, D), jnp.float32), (axis_name,))
+    m0 = _pvary(jnp.full((B, Sq, H), _NEG, jnp.float32), (axis_name,))
+    l0 = _pvary(jnp.zeros((B, Sq, H), jnp.float32), (axis_name,))
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
     return (o / l[..., None]).astype(q.dtype)
 
