@@ -185,8 +185,12 @@ def barrier(name: Optional[str] = None) -> None:
 
     The reference implements barrier as a tiny allreduce unless negotiation
     is skipped (mpi_ops.py:872-881); on TPU a psum across the mesh plus a
-    host block gives the same guarantee.
+    host block gives the same guarantee. Multi-controller jobs additionally
+    rendezvous all controller processes through the control plane's named
+    barrier (runtime/control_plane.py).
     """
+    from ..runtime import control_plane as _cp
+
     st = _global_state()
     st.check_initialized()
     token = jnp.zeros((st.size, 1), jnp.float32)
@@ -196,6 +200,7 @@ def barrier(name: Optional[str] = None) -> None:
 
     out = _smap(st, body, (token,))
     jax.block_until_ready(out)
+    _cp.barrier(name or "bf.barrier")
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,7 @@ the original structure. Both are jit-friendly (static shapes from the spec).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, List, NamedTuple, Sequence, Tuple
 
 import numpy as np
@@ -74,3 +75,54 @@ def unpack(buffer, spec: PackSpec):
         chunk = jax.lax.dynamic_slice_in_dim(buffer, off, size, axis=1)
         leaves.append(chunk.reshape((n,) + shape).astype(dtype))
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+@functools.lru_cache(maxsize=512)
+def _pack_compiled(spec: PackSpec):
+    return jax.jit(lambda tree: pack(tree, spec))
+
+
+@functools.lru_cache(maxsize=512)
+def _unpack_compiled(spec: PackSpec):
+    return jax.jit(lambda buf: unpack(buf, spec))
+
+
+def pack_jit(tree, spec: PackSpec):
+    """``pack`` through a per-spec cached jit (one program per buffer shape)."""
+    return _pack_compiled(spec)(tree)
+
+
+def unpack_jit(buffer, spec: PackSpec):
+    return _unpack_compiled(spec)(buffer)
+
+
+def group_leaves(leaves: Sequence, threshold_bytes: int,
+                 rank_stacked: bool = True) -> List[List[int]]:
+    """Greedy in-order batching of leaf indices into fusion groups.
+
+    The analog of the reference's fusion buffer policy: consecutive tensors
+    share one exchange buffer up to ``tensor_fusion_threshold`` bytes
+    (tensor_queue.cc:127-155; fused layout mpi_controller.cc:604-609). The
+    threshold counts PER-RANK bytes (the reference's buffer is per process),
+    so ``rank_stacked`` leaves drop their leading rank dim from the tally.
+    ``threshold_bytes <= 0`` disables fusion (one leaf per group). Groups
+    never mix dtypes — packing would silently promote.
+    """
+    if threshold_bytes <= 0:
+        return [[i] for i in range(len(leaves))]
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i, leaf in enumerate(leaves):
+        shape = leaf.shape[1:] if rank_stacked else leaf.shape
+        b = int(np.prod(shape)) * jnp.dtype(leaf.dtype).itemsize
+        if cur and (cur_bytes + b > threshold_bytes or leaf.dtype != cur_dtype):
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += b
+        cur_dtype = leaf.dtype
+    if cur:
+        groups.append(cur)
+    return groups

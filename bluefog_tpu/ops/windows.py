@@ -46,12 +46,175 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import topology as topology_util
+from ..runtime import control_plane as _cp
 from ..runtime import handles as _handles
 from ..runtime.state import _global_state
 from ..runtime.timeline import timeline_context
 from .neighbors import _auto_name, _check_rank_stacked, _per_rank
 
 Weights = Union[float, Dict[int, float], Dict[int, Dict[int, float]]]
+
+
+class _LocalWinHost:
+    """Controller-local scalar state: versions, push-sum p, rank mutexes.
+
+    Single-controller deployments keep the reference's cross-process
+    protocols (version windows mpi_controller.cc:1281-1393, fetch-and-op
+    mutexes mpi_controller.cc:1532-1602) as plain host memory — every rank
+    lives in this process, so process-local IS globally consistent.
+    """
+
+    def __init__(self, name: str, n: int, d_max: int) -> None:
+        self.n = n
+        self.d_max = d_max
+        self.version = np.zeros((n, d_max), np.int64)
+        self.p = np.ones(n, np.float64)
+        self.p_mail = np.zeros((n, d_max), np.float64)
+        self.mutexes = [threading.RLock() for _ in range(n)]
+
+    def bump_version(self, dst: int, k: int) -> None:
+        self.version[dst, k] += 1
+
+    def reset_versions(self, pairs) -> None:
+        for dst, k in pairs:
+            self.version[dst, k] = 0
+
+    def get_version(self, dst: int, k: int) -> int:
+        return int(self.version[dst, k])
+
+    def read_p(self) -> np.ndarray:
+        return self.p.copy()
+
+    def write_p(self, values: np.ndarray) -> None:
+        self.p = np.asarray(values, np.float64).copy()
+
+    def read_p_mail(self) -> np.ndarray:
+        return self.p_mail.copy()
+
+    def write_p_mail(self, values: np.ndarray) -> None:
+        self.p_mail = np.asarray(values, np.float64).copy()
+
+    def add_p_mail(self, dst: int, k: int, v: float) -> None:
+        self.p_mail[dst, k] += v
+
+    def set_p_mail(self, dst: int, k: int, v: float) -> None:
+        self.p_mail[dst, k] = v
+
+    def mutex_acquire(self, rank: int) -> None:
+        self.mutexes[rank].acquire()
+
+    def mutex_release(self, rank: int) -> None:
+        self.mutexes[rank].release()
+
+    def op_mutex_ranks(self, touched) -> List[int]:
+        """Which of the touched ranks' mutexes THIS controller takes for an op."""
+        return sorted(set(touched))
+
+    def flush(self) -> None:
+        pass
+
+
+class _ControlPlaneWinHost:
+    """Shared scalar state over the native TCP control plane.
+
+    Multi-controller deployments (one process per host) keep window versions,
+    push-sum p scalars, and rank mutexes in the job-wide control-plane server
+    (csrc/bf_runtime.cc) — the analog of the reference's MPI RMA windows for
+    these scalars. Writes are ownership-partitioned: only the controller
+    hosting rank r's shard writes r's scalars (all controllers execute the
+    same SPMD op sequence, so owner-writes gives exactly-once updates);
+    ``flush`` barriers all controllers so reads after an op are consistent.
+    """
+
+    def __init__(self, name: str, n: int, d_max: int, owned: Sequence[int]) -> None:
+        self.n = n
+        self.d_max = d_max
+        self.owned = set(owned)
+        self._cl = _cp.client()
+        self._pre = f"w.{name}"
+        # The server lock is re-entrant per client rank but NOT
+        # recursion-counted (first unlock fully releases, csrc/bf_runtime.cc
+        # kUnlock). Count recursion locally so a require_mutex op nested in a
+        # user win_mutex cannot release the user's lock mid-context.
+        self._mu_depth: Dict[int, int] = {}
+        self._mu_depth_lock = threading.Lock()
+        for dst in self.owned:
+            _cp.put_float(self._cl, f"{self._pre}.p.{dst}", 1.0)
+            for k in range(d_max):
+                self._cl.put(f"{self._pre}.v.{dst}.{k}", 0)
+                _cp.put_float(self._cl, f"{self._pre}.m.{dst}.{k}", 0.0)
+        self.flush()
+
+    def bump_version(self, dst: int, k: int) -> None:
+        if dst in self.owned:
+            self._cl.fetch_add(f"{self._pre}.v.{dst}.{k}", 1)
+
+    def reset_versions(self, pairs) -> None:
+        for dst, k in pairs:
+            if dst in self.owned:
+                self._cl.put(f"{self._pre}.v.{dst}.{k}", 0)
+
+    def get_version(self, dst: int, k: int) -> int:
+        return int(self._cl.get(f"{self._pre}.v.{dst}.{k}"))
+
+    def read_p(self) -> np.ndarray:
+        return np.array([
+            _cp.get_float(self._cl, f"{self._pre}.p.{r}") for r in range(self.n)
+        ])
+
+    def write_p(self, values: np.ndarray) -> None:
+        for r in self.owned:
+            _cp.put_float(self._cl, f"{self._pre}.p.{r}", float(values[r]))
+
+    def read_p_mail(self) -> np.ndarray:
+        out = np.zeros((self.n, self.d_max), np.float64)
+        for r in range(self.n):
+            for k in range(self.d_max):
+                out[r, k] = _cp.get_float(self._cl, f"{self._pre}.m.{r}.{k}")
+        return out
+
+    def write_p_mail(self, values: np.ndarray) -> None:
+        for r in self.owned:
+            for k in range(self.d_max):
+                _cp.put_float(self._cl, f"{self._pre}.m.{r}.{k}",
+                              float(values[r, k]))
+
+    def add_p_mail(self, dst: int, k: int, v: float) -> None:
+        if dst in self.owned:
+            key = f"{self._pre}.m.{dst}.{k}"
+            _cp.put_float(self._cl, key, _cp.get_float(self._cl, key) + v)
+
+    def set_p_mail(self, dst: int, k: int, v: float) -> None:
+        if dst in self.owned:
+            _cp.put_float(self._cl, f"{self._pre}.m.{dst}.{k}", v)
+
+    def mutex_acquire(self, rank: int) -> None:
+        with self._mu_depth_lock:
+            depth = self._mu_depth.get(rank, 0)
+            self._mu_depth[rank] = depth + 1
+            if depth > 0:
+                return  # server lock already held by this controller
+        self._cl.lock(f"{self._pre}.mu.{rank}")
+
+    def mutex_release(self, rank: int) -> None:
+        with self._mu_depth_lock:
+            depth = self._mu_depth.get(rank, 0) - 1
+            if depth < 0:
+                raise RuntimeError(f"mutex for rank {rank} released more "
+                                   "times than acquired")
+            self._mu_depth[rank] = depth
+            if depth > 0:
+                return
+        self._cl.unlock(f"{self._pre}.mu.{rank}")
+
+    def op_mutex_ranks(self, touched) -> List[int]:
+        # Owner-partitioned: each controller locks only the touched ranks it
+        # owns. Owned sets are disjoint, so the collective op cannot deadlock
+        # between controllers, yet an external mutex holder still excludes it.
+        return sorted(set(touched) & self.owned)
+
+    def flush(self) -> None:
+        _cp.barrier(self._pre)
 
 
 def _win_acc_dtype(dtype):
@@ -133,11 +296,15 @@ class Window:
                 tensor[:, None], (st.size, d) + tensor.shape[1:]
             ).astype(mail_dtype)
         self.mail = jax.device_put(mail, sh)
-        self.version = np.zeros((st.size, d), np.int64)
-        # associated-p scalars (push-sum weights) — host numpy mirror
-        self.p = np.ones(st.size, dtype=np.float64)
-        self.p_mail = np.zeros((st.size, d), dtype=np.float64)
-        self.mutexes = [threading.RLock() for _ in range(st.size)]
+        # Scalar protocols (versions / push-sum p / mutexes): controller-local
+        # host memory, or the job-wide control plane when one is attached
+        # (multi-controller; reference mpi_controller.cc:1281-1393, 1532-1602).
+        if _cp.active():
+            owned = _cp.owned_ranks(st.devices, jax.process_index())
+            self.host = _ControlPlaneWinHost(name, st.size, self.layout.d_max,
+                                             owned)
+        else:
+            self.host = _LocalWinHost(name, st.size, self.layout.d_max)
         # Serializes the whole-array read-modify-write of mail/self_value:
         # ops touching disjoint edges hold disjoint rank mutexes yet still
         # reassign the same arrays, so every op takes this lock around its
@@ -291,28 +458,29 @@ def _bump_host_state(win: Window, table: Dict[int, Dict[int, float]],
                      accumulate: bool) -> None:
     """Mirror version counters and associated-p scalars for touched edges."""
     st = _global_state()
+    p = win.host.read_p() if st.win_ops_with_associated_p else None
     for src in range(win.size):
         for dst, wt in table[src].items():
             k = win.layout.slot_of[dst][src]
-            win.version[dst, k] += 1
+            win.host.bump_version(dst, k)
             if st.win_ops_with_associated_p:
-                contrib = win.p[src] * wt
+                contrib = p[src] * wt
                 if accumulate:
-                    win.p_mail[dst, k] += contrib
+                    win.host.add_p_mail(dst, k, contrib)
                 else:
-                    win.p_mail[dst, k] = contrib
+                    win.host.set_p_mail(dst, k, contrib)
 
 
 def _acquire(win: Window, ranks, require_mutex: bool):
     if require_mutex:
-        for r in sorted(set(ranks)):
-            win.mutexes[r].acquire()
+        for r in win.host.op_mutex_ranks(ranks):
+            win.host.mutex_acquire(r)
 
 
 def _release(win: Window, ranks, require_mutex: bool):
     if require_mutex:
-        for r in sorted(set(ranks), reverse=True):
-            win.mutexes[r].release()
+        for r in reversed(win.host.op_mutex_ranks(ranks)):
+            win.host.mutex_release(r)
 
 
 # ---------------------------------------------------------------------------
@@ -376,8 +544,14 @@ def _do_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 win.self_value = new_self
             win.mail = new_mail
             _bump_host_state(win, table, accumulate)
+            # Barrier between the mailbox p-contributions (which read OTHER
+            # ranks' pre-scale p) and the owner rescale of p below: without
+            # it a fast controller could rescale before a slow one reads.
+            win.host.flush()
             if st.win_ops_with_associated_p and not from_get:
-                win.p = win.p * np.asarray(sw_list, np.float64)
+                win.host.write_p(
+                    win.host.read_p() * np.asarray(sw_list, np.float64))
+                win.host.flush()
     finally:
         _release(win, touched, require_mutex)
     return _handles.allocate(f"{activity.lower()}.{win.name}", win.self_value)
@@ -536,19 +710,22 @@ def win_update(
                 jnp.asarray(sw_list, jnp.float32), jnp.asarray(nw),
                 jnp.asarray(read_mask if reset else np.zeros_like(read_mask)))
             if st.win_ops_with_associated_p:
-                new_p = np.asarray(sw_list, np.float64) * win.p + np.sum(
-                    nw.astype(np.float64) * win.p_mail, axis=1)
+                p_mail = win.host.read_p_mail()
+                new_p = np.asarray(sw_list, np.float64) * win.host.read_p() + \
+                    np.sum(nw.astype(np.float64) * p_mail, axis=1)
             # versions of read buffers reset; optionally clear the buffers
-            for r, wmap in nw_table.items():
-                for src in wmap:
-                    win.version[r, lay.slot_of[r][src]] = 0
+            win.host.reset_versions(
+                (r, lay.slot_of[r][src])
+                for r, wmap in nw_table.items() for src in wmap)
             win.mail = new_mail
             if reset and st.win_ops_with_associated_p:
-                win.p_mail = win.p_mail * (1.0 - read_mask.astype(np.float64))
+                win.host.write_p_mail(
+                    p_mail * (1.0 - read_mask.astype(np.float64)))
             if not clone:
                 win.self_value = result
                 if st.win_ops_with_associated_p:
-                    win.p = new_p
+                    win.host.write_p(new_p)
+            win.host.flush()
         finally:
             win.state_mu.release()
             _release(win, range(n), require_mutex)
@@ -589,7 +766,7 @@ def get_win_version(name: str, rank: Optional[int] = None) -> Dict[int, int]:
     win = _get_window(name)
     r = 0 if rank is None else rank
     return {
-        src: int(win.version[r, win.layout.slot_of[r][src]])
+        src: win.host.get_version(r, win.layout.slot_of[r][src])
         for src in win.in_neighbors[r]
     }
 
@@ -603,19 +780,22 @@ class win_mutex:
 
     def __init__(self, name: str, for_self: bool = False,
                  ranks: Optional[Sequence[int]] = None, rank: int = 0) -> None:
-        win = _get_window(name)
+        self._win = _get_window(name)
         if ranks is None:
-            ranks = [rank] if for_self else win.out_neighbors[rank]
-        self._locks = [win.mutexes[r] for r in sorted(set(ranks))]
+            ranks = [rank] if for_self else self._win.out_neighbors[rank]
+        # Explicit user request: take exactly these ranks' locks (even ones
+        # another controller owns — this is how an external actor excludes
+        # the collective window ops on those ranks).
+        self._ranks = sorted(set(ranks))
 
     def __enter__(self):
-        for lock in self._locks:
-            lock.acquire()
+        for r in self._ranks:
+            self._win.host.mutex_acquire(r)
         return self
 
     def __exit__(self, *exc):
-        for lock in reversed(self._locks):
-            lock.release()
+        for r in reversed(self._ranks):
+            self._win.host.mutex_release(r)
         return False
 
 
@@ -639,13 +819,11 @@ class win_lock:
 def win_associated_p(name: str, rank: Optional[int] = None) -> float:
     """The push-sum correction scalar p for ``rank`` (init 1.0)."""
     win = _get_window(name)
-    if rank is None:
-        return float(win.p[0])
-    return float(win.p[rank])
+    return float(win.host.read_p()[0 if rank is None else rank])
 
 
 def win_associated_p_all(name: str) -> np.ndarray:
-    return np.array(_get_window(name).p)
+    return _get_window(name).host.read_p()
 
 
 def turn_on_win_ops_with_associated_p() -> None:

@@ -1,0 +1,189 @@
+"""Process-global control plane: distributed scalar state for windows.
+
+The reference implements cross-process window mutexes as MPI_Fetch_and_op
+spin-locks (reference: mpi_controller.cc:1532-1602) and per-edge version
+counters as MPI RMA "version windows" (mpi_controller.cc:1281-1393). On TPU
+those small-scalar protocols ride the native TCP control plane
+(csrc/bf_runtime.cc) instead of MPI RMA: one server per job, one client per
+controller process.
+
+Activation:
+  * multi-controller jobs (``jax.process_count() > 1``): process 0 serves on
+    ``BLUEFOG_CP_PORT`` (default: coordinator port + 17) and every process
+    connects to the coordinator host. Wired automatically by ``bf.init``.
+  * forced: set ``BLUEFOG_CP_HOST``/``BLUEFOG_CP_PORT`` (tests, external
+    actors). ``BLUEFOG_CP_DISABLE=1`` turns the subsystem off entirely —
+    window scalar state then stays controller-local.
+
+Ownership: every window rank is owned by exactly one controller process (the
+process whose devices host that rank's shard). Only the owner WRITES that
+rank's scalars to the shared KV; every process READS from it. Since all
+controllers execute the same SPMD op sequence, this gives exactly-once
+update semantics without compare-and-swap loops.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from typing import List, Optional
+
+from .logging import logger
+from .native import ControlPlaneClient, ControlPlaneServer
+
+_mu = threading.Lock()
+_client: Optional[ControlPlaneClient] = None
+_server: Optional[ControlPlaneServer] = None
+_world: int = 1
+_tried = False
+
+
+def _env_port(default: Optional[int] = None) -> Optional[int]:
+    v = os.environ.get("BLUEFOG_CP_PORT")
+    return int(v) if v else default
+
+
+def _distributed_client_info():
+    """(coordinator_address, num_processes, process_id) from a live
+    jax.distributed client, or (None, 1, 0). Internal-API probe: pods that
+    called argument-free ``jax.distributed.initialize()`` are multi-process
+    without any env set."""
+    try:
+        import jax
+        from jax._src import distributed as _jd
+
+        state = _jd.global_state
+        if state.client is not None and state.coordinator_address:
+            return (state.coordinator_address, jax.process_count(),
+                    jax.process_index())
+    except Exception:  # noqa: BLE001 — internal layout may change by version
+        pass
+    return None, 1, 0
+
+
+def attach() -> Optional[ControlPlaneClient]:
+    """Connect (starting the server if this is process 0) when configured.
+
+    Returns the process-global client, or None when the control plane is
+    not configured / disabled / the native runtime is unavailable.
+    """
+    global _client, _server, _world, _tried
+    with _mu:
+        if _client is not None or _tried:
+            return _client
+        _tried = True
+        if os.environ.get("BLUEFOG_CP_DISABLE") == "1":
+            return None
+
+        host = os.environ.get("BLUEFOG_CP_HOST")
+        port = _env_port()
+        rank = int(os.environ.get("BLUEFOG_CP_RANK", "0"))
+        world = int(os.environ.get("BLUEFOG_CP_WORLD", "0"))
+
+        if host is None:
+            # Automatic multi-controller wiring: prefer the launcher's env,
+            # fall back to the live jax.distributed client (pods initialized
+            # with argument-free jax.distributed.initialize() have
+            # process_count > 1 without the env being set).
+            coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+            nproc = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+            pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+            if coord is None or nproc <= 1:
+                coord, nproc, pid = _distributed_client_info()
+            if coord is None or nproc <= 1:
+                return None
+            chost, _, cport = coord.partition(":")
+            host = chost
+            port = port or int(cport) + 17
+            rank = pid
+            world = nproc
+        if port is None or world <= 0:
+            logger.warning("control plane env incomplete; staying local")
+            return None
+
+        if rank == 0 and os.environ.get("BLUEFOG_CP_SERVE", "1") != "0":
+            try:
+                _server = ControlPlaneServer(world, port)
+            except (OSError, RuntimeError) as exc:
+                # Another actor (launcher, tests) may already serve this port.
+                logger.debug("control plane server not started here (%s)", exc)
+                _server = None
+
+        deadline = time.monotonic() + float(
+            os.environ.get("BLUEFOG_CP_CONNECT_TIMEOUT", "30"))
+        last: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                _client = ControlPlaneClient(host, port, rank)
+                break
+            except (OSError, RuntimeError) as exc:
+                last = exc
+                time.sleep(0.2)
+        if _client is None:
+            logger.warning("control plane connect failed (%s); staying local", last)
+            if _server is not None:
+                _server.stop()
+                _server = None
+            return None
+        _world = world
+        logger.info("control plane attached: %s:%d rank=%d world=%d",
+                    host, port, rank, world)
+        return _client
+
+
+def active() -> bool:
+    return _client is not None
+
+
+def client() -> ControlPlaneClient:
+    if _client is None:
+        raise RuntimeError("control plane is not attached")
+    return _client
+
+
+def world() -> int:
+    return _world
+
+
+def detach() -> None:
+    """Close the client (and server, when owned). Safe to call repeatedly."""
+    global _client, _server, _tried, _world
+    with _mu:
+        if _client is not None:
+            _client.close()
+            _client = None
+        if _server is not None:
+            _server.stop()
+            _server = None
+        _tried = False
+        _world = 1
+
+
+def reset_for_test() -> None:
+    """Forget the cached attach decision so tests can re-configure the env."""
+    detach()
+
+
+def barrier(name: str = "default") -> None:
+    if _client is not None:
+        _client.barrier(name)
+
+
+# -- float scalars over the int64 KV (IEEE754 bit-packing) ------------------
+
+def put_float(cl: ControlPlaneClient, key: str, value: float) -> None:
+    cl.put(key, struct.unpack("<q", struct.pack("<d", float(value)))[0])
+
+
+def get_float(cl: ControlPlaneClient, key: str) -> float:
+    return struct.unpack("<d", struct.pack("<q", cl.get(key)))[0]
+
+
+def owned_ranks(devices, process_index: int) -> List[int]:
+    """Ranks whose device shard this controller hosts."""
+    return [
+        r for r, d in enumerate(devices)
+        if getattr(d, "process_index", 0) == process_index
+    ]

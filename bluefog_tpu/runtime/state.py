@@ -134,6 +134,11 @@ def init(
         logger.info("env %s has no effect on TPU (transport is XLA-managed)", knob)
 
     _maybe_init_distributed()
+    # Multi-controller scalar coordination (window mutexes/versions/p,
+    # cross-controller barrier). No-op unless the job is multi-process or
+    # BLUEFOG_CP_HOST is set (runtime/control_plane.py).
+    from . import control_plane as _cp
+    _cp.attach()
     st.devices = list(devices if devices is not None else jax.devices())
     st.size = len(st.devices)
     if local_size:
@@ -201,6 +206,8 @@ def shutdown() -> None:
     st = _state
     if not st.initialized:
         return
+    from . import control_plane as _cp
+    _cp.detach()
     if st.watchdog is not None:
         st.watchdog.stop()
         st.watchdog = None

@@ -323,24 +323,58 @@ struct ControlClient {
   int rank = 0;
   std::mutex mu;
 
-  int64_t Call(uint8_t op, const std::string& key, int64_t arg) {
-    std::lock_guard<std::mutex> lk(mu);
+  void Encode(std::vector<char>* buf, uint8_t op, const std::string& key,
+              int64_t arg) {
     uint16_t klen = static_cast<uint16_t>(key.size());
     uint32_t len = 1 + 4 + 2 + klen + 8;
-    std::vector<char> buf(4 + len);
-    std::memcpy(buf.data(), &len, 4);
-    buf[4] = static_cast<char>(op);
-    std::memcpy(buf.data() + 5, &rank, 4);
-    std::memcpy(buf.data() + 9, &klen, 2);
-    std::memcpy(buf.data() + 11, key.data(), klen);
-    std::memcpy(buf.data() + 11 + klen, &arg, 8);
-    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+    size_t base = buf->size();
+    buf->resize(base + 4 + len);
+    std::memcpy(buf->data() + base, &len, 4);
+    (*buf)[base + 4] = static_cast<char>(op);
+    std::memcpy(buf->data() + base + 5, &rank, 4);
+    std::memcpy(buf->data() + base + 9, &klen, 2);
+    std::memcpy(buf->data() + base + 11, key.data(), klen);
+    std::memcpy(buf->data() + base + 11 + klen, &arg, 8);
+  }
+
+  bool ReadReply(int64_t* reply) {
     uint32_t rlen;
+    if (!ControlServer::ReadAll(fd, &rlen, 4)) return false;
+    if (rlen != 8) return false;
+    return ControlServer::ReadAll(fd, reply, 8);
+  }
+
+  int64_t Call(uint8_t op, const std::string& key, int64_t arg) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<char> buf;
+    Encode(&buf, op, key, arg);
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
     int64_t reply;
-    if (!ControlServer::ReadAll(fd, &rlen, 4)) return -1;
-    if (rlen != 8) return -1;
-    if (!ControlServer::ReadAll(fd, &reply, 8)) return -1;
+    if (!ReadReply(&reply)) return -1;
     return reply;
+  }
+
+  // Pipelined batch: send every request, then drain every reply. The server
+  // handles one connection sequentially, so replies arrive in order; this
+  // turns n key operations into one round-trip's worth of latency.
+  int64_t CallMulti(uint8_t op, const char* keys_nl, const int64_t* args,
+                    int64_t* out, int n) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<char> buf;
+    const char* p = keys_nl;
+    for (int i = 0; i < n; ++i) {
+      const char* e = std::strchr(p, '\n');
+      std::string key = e ? std::string(p, e - p) : std::string(p);
+      Encode(&buf, op, key, args ? args[i] : 0);
+      p = e ? e + 1 : p + key.size();
+    }
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+    for (int i = 0; i < n; ++i) {
+      int64_t reply;
+      if (!ReadReply(&reply)) return -1;
+      if (out) out[i] = reply;
+    }
+    return n;
   }
 };
 
