@@ -11,6 +11,7 @@ ICI links — there is no vendor routing (BLUEFOG_*_BY_MPI) to configure.
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Optional, Sequence, Union
 
 import numpy as np
@@ -26,24 +27,30 @@ from ..runtime.timeline import timeline_context
 from .neighbors import _auto_name, _check_rank_stacked
 
 
-def _smap(st, fn, leaves, hierarchical: bool = False):
-    if hierarchical:
-        mesh = st.machine_mesh
-        spec = P(("machine", "local"))
-    else:
-        mesh = st.mesh
-        spec = P("rank")
-    mapped = jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=tuple(spec for _ in leaves),
-        out_specs=tuple(spec for _ in leaves),
-    )
-    return jax.jit(mapped)(*leaves)
+def _jit_smap(mesh, spec, body):
+    """jit-wrapped shard_map over a variable-length tuple of leaves.
+
+    The returned callable has stable identity, so jax's jit cache is actually
+    hit on repeat calls — building ``jax.jit(shard_map(...))`` inline per op
+    call would re-trace and re-lower the program every single time (~0.5 s of
+    host overhead per collective on the CPU mesh). Every op below routes
+    through an ``lru_cache``d builder keyed by its static parameters.
+    """
+
+    def call(leaves):
+        mapped = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(spec for _ in leaves),
+            out_specs=tuple(spec for _ in leaves),
+        )
+        return mapped(*leaves)
+
+    return jax.jit(call)
 
 
-def _tree_op(st, tensor, fn, hierarchical: bool = False):
+def _tree_op(fn, tensor):
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
-    outs = _smap(st, fn, leaves, hierarchical)
+    outs = fn(tuple(leaves))
     return jax.tree_util.tree_unflatten(treedef, list(outs))
 
 
@@ -81,7 +88,17 @@ def allreduce_nonblocking(
     if is_hierarchical_local and st.machine_mesh is None:
         raise RuntimeError("hierarchical-local allreduce needs a homogeneous layout")
 
-    axis = "local" if is_hierarchical_local else "rank"
+    mesh = st.machine_mesh if is_hierarchical_local else st.mesh
+    with timeline_context(op_name, "ALLREDUCE"):
+        out = _tree_op(
+            _allreduce_fn(mesh, average, is_hierarchical_local), tensor)
+    return _handles.allocate(op_name, out)
+
+
+@functools.lru_cache(maxsize=64)
+def _allreduce_fn(mesh, average: bool, hierarchical: bool):
+    axis = "local" if hierarchical else "rank"
+    spec = P(("machine", "local")) if hierarchical else P("rank")
 
     def body(*xs):
         outs = []
@@ -92,9 +109,7 @@ def allreduce_nonblocking(
             outs.append(red.astype(x.dtype))
         return tuple(outs)
 
-    with timeline_context(op_name, "ALLREDUCE"):
-        out = _tree_op(st, tensor, body, hierarchical=is_hierarchical_local)
-    return _handles.allocate(op_name, out)
+    return _jit_smap(mesh, spec, body)
 
 
 # ---------------------------------------------------------------------------
@@ -114,6 +129,13 @@ def broadcast_nonblocking(tensor, root_rank: int, name: Optional[str] = None) ->
     if not 0 <= root_rank < st.size:
         raise ValueError(f"root_rank {root_rank} out of range [0, {st.size})")
 
+    with timeline_context(op_name, "BROADCAST"):
+        out = _tree_op(_broadcast_fn(st.mesh, root_rank), tensor)
+    return _handles.allocate(op_name, out)
+
+
+@functools.lru_cache(maxsize=64)
+def _broadcast_fn(mesh, root_rank: int):
     def body(*xs):
         me = lax.axis_index("rank")
         outs = []
@@ -122,9 +144,7 @@ def broadcast_nonblocking(tensor, root_rank: int, name: Optional[str] = None) ->
             outs.append(lax.psum(masked, "rank").astype(x.dtype))
         return tuple(outs)
 
-    with timeline_context(op_name, "BROADCAST"):
-        out = _tree_op(st, tensor, body)
-    return _handles.allocate(op_name, out)
+    return _jit_smap(mesh, P("rank"), body)
 
 
 # ---------------------------------------------------------------------------
@@ -148,6 +168,13 @@ def allgather_nonblocking(tensor, name: Optional[str] = None) -> int:
     op_name = _auto_name("allgather", name)
     _check_rank_stacked(tensor, st.size, "allgather")
 
+    with timeline_context(op_name, "ALLGATHER"):
+        out = _tree_op(_allgather_fn(st.mesh), tensor)
+    return _handles.allocate(op_name, out)
+
+
+@functools.lru_cache(maxsize=8)
+def _allgather_fn(mesh):
     def body(*xs):
         outs = []
         for x in xs:
@@ -156,9 +183,7 @@ def allgather_nonblocking(tensor, name: Optional[str] = None) -> int:
             outs.append(g)
         return tuple(outs)
 
-    with timeline_context(op_name, "ALLGATHER"):
-        out = _tree_op(st, tensor, body)
-    return _handles.allocate(op_name, out)
+    return _jit_smap(mesh, P("rank"), body)
 
 
 def allgather_v(tensors: Sequence, name: Optional[str] = None):
@@ -193,14 +218,21 @@ def barrier(name: Optional[str] = None) -> None:
 
     st = _global_state()
     st.check_initialized()
-    token = jnp.zeros((st.size, 1), jnp.float32)
+    # numpy, not jnp.zeros: an eager jnp constant would materialize on the
+    # DEFAULT device (possibly a different backend than the mesh) and force a
+    # cross-backend transfer into the compiled program on every call.
+    token = np.zeros((st.size, 1), np.float32)
+    out = _barrier_fn(st.mesh)((token,))
+    jax.block_until_ready(out)
+    _cp.barrier(name or "bf.barrier")
 
+
+@functools.lru_cache(maxsize=8)
+def _barrier_fn(mesh):
     def body(x):
         return (lax.psum(x, "rank"),)
 
-    out = _smap(st, body, (token,))
-    jax.block_until_ready(out)
-    _cp.barrier(name or "bf.barrier")
+    return _jit_smap(mesh, P("rank"), body)
 
 
 # ---------------------------------------------------------------------------
@@ -253,6 +285,17 @@ def pair_gossip_nonblocking(
                 f"rank {p} -> {peers[p]} (sendrecv semantics)"
             )
 
+    with timeline_context(op_name, "PAIR_GOSSIP"):
+        out = _tree_op(
+            _pair_gossip_fn(st.mesh, tuple(peers),
+                            float(self_weight), float(pair_weight)),
+            tensor,
+        )
+    return _handles.allocate(op_name, out)
+
+
+@functools.lru_cache(maxsize=128)
+def _pair_gossip_fn(mesh, peers: tuple, self_weight: float, pair_weight: float):
     perm = [(p, r) for r, p in enumerate(peers)]  # rank r receives from its peer
 
     def body(*xs):
@@ -262,6 +305,4 @@ def pair_gossip_nonblocking(
             outs.append((self_weight * x + pair_weight * recv).astype(x.dtype))
         return tuple(outs)
 
-    with timeline_context(op_name, "PAIR_GOSSIP"):
-        out = _tree_op(st, tensor, body)
-    return _handles.allocate(op_name, out)
+    return _jit_smap(mesh, P("rank"), body)

@@ -17,12 +17,15 @@ Weight semantics follow the reference exactly:
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from .. import topology as topology_util
 from ..runtime import handles as _handles
@@ -292,9 +295,6 @@ def hierarchical_neighbor_allreduce_nonblocking(
     enable_topo_check: bool = False,
     name: Optional[str] = None,
 ) -> int:
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
-
     st = _global_state()
     st.check_initialized()
     if st.machine_mesh is None:
@@ -330,12 +330,18 @@ def hierarchical_neighbor_allreduce_nonblocking(
         )
 
     plan = CombinePlan(Wm)
-    mesh = st.machine_mesh
-    shifts = plan.shifts
-    rows = jnp.asarray(plan.rows)
-    local_size = st.local_size
 
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    fn = _hierarchical_fn(st.machine_mesh, plan.shifts, plan.n)
+    with timeline_context(op_name, "HIERARCHICAL_NEIGHBOR_ALLREDUCE"):
+        outs = fn(plan.rows, tuple(leaves))
+    out = jax.tree_util.tree_unflatten(treedef, list(outs))
+    return _handles.allocate(op_name, out)
+
+
+@functools.lru_cache(maxsize=128)
+def _hierarchical_fn(mesh, shifts: tuple, n_machines: int):
+    """Cached local-pmean + machine-ppermute program (stable jit identity)."""
 
     def per_rank(w, *xs):
         mid = lax.axis_index("machine")
@@ -346,21 +352,21 @@ def hierarchical_neighbor_allreduce_nonblocking(
             xl = lax.pmean(x.astype(acc_t), "local")
             acc = wm[0].astype(acc_t) * xl
             for k, s in enumerate(shifts):
-                perm = [(i, (i + s) % plan.n) for i in range(plan.n)]
+                perm = [(i, (i + s) % n_machines) for i in range(n_machines)]
                 acc = acc + wm[k + 1].astype(acc_t) * lax.ppermute(xl, "machine", perm)
             outs.append(acc.astype(x.dtype))
         return tuple(outs)
 
-    mapped = jax.shard_map(
-        per_rank,
-        mesh=mesh,
-        in_specs=(P(),) + tuple(P(("machine", "local")) for _ in leaves),
-        out_specs=tuple(P(("machine", "local")) for _ in leaves),
-    )
-    with timeline_context(op_name, "HIERARCHICAL_NEIGHBOR_ALLREDUCE"):
-        outs = jax.jit(mapped)(rows, *leaves)
-    out = jax.tree_util.tree_unflatten(treedef, list(outs))
-    return _handles.allocate(op_name, out)
+    def call(w, leaves):
+        mapped = jax.shard_map(
+            per_rank,
+            mesh=mesh,
+            in_specs=(P(),) + tuple(P(("machine", "local")) for _ in leaves),
+            out_specs=tuple(P(("machine", "local")) for _ in leaves),
+        )
+        return mapped(w, *leaves)
+
+    return jax.jit(call)
 
 
 # ---------------------------------------------------------------------------
@@ -381,6 +387,49 @@ def neighbor_allgather(tensor, name: Optional[str] = None):
     return _handles.synchronize(handle)
 
 
+@functools.lru_cache(maxsize=128)
+def _gather_exchange_fn(mesh, shifts: tuple, n: int, d_max: int):
+    """Compiled in-neighbor exchange: one ppermute per shift, slot scatter.
+
+    Each rank receives one value per incoming circulant shift and writes it
+    into slot j of a [d_max, ...] buffer, where j is the source's position in
+    the rank's *sorted* in-neighbor list — the MPI_Dist_graph ordering the
+    reference guarantees (mpi_controller.cc:251-293) — so the later reshape
+    is exactly the sorted-neighbor concatenation. Slots with no neighbor
+    (irregular graphs, padded to d_max) stay zero and are sliced away by the
+    caller. The slot table is traced, so per-rank irregularity costs nothing
+    at compile time; shifts are static like every CombinePlan.
+    """
+
+    def per_rank(slot, *xs):
+        me = lax.axis_index("rank")
+        outs = []
+        for x in xs:
+            xb = x[0]
+            out = jnp.zeros((d_max,) + xb.shape, xb.dtype)
+            for si, s in enumerate(shifts):
+                perm = [(i, (i + s) % n) for i in range(n)]
+                moved = lax.ppermute(xb, "rank", perm)  # from (me - s) % n
+                k = slot[si, me]
+                kk = jnp.maximum(k, 0)
+                cur = lax.dynamic_index_in_dim(out, kk, 0, keepdims=False)
+                val = jnp.where(k >= 0, moved, cur)
+                out = lax.dynamic_update_index_in_dim(out, val, kk, axis=0)
+            outs.append(out[None])
+        return tuple(outs)
+
+    def call(slot, leaves):
+        mapped = jax.shard_map(
+            per_rank,
+            mesh=mesh,
+            in_specs=(P(),) + tuple(P("rank") for _ in leaves),
+            out_specs=tuple(P("rank") for _ in leaves),
+        )
+        return mapped(slot, *leaves)
+
+    return jax.jit(call)
+
+
 def neighbor_allgather_nonblocking(tensor, name: Optional[str] = None) -> int:
     st = _global_state()
     st.check_initialized()
@@ -395,22 +444,42 @@ def neighbor_allgather_nonblocking(tensor, name: Optional[str] = None) -> int:
             )
 
     n = st.size
-    indeg = [topology_util.in_neighbor_ranks(st.topology, r) for r in range(n)]
+    key = ("nag_layout", id(st.topology))
+    layout = st._plan_cache.get(key)
+    if layout is None:
+        # Same circulant shift/slot decomposition the window subsystem uses
+        # (one source of truth; windows._GraphLayout). -1 marks "no edge on
+        # this shift for this rank" for the exchange body's active check.
+        from .windows import _GraphLayout
+
+        lay = _GraphLayout(st.topology, n)
+        indeg = [lay.in_nbrs[r] for r in range(n)]
+        d_max = max((len(v) for v in indeg), default=0)
+        slot = np.where(lay.has_edge, lay.slot, -1).astype(np.int32)
+        layout = (indeg, d_max, lay.shifts, slot)
+        st._plan_cache[key] = layout
+    indeg, d_max, shifts, slot = layout
     regular = len({len(v) for v in indeg}) == 1
 
-    def gather_one(x):
-        # [n, b, ...] -> per-rank concat of neighbor slices.
-        if regular and indeg and len(indeg[0]) > 0:
-            idx = np.array(indeg)  # [n, d]
-            g = jnp.take(x, idx.reshape(-1), axis=0)  # [n*d, b, ...]
-            d = idx.shape[1]
-            return g.reshape((n, d * x.shape[1]) + x.shape[2:])
-        return [
-            jnp.concatenate([x[s] for s in indeg[r]], axis=0)
-            if indeg[r] else jnp.zeros((0,) + x.shape[2:], x.dtype)
-            for r in range(n)
-        ]
+    def finalize(padded, x):
+        # [n, d_max, b, ...] -> sorted-neighbor concat per rank.
+        flat = padded.reshape((n, d_max * x.shape[1]) + x.shape[2:])
+        if regular:
+            return flat
+        return [flat[r, : len(indeg[r]) * x.shape[1]] for r in range(n)]
 
     with timeline_context(op_name, "NEIGHBOR_ALLGATHER"):
-        out = jax.tree_util.tree_map(gather_one, tensor)
+        if d_max == 0:
+            out = jax.tree_util.tree_map(
+                lambda x: [jnp.zeros((0,) + x.shape[2:], x.dtype)
+                           for _ in range(n)],
+                tensor,
+            )
+        else:
+            leaves, treedef = jax.tree_util.tree_flatten(tensor)
+            fn = _gather_exchange_fn(st.mesh, shifts, n, d_max)
+            padded = fn(slot, tuple(leaves))
+            out = jax.tree_util.tree_unflatten(
+                treedef, [finalize(p, x) for p, x in zip(padded, leaves)]
+            )
     return _handles.allocate(op_name, out)

@@ -147,8 +147,10 @@ def apply_plan(plan: CombinePlan, mesh: Mesh, axis: str, tree):
     """Run the combine over a pytree of rank-stacked arrays ([n, ...] each)."""
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     fn = _combine_fn(mesh, axis, plan.shifts, plan.use_gather, plan.n)
-    w = jnp.asarray(plan.weight_array())
-    outs = fn(w, tuple(leaves))
+    # numpy, not jnp.asarray: jit places host arrays straight onto the mesh;
+    # an eager conversion would hop through the default device (possibly a
+    # different backend) on every call.
+    outs = fn(plan.weight_array(), tuple(leaves))
     return jax.tree_util.tree_unflatten(treedef, list(outs))
 
 

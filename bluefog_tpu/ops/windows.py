@@ -280,27 +280,46 @@ class Window:
         self.in_neighbors = self.layout.in_nbrs
         self.out_neighbors = self.layout.out_nbrs
         sh = NamedSharding(st.mesh, P("rank"))
-        tensor = jnp.asarray(tensor)
-        self.self_value = jax.device_put(tensor, sh)
         d = self.layout.d_max
         # Mailboxes for integer windows store floats: weighted contributions
         # stay exact until win_update casts the combined result back.
         mail_dtype = tensor.dtype if jnp.issubdtype(tensor.dtype, jnp.floating) \
             else jnp.float32
-        if zero_init:
-            mail = jnp.zeros((st.size, d) + tensor.shape[1:], mail_dtype)
+        mail_shape = (st.size, d) + tensor.shape[1:]
+        if isinstance(tensor, jax.Array):
+            # Device input (possibly a multi-controller global array that
+            # CANNOT be materialized on the host): reshard directly, and
+            # build the neighbor-buffer copy with eager device ops — every
+            # controller executes the same sequence, so this is SPMD-safe.
+            self.self_value = jax.device_put(tensor, sh)
+            if zero_init:
+                mail = jax.device_put(np.zeros(mail_shape, mail_dtype), sh)
+            else:
+                # Neighbor buffers start as a copy of the local tensor
+                # (mpi_ops.py:890-915 zero_init=False default).
+                mail = jnp.broadcast_to(
+                    self.self_value[:, None], mail_shape).astype(mail_dtype)
+                mail = jax.device_put(mail, sh)
         else:
-            # Neighbor buffers start as a copy of the local tensor
-            # (mpi_ops.py:890-915 zero_init=False default).
-            mail = jnp.broadcast_to(
-                tensor[:, None], (st.size, d) + tensor.shape[1:]
-            ).astype(mail_dtype)
-        self.mail = jax.device_put(mail, sh)
+            # Host input: stage via numpy so nothing hops through the
+            # DEFAULT device, which may be a different backend than the
+            # window's mesh (e.g. a remote TPU while the mesh is CPU).
+            host = np.asarray(tensor)
+            self.self_value = jax.device_put(host, sh)
+            if zero_init:
+                mail = np.zeros(mail_shape, mail_dtype)
+            else:
+                mail = np.broadcast_to(host[:, None], mail_shape).astype(
+                    mail_dtype)
+            mail = jax.device_put(mail, sh)
+        self.mail = mail
         # Scalar protocols (versions / push-sum p / mutexes): controller-local
         # host memory, or the job-wide control plane when one is attached
         # (multi-controller; reference mpi_controller.cc:1281-1393, 1532-1602).
         if _cp.active():
-            owned = _cp.owned_ranks(st.devices, jax.process_index())
+            # st.process_index, not argless jax.process_index(): the mesh's
+            # backend may not be the default backend (state.py init).
+            owned = _cp.owned_ranks(st.devices, st.process_index)
             self.host = _ControlPlaneWinHost(name, st.size, self.layout.d_max,
                                              owned)
         else:
@@ -325,7 +344,7 @@ class Window:
         st = _global_state()
         lay = self.layout
         n, shifts = lay.n, lay.shifts
-        slot_c = jnp.asarray(lay.slot)
+        slot_c = np.asarray(lay.slot)  # compile-time const inside the program
 
         def per_rank(x, mail, w, active, self_w):
             me = lax.axis_index("rank")
@@ -337,7 +356,7 @@ class Window:
                 moved = lax.ppermute(xb, "rank", perm)  # from (me - s) % n
                 wk = w[si, me].astype(acc_t)
                 ak = active[si, me]
-                k = slot_c[si, me]
+                k = jnp.asarray(slot_c)[si, me]  # traced const, no eager hop
                 cur = lax.dynamic_index_in_dim(mb, k, axis=0, keepdims=False)
                 contrib = moved.astype(acc_t) * wk
                 if accumulate:
@@ -531,15 +550,17 @@ def _do_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
     else:
         # A put/accumulate WRITES the destinations' mailboxes: lock the dsts.
         touched = [dst for src in range(win.size) for dst in table[src]]
-    source = None if from_get else jnp.asarray(tensor)  # get reads under lock
-    sw_arr = jnp.asarray(sw_list, jnp.float32)
+    # numpy for host-side operands: jit places them on the mesh directly; an
+    # eager jnp.asarray would round-trip them through the default device.
+    source = None if from_get else tensor  # get reads under lock
+    sw_arr = np.asarray(sw_list, np.float32)
     fn = win._exchange_fn(accumulate)
     _acquire(win, touched, require_mutex)
     try:
         with timeline_context(win.name, activity), win.state_mu:
             new_self, new_mail = fn(
                 source if not from_get else win.self_value, win.mail,
-                jnp.asarray(w), jnp.asarray(active), sw_arr)
+                np.asarray(w), np.asarray(active), sw_arr)
             if not from_get:
                 win.self_value = new_self
             win.mail = new_mail
@@ -707,8 +728,8 @@ def win_update(
             fn = win._update_fn()
             result, new_mail = fn(
                 win.self_value, win.mail,
-                jnp.asarray(sw_list, jnp.float32), jnp.asarray(nw),
-                jnp.asarray(read_mask if reset else np.zeros_like(read_mask)))
+                np.asarray(sw_list, np.float32), np.asarray(nw),
+                np.asarray(read_mask if reset else np.zeros_like(read_mask)))
             if st.win_ops_with_associated_p:
                 p_mail = win.host.read_p_mail()
                 new_p = np.asarray(sw_list, np.float64) * win.host.read_p() + \

@@ -225,15 +225,17 @@ class _FusedOptimizer:
     def _weights_and_key(self):
         plan = self._plan()
         if plan is None:
-            return None, jnp.zeros((1, 1), jnp.float32), ("none",)
-        return plan, jnp.asarray(plan.weight_array()), (plan.shifts, plan.use_gather)
+            # numpy host constants: jit places them on the mesh directly
+            # instead of hopping through the default device every step.
+            return None, np.zeros((1, 1), np.float32), ("none",)
+        return plan, plan.weight_array(), (plan.shifts, plan.use_gather)
 
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
         """One training iteration over the whole mesh."""
         k = self.num_steps_per_communication
         self._counter += 1
         do_comm = (self._counter % k) == 0
-        plan, w, wkey = self._weights_and_key() if do_comm else (None, jnp.zeros((1, 1), jnp.float32), ("skip",))
+        plan, w, wkey = self._weights_and_key() if do_comm else (None, np.zeros((1, 1), np.float32), ("skip",))
         key = (do_comm,) + wkey
         fn = self._step_cache.get(key)
         if fn is None:
@@ -424,7 +426,7 @@ class _WindowOptimizer(_FusedOptimizer):
             fn = self._build(key, None, False)
             self._step_cache[key] = fn
         params, opt_state, model_state, metrics = fn(
-            jnp.zeros((1, 1), jnp.float32),
+            np.zeros((1, 1), np.float32),
             state.params, state.opt_state, state.model_state, batch)
         return TrainState(params, opt_state, model_state), metrics
 
@@ -539,14 +541,14 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
             win = st.windows[nm]
             # numerator = x * p  (x is the de-biased parameter)
             p_col = win.host.read_p()
-            numer = leaf * jnp.asarray(p_col, leaf.dtype).reshape(
+            numer = leaf * np.asarray(p_col, leaf.dtype).reshape(
                 (n,) + (1,) * (leaf.ndim - 1))
             _windows.win_accumulate(numer, nm, self_weight=sw, dst_weights=dw,
                                     require_mutex=self.require_mutex)
             collected = _windows.win_update_then_collect(
                 nm, require_mutex=self.require_mutex)
             p_new = _windows.win_associated_p_all(nm)
-            out.append(collected / jnp.asarray(p_new, collected.dtype).reshape(
+            out.append(collected / np.asarray(p_new, collected.dtype).reshape(
                 (n,) + (1,) * (collected.ndim - 1)))
         return out
 

@@ -23,7 +23,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-_NEG = jnp.float32(-1e30)
+# Python scalar, not jnp.float32(...): a concrete array here would initialize
+# the XLA backend at import time, breaking jax.distributed.initialize() in
+# multi-controller jobs (it must run before any backend touch).
+_NEG = -1e30
 
 if hasattr(lax, "pcast"):
     def _pvary(x, axes):

@@ -48,6 +48,13 @@ def cp_apply(model, variables, tokens, mesh: Optional[Mesh] = None,
     if kind == "ulysses" and model.num_heads % n:
         raise ValueError(
             f"ulysses needs num_heads % {n} == 0; got {model.num_heads}")
+    return _cp_apply_fn(model, mesh, axis, kind)(variables, tokens)
+
+
+@functools.lru_cache(maxsize=32)
+def _cp_apply_fn(model, mesh: Mesh, axis: str, kind: str):
+    """Cached jitted CP forward — stable identity so repeat calls hit the
+    jit cache instead of re-tracing (flax Modules hash by value)."""
     cp = _cp_model(model, kind, axis)
 
     def body(variables, toks):
@@ -61,7 +68,7 @@ def cp_apply(model, variables, tokens, mesh: Optional[Mesh] = None,
         in_specs=(P(), P(None, axis)),
         out_specs=P(None, axis),
     )
-    return jax.jit(mapped)(variables, tokens)
+    return jax.jit(mapped)
 
 
 def cp_loss_fn(model, mesh: Optional[Mesh] = None, axis: str = "rank",

@@ -48,8 +48,8 @@ def test_pack_unpack_roundtrip():
 
 
 def test_group_leaves_threshold():
-    leaves = [jnp.zeros((N, 100), jnp.float32) for _ in range(10)]  # 3.2KB/leaf global
-    per_leaf = 100 * N * 4
+    leaves = [jnp.zeros((N, 100), jnp.float32) for _ in range(10)]
+    per_leaf = 100 * 4  # threshold counts PER-RANK bytes (leading dim dropped)
     assert fusion.group_leaves(leaves, 0) == [[i] for i in range(10)]
     assert fusion.group_leaves(leaves, per_leaf * 10) == [list(range(10))]
     gs = fusion.group_leaves(leaves, per_leaf * 3)

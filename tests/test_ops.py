@@ -256,6 +256,25 @@ class TestNeighborAllgather:
         assert out[3].shape == (2,)
         np.testing.assert_allclose(np.asarray(out[3]), 0.0)
 
+    def test_compiled_exchange_is_used(self, bf8):
+        """The gather is a compiled shard_map collective, not an eager take."""
+        from bluefog_tpu.ops import neighbors as nb
+
+        bf8.set_topology(topology_util.ExponentialTwoGraph(8))
+        nb._gather_exchange_fn.cache_clear()
+        x = rank_tensor(shape=(2,))
+        out = bf8.neighbor_allgather(x)
+        assert nb._gather_exchange_fn.cache_info().misses == 1
+        # expo2: rank 0's sorted in-neighbors are [4, 6, 7]
+        assert out.shape == (8, 6)
+        np.testing.assert_allclose(np.asarray(out[0]), [4, 4, 6, 6, 7, 7])
+        # output stays rank-sharded on the mesh (one slice per device)
+        shard_devs = {s.device for s in out.addressable_shards}
+        assert len(shard_devs) == 8
+        # second call with the same topology reuses the compiled program
+        bf8.neighbor_allgather(x)
+        assert nb._gather_exchange_fn.cache_info().misses == 1
+
 
 class TestPairGossip:
     def test_even_odd_pairs(self, bf8):
