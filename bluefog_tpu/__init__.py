@@ -44,6 +44,9 @@ from .runtime.state import (
 # handles
 from .runtime.handles import poll, synchronize, wait
 
+# failure detection / coordinated shutdown (multi-controller)
+from .runtime.heartbeat import shutdown_requested
+
 # timeline
 from .runtime.timeline import (
     start_timeline,

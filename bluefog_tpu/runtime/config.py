@@ -17,6 +17,8 @@ Knobs kept:
   BLUEFOG_SKIP_NEGOTIATE   '1' skips eager cross-rank validation (the analog
                            of bf.set_skip_negotiate_stage, basics.py:293-306;
                            under jit there is never a negotiation stage)
+  BLUEFOG_SIMULATE_DEVICES N -> init() ranks over N forced-CPU devices even
+                           when an accelerator is present (bfrun --simulate)
 
 Knobs with no TPU meaning (accepted, ignored, logged once at init):
   BLUEFOG_*_BY_MPI routing, BLUEFOG_OPS_ON_CPU, BLUEFOG_WIN_ON_GPU,
@@ -56,6 +58,7 @@ class Config:
     cycle_time_ms: float = 0.5
     stall_warning_sec: float = 60.0
     skip_negotiate: bool = False
+    simulate_devices: int = 0
     ignored_set: tuple = ()
 
     @classmethod
@@ -71,6 +74,7 @@ class Config:
             cycle_time_ms=float(env.get("BLUEFOG_CYCLE_TIME", 0.5)),
             stall_warning_sec=float(env.get("BLUEFOG_STALL_WARNING_TIME", 60.0)),
             skip_negotiate=env.get("BLUEFOG_SKIP_NEGOTIATE", "0") == "1",
+            simulate_devices=int(env.get("BLUEFOG_SIMULATE_DEVICES", 0)),
             ignored_set=tuple(k for k in _IGNORED_KNOBS if k in env),
         )
         return cfg

@@ -1,0 +1,196 @@
+"""Controller heartbeats: failure detection + coordinated shutdown.
+
+The reference's background loop gives every rank two liveness guarantees:
+a stalled peer is *detected* (CheckForStalledTensors, operations.cc:387-432)
+and shutdown is *coordinated* — any worker's shutdown request reaches the
+coordinator, which broadcasts SHUTDOWN so no rank blocks on a departed peer
+(operations.cc:830-909, 1074-1095).
+
+The TPU-native analog rides the control-plane KV instead of MPI messages:
+
+  * every controller process bumps ``bf.hb.<pid>`` on a cadence;
+  * a monitor thread watches the other controllers' counters and reports a
+    peer whose heartbeat stops advancing for longer than
+    ``BLUEFOG_HEARTBEAT_TIMEOUT`` seconds (default 30) — the analog of the
+    missing-rank stall warning, but cross-process;
+  * ``bf.shutdown()`` publishes ``bf.shutdown.flag``; peers' monitors
+    surface it via :func:`shutdown_requested`, so a training loop can exit
+    cleanly instead of hanging in the next collective.
+
+Single-controller jobs (no control plane) skip all of this — there is no
+peer to detect or coordinate with.
+
+Coordination protocol: every process announces ITS OWN shutdown under
+``bf.shutdown.flag.<pid>``; a monitor that sees any peer's flag latches
+``shutdown_requested`` and acknowledges under ``bf.shutdown.ack.<pid>``.
+The first announcer waits (bounded) until every peer has either acked or
+announced its own shutdown before tearing its control-plane server down —
+otherwise process 0 would kill the server before the 5-second-cadence
+monitors ever read the flag.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from . import control_plane as _cp
+from .logging import logger
+
+_FLAG = "bf.shutdown.flag."
+_ACK = "bf.shutdown.ack."
+
+
+class PeerMonitor:
+    """Heartbeat publisher + peer liveness / shutdown-flag watcher."""
+
+    def __init__(self, process_index: int, process_count: int,
+                 interval_sec: Optional[float] = None,
+                 timeout_sec: Optional[float] = None) -> None:
+        self.me = process_index
+        self.world = process_count
+        self.interval = interval_sec if interval_sec is not None else float(
+            os.environ.get("BLUEFOG_HEARTBEAT_INTERVAL", "5"))
+        self.timeout = timeout_sec if timeout_sec is not None else float(
+            os.environ.get("BLUEFOG_HEARTBEAT_TIMEOUT", "30"))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown_seen = threading.Event()
+        self._last_value: Dict[int, int] = {}
+        self._last_change: Dict[int, float] = {}
+        self._dead: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None or not _cp.active():
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="bf-heartbeat", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def shutdown_seen(self) -> bool:
+        return self._shutdown_seen.is_set()
+
+    def dead_peers(self) -> set:
+        return set(self._dead)
+
+    # -- the loop ----------------------------------------------------------
+
+    def _tick(self) -> None:
+        cl = _cp.client()
+        cl.put(f"bf.hb.{self.me}", int(time.monotonic_ns() & 0x7FFFFFFFFFFF))
+        now = time.monotonic()
+        for peer in range(self.world):
+            if peer == self.me:
+                continue
+            v = cl.get(f"bf.hb.{peer}")
+            if v != self._last_value.get(peer):
+                self._last_value[peer] = v
+                self._last_change[peer] = now
+                if peer in self._dead:
+                    self._dead.discard(peer)
+                    logger.warning("controller %d heartbeat resumed", peer)
+            elif (now - self._last_change.get(peer, now) > self.timeout
+                  and peer not in self._dead):
+                self._dead.add(peer)
+                logger.error(
+                    "controller %d heartbeat missing for %.0f s — peer "
+                    "failure detected; collectives involving its devices "
+                    "will hang (reference analog: missing-rank stall, "
+                    "operations.cc:387-432)", peer, self.timeout)
+        if not self._shutdown_seen.is_set() and any(
+                cl.get(f"{_FLAG}{p}") for p in range(self.world)
+                if p != self.me):
+            self._shutdown_seen.set()
+            cl.put(f"{_ACK}{self.me}", 1)  # let the announcer stop waiting
+            logger.info(
+                "coordinated shutdown requested by a peer controller "
+                "(reference analog: SHUTDOWN broadcast, operations.cc"
+                ":1074-1095)")
+
+    def _loop(self) -> None:
+        failures = 0
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+                if failures >= 3:
+                    logger.warning(
+                        "heartbeat recovered after %d failed ticks", failures)
+                failures = 0
+            except Exception as exc:  # noqa: BLE001 — observability thread
+                # Keep retrying forever: the monitor must outlive transient
+                # KV/socket outages (it tolerates `timeout` seconds of peer
+                # silence, so it must tolerate at least that much of its
+                # own). Shutdown stops this thread BEFORE detaching the
+                # control plane, so teardown never strands it spinning.
+                failures += 1
+                if failures == 3:
+                    logger.warning(
+                        "heartbeat ticks failing (%s); retrying every "
+                        "%.1f s — peer failure detection degraded until the "
+                        "control plane recovers", exc, self.interval)
+                else:
+                    logger.debug("heartbeat tick failed (retrying): %s", exc)
+
+
+def announce_shutdown(process_index: int, process_count: int,
+                      grace_sec: Optional[float] = None) -> None:
+    """Publish this process's shutdown flag and wait for peers to see it.
+
+    The wait is what makes the coordination real: the announcer may host the
+    control-plane server, and tearing it down before the (interval-cadence)
+    peer monitors have read the flag would defeat the broadcast. A peer
+    counts as "notified" once it acks or announces its own shutdown; the
+    wait is bounded by ``BLUEFOG_SHUTDOWN_GRACE`` seconds (default: 3x the
+    heartbeat interval) so crashed peers cannot hang teardown.
+    """
+    if not _cp.active():
+        return
+    try:
+        cl = _cp.client()
+        peer_already_announced = any(
+            cl.get(f"{_FLAG}{p}") for p in range(process_count)
+            if p != process_index)
+        cl.put(f"{_FLAG}{process_index}", 1)
+        cl.put(f"{_ACK}{process_index}", 1)
+        if peer_already_announced:
+            return  # coordination already under way; no need to wait
+        grace = grace_sec if grace_sec is not None else float(
+            os.environ.get("BLUEFOG_SHUTDOWN_GRACE",
+                           3 * float(os.environ.get(
+                               "BLUEFOG_HEARTBEAT_INTERVAL", "5"))))
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if all(cl.get(f"{_ACK}{p}") or cl.get(f"{_FLAG}{p}")
+                   for p in range(process_count)):
+                return
+            time.sleep(0.05)
+        logger.warning(
+            "shutdown grace (%.1f s) expired with unacknowledged peers; "
+            "proceeding with teardown", grace)
+    except Exception as exc:  # noqa: BLE001 — best effort during teardown
+        logger.debug("shutdown announce failed: %s", exc)
+
+
+def shutdown_requested() -> bool:
+    """True once any controller in the job has called ``bf.shutdown()``.
+
+    Training loops in multi-controller deployments can poll this to exit
+    before issuing a collective that would hang on the departed peer.
+    """
+    from .state import _global_state
+
+    mon = _global_state().peer_monitor
+    return bool(mon is not None and mon.shutdown_seen)

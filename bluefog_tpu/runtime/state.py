@@ -42,6 +42,9 @@ class BluefogTPUState:
         self.devices: List[Any] = []
         self.size: int = 0
         self.local_size: int = 1
+        self.local_rank: int = 0
+        self.process_index: int = 0
+        self.process_count: int = 1
         self.mesh: Optional[Mesh] = None
         self.machine_mesh: Optional[Mesh] = None
         self.topology: Optional[nx.DiGraph] = None
@@ -55,6 +58,7 @@ class BluefogTPUState:
         self.skip_negotiate: bool = False
         self.timeline = None  # runtime.timeline.Timeline when enabled
         self.watchdog = None  # runtime.watchdog.StallWatchdog when enabled
+        self.peer_monitor = None  # runtime.heartbeat.PeerMonitor (multi-ctrl)
         self._plan_cache: Dict[Any, Any] = {}  # compiled combine plans
 
     # -- lifecycle ---------------------------------------------------------
@@ -127,7 +131,10 @@ def init(
     """
     st = _state
     if st.initialized:
-        shutdown()
+        # Re-init: tear down locally WITHOUT announcing coordinated shutdown
+        # — the job is not ending, and the flag would spuriously (and
+        # permanently) trip every peer's shutdown_requested().
+        shutdown(_announce=False)
 
     st.config = Config.from_env()
     for knob in st.config.ignored_set:
@@ -139,14 +146,40 @@ def init(
     # BLUEFOG_CP_HOST is set (runtime/control_plane.py).
     from . import control_plane as _cp
     _cp.attach()
+    if devices is None and st.config.simulate_devices > 0:
+        # bfrun --simulate N: rank over forced-CPU devices even when an
+        # accelerator backend registered (launcher.py:62-68). N counts
+        # devices PER PROCESS; a multi-controller simulate job ranks over
+        # the whole aggregated CPU device set.
+        want = st.config.simulate_devices * jax.process_count("cpu")
+        devices = jax.devices("cpu")[:want]
+        if len(devices) < want:
+            raise RuntimeError(
+                f"BLUEFOG_SIMULATE_DEVICES={st.config.simulate_devices} but "
+                f"only {len(devices)} CPU devices exist; set XLA_FLAGS="
+                "--xla_force_host_platform_device_count (bfrun does this)"
+            )
     st.devices = list(devices if devices is not None else jax.devices())
     st.size = len(st.devices)
+    # Process identity of the backend the mesh actually lives on. The
+    # argless jax.process_index()/process_count() read the DEFAULT backend,
+    # which can be a different (single-process) platform than the mesh —
+    # e.g. ranks on a multi-process CPU job while an accelerator plugin is
+    # the default. Reference analog: rank comes from the communicator the
+    # job runs on, not from the environment at large.
+    platform = getattr(st.devices[0], "platform", None)
+    try:
+        st.process_index = jax.process_index(platform)
+        st.process_count = jax.process_count(platform)
+    except RuntimeError:
+        st.process_index = jax.process_index()
+        st.process_count = jax.process_count()
     if local_size:
         st.local_size = int(local_size)
     else:
         mine = [
             d for d in st.devices
-            if getattr(d, "process_index", 0) == jax.process_index()
+            if getattr(d, "process_index", 0) == st.process_index
         ]
         st.local_size = max(1, len(mine))
     if st.size % st.local_size != 0:
@@ -163,6 +196,7 @@ def init(
             np.array(st.devices).reshape(st.size // st.local_size, st.local_size),
             ("machine", "local"),
         )
+    st.local_rank = _compute_local_rank()
     st.skip_negotiate = st.config.skip_negotiate
     st.windows = {}
     st.win_ops_with_associated_p = False
@@ -180,7 +214,11 @@ def init(
     if st.config.timeline_prefix:
         from .timeline import Timeline
 
-        st.timeline = Timeline(st.config.timeline_prefix)
+        # st.process_index, not the Timeline default (argless
+        # jax.process_index() reads the DEFAULT backend): co-hosted
+        # controllers must not clobber each other's trace file.
+        st.timeline = Timeline(st.config.timeline_prefix,
+                               process_index=st.process_index)
 
     from .watchdog import StallWatchdog
 
@@ -190,23 +228,41 @@ def init(
     )
     st.watchdog.start()
 
+    # Cross-controller failure detection + coordinated shutdown (reference:
+    # stall check operations.cc:387-432, SHUTDOWN broadcast :1074-1095).
+    if st.process_count > 1:
+        from .heartbeat import PeerMonitor
+
+        st.peer_monitor = PeerMonitor(st.process_index, st.process_count)
+        st.peer_monitor.start()
+
     logger.info(
         "bluefog_tpu initialized: %d rank(s) on %s, local_size=%d",
         st.size, st.devices[0].platform, st.local_size,
     )
 
 
-def shutdown() -> None:
+def shutdown(_announce: bool = True) -> None:
     """Tear down runtime state; analog of ``bf.shutdown`` (operations.cc:1205-1215).
 
-    Outstanding window state is dropped; the stall watchdog and timeline
-    writer threads are joined (the reference's coordinated-shutdown broadcast
-    has no analog because there is no peer process to notify).
+    Outstanding window state is dropped; the stall watchdog, heartbeat
+    monitor, and timeline writer threads are joined. In multi-controller
+    jobs the coordinated-shutdown flag is published first (the analog of
+    the reference's SHUTDOWN broadcast, operations.cc:1074-1095) so peers
+    can exit before hanging on a collective with this process's devices.
     """
     st = _state
     if not st.initialized:
         return
     from . import control_plane as _cp
+    from .heartbeat import announce_shutdown
+    if _announce and st.process_count > 1:
+        # Coordinated: peers learn the job is ending BEFORE this process
+        # (possibly the control-plane server host) tears anything down.
+        announce_shutdown(st.process_index, st.process_count)
+    if st.peer_monitor is not None:
+        st.peer_monitor.stop()
+        st.peer_monitor = None
     _cp.detach()
     if st.watchdog is not None:
         st.watchdog.stop()
@@ -252,15 +308,45 @@ def rank() -> int:
 
     In the reference each process is one rank; on TPU one controller drives
     many devices, so per-device rank only exists inside SPMD code (as the
-    rank-axis index). This returns the process index for launcher parity.
+    rank-axis index). This returns the process index of the mesh's backend
+    for launcher parity.
     """
     _state.check_initialized()
-    return jax.process_index()
+    return _state.process_index
+
+
+def _compute_local_rank() -> int:
+    """Index of this controller among controllers on the same physical host.
+
+    The reference reads this off MPI's LOCAL communicator
+    (mpi_context.cc local comm split). Multi-controller jobs here register
+    their hostname in the control-plane KV and count lower-indexed
+    co-hosted processes; single-controller jobs are trivially 0.
+    """
+    from . import control_plane as _cp
+
+    st = _state
+    if st.process_count <= 1 or not _cp.active():
+        return 0
+    import socket
+    import zlib
+
+    cl = _cp.client()
+    me = st.process_index
+    h = zlib.crc32(socket.gethostname().encode())
+    cl.put(f"bf.host.{me}", h)
+    cl.barrier("bf.local_rank")
+    return sum(
+        1 for i in range(st.process_count)
+        if i < me and cl.get(f"bf.host.{i}") == h
+    )
 
 
 def local_rank() -> int:
+    """This controller's index among co-hosted controllers (see
+    :func:`_compute_local_rank`); 0 in single-controller deployments."""
     _state.check_initialized()
-    return 0
+    return _state.local_rank
 
 
 def is_homogeneous() -> bool:

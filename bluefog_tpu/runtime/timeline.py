@@ -37,7 +37,17 @@ class Timeline:
 
     def __init__(self, prefix: str, process_index: Optional[int] = None,
                  use_native: bool = True) -> None:
-        pid = jax.process_index() if process_index is None else process_index
+        if process_index is None:
+            # The runtime's backend-aware index, not argless
+            # jax.process_index(): the DEFAULT backend can be a
+            # single-process plugin while the mesh is multi-process, and
+            # co-hosted controllers must not share a trace file.
+            from .state import _global_state
+
+            st = _global_state()
+            pid = st.process_index if st.initialized else jax.process_index()
+        else:
+            pid = process_index
         self.path = f"{prefix}{pid}.json"
         self._t0 = time.perf_counter_ns()
         self._pid = pid

@@ -1,0 +1,74 @@
+"""Child program for the 2-process ``bfrun`` smoke test.
+
+Launched (twice) by tests/test_launcher.py through
+``python -m bluefog_tpu.launcher -np 2 --coordinator ... --process-id i``.
+Each process brings 2 forced CPU devices, so the job is a 2-process x
+2-device, size-4 deployment — the smallest real multi-controller layout.
+Exercises: jax.distributed bootstrap from the launcher env, control-plane
+attach, truthful rank/local_rank introspection, and cross-process compiled
+collectives (gloo) through the public op surface.
+"""
+
+import jax
+import numpy as np
+
+import bluefog_tpu as bf
+from bluefog_tpu.runtime import control_plane
+
+
+def main() -> None:
+    # bfrun exported the whole env (-np 2 --simulate 2): init() joins the
+    # distributed job FIRST (no jax call may precede it), then ranks over
+    # the aggregated 2x2 CPU device set. The default backend may be a
+    # different, single-process platform, which is exactly what the
+    # platform-aware introspection must see through.
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert jax.process_count("cpu") == 2, jax.process_count("cpu")
+    assert bf.size() == 4, bf.size()
+    assert bf.rank() == pid, (bf.rank(), pid)
+    assert bf.local_size() == 2, bf.local_size()
+    assert bf.num_machines() == 2, bf.num_machines()
+    # Both processes run on THIS host: local_rank must tell them apart
+    # (pre-fix it lied 0 for every controller).
+    assert control_plane.active(), "control plane did not attach"
+    assert bf.local_rank() == pid, (bf.local_rank(), pid)
+
+    # A real cross-process compiled collective through the public surface.
+    global_np = np.arange(8, dtype=np.float32).reshape(4, 2)
+    sh = bf.rank_sharding(bf.mesh())
+    x = jax.make_array_from_callback(
+        global_np.shape, sh, lambda idx: global_np[idx])
+    y = bf.allreduce(x, average=True)
+    expect = global_np.mean(axis=0)
+    for s in y.addressable_shards:
+        np.testing.assert_allclose(np.asarray(s.data)[0], expect, atol=1e-6)
+
+    # Ring neighbor averaging crosses the process boundary (ranks 1<->2).
+    bf.set_topology(bf.topology_util.RingGraph(4))
+    z = bf.neighbor_allreduce(x)
+    for s in z.addressable_shards:
+        r = s.index[0].start
+        want = (global_np[r] + global_np[(r - 1) % 4] + global_np[(r + 1) % 4]) / 3.0
+        np.testing.assert_allclose(np.asarray(s.data)[0], want, atol=1e-6)
+
+    # One-sided windows on a multi-controller GLOBAL array (win_create must
+    # not materialize the non-addressable input on the host).
+    bf.win_create(x, name="smoke.win", zero_init=True)
+    bf.win_put(x, "smoke.win")
+    got = bf.win_update(name="smoke.win")
+    assert got.shape == global_np.shape
+    bf.win_free("smoke.win")
+
+    # Control-plane primitives are live across the two controllers.
+    cl = control_plane.client()
+    total = cl.fetch_add("smoke.counter", 1)
+    assert total in (0, 1)
+    bf.barrier()
+
+    bf.shutdown()
+    print(f"CHILD_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Failure detection + coordinated shutdown over the control plane.
+
+SURVEY §5.3 / VERDICT A3: the reference detects stalled/missing ranks
+(operations.cc:387-432) and coordinates shutdown via a SHUTDOWN broadcast
+(operations.cc:1074-1095). Here two PeerMonitors — standing in for two
+controller processes — exchange heartbeats through one control-plane server:
+a stopped heart is detected, a resumed one clears, and the shutdown flag
+published by one side is seen by the other.
+"""
+
+import socket
+import time
+
+import pytest
+
+from bluefog_tpu.runtime import control_plane as cp
+from bluefog_tpu.runtime import heartbeat, native
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native runtime unavailable")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def two_clients():
+    port = _free_port()
+    server = native.ControlPlaneServer(2, port)
+    a = native.ControlPlaneClient("127.0.0.1", port, 0)
+    b = native.ControlPlaneClient("127.0.0.1", port, 1)
+    yield a, b
+    a.close()
+    b.close()
+    server.stop()
+
+
+def _attach(monkeypatch, client):
+    """Point the control_plane module-level singleton at a raw client."""
+    monkeypatch.setattr(cp, "_client", client)
+
+
+def test_peer_failure_detected_and_recovery(two_clients, monkeypatch):
+    a, b = two_clients
+    _attach(monkeypatch, a)
+    mon = heartbeat.PeerMonitor(0, 2, interval_sec=0.05, timeout_sec=0.3)
+
+    # peer 1 beats by hand (its "process" is client b)
+    def beat():
+        b.put("bf.hb.1", int(time.monotonic_ns()))
+
+    beat()
+    mon._tick()
+    assert mon.dead_peers() == set()
+
+    deadline = time.monotonic() + 5.0
+    # silence: tick until the monitor declares peer 1 dead
+    while time.monotonic() < deadline and 1 not in mon.dead_peers():
+        time.sleep(0.05)
+        mon._tick()
+    assert mon.dead_peers() == {1}
+
+    # resumed heartbeat clears the failure
+    beat()
+    mon._tick()
+    assert mon.dead_peers() == set()
+
+
+def test_shutdown_flag_propagates_and_acks(two_clients, monkeypatch):
+    a, b = two_clients
+    _attach(monkeypatch, a)
+    mon = heartbeat.PeerMonitor(0, 2, interval_sec=0.05, timeout_sec=10.0)
+    mon._tick()
+    assert not mon.shutdown_seen
+
+    # "process 1" announces shutdown through its own client
+    b.put("bf.shutdown.flag.1", 1)
+    mon._tick()
+    assert mon.shutdown_seen
+    # the monitor acked, so the announcer's bounded wait can return
+    assert b.get("bf.shutdown.ack.0") == 1
+
+
+def test_announcer_waits_for_ack_then_returns(two_clients, monkeypatch):
+    a, b = two_clients
+    _attach(monkeypatch, a)
+    # peer already acked: announce returns immediately
+    b.put("bf.shutdown.ack.1", 1)
+    t0 = time.monotonic()
+    heartbeat.announce_shutdown(0, 2, grace_sec=5.0)
+    assert time.monotonic() - t0 < 1.0
+    assert a.get("bf.shutdown.flag.0") == 1
+
+
+def test_announcer_grace_bounds_the_wait(two_clients, monkeypatch):
+    a, b = two_clients
+    _attach(monkeypatch, a)
+    # nobody ever acks: the wait must end at the grace bound, not hang
+    t0 = time.monotonic()
+    heartbeat.announce_shutdown(0, 2, grace_sec=0.3)
+    dt = time.monotonic() - t0
+    assert 0.25 <= dt < 3.0
+
+
+def test_second_announcer_skips_the_wait(two_clients, monkeypatch):
+    a, b = two_clients
+    _attach(monkeypatch, a)
+    b.put("bf.shutdown.flag.1", 1)  # peer announced first
+    t0 = time.monotonic()
+    heartbeat.announce_shutdown(0, 2, grace_sec=5.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_monitor_thread_lifecycle(two_clients, monkeypatch):
+    a, b = two_clients
+    _attach(monkeypatch, a)
+    mon = heartbeat.PeerMonitor(0, 2, interval_sec=0.02, timeout_sec=10.0)
+    mon.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and b.get("bf.hb.0") == 0:
+            time.sleep(0.02)
+        assert b.get("bf.hb.0") != 0, "monitor never published a heartbeat"
+    finally:
+        mon.stop()
+
+
+def test_announce_shutdown_noop_without_control_plane(monkeypatch):
+    _attach(monkeypatch, None)
+    heartbeat.announce_shutdown(0, 2)  # must not raise
+    assert heartbeat.shutdown_requested() in (False,)
