@@ -92,6 +92,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    stem: str = "conv"  # "conv" (7x7/2, torchvision parity) | "space_to_depth"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -106,7 +107,19 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            # MLPerf-style stem: 2x2 space-to-depth packs the 3-channel
+            # input into 12 channels at half resolution, turning the padded
+            # stride-2 7x7 conv (3 input channels badly under-fill the
+            # MXU's 128-lane contraction) into a dense stride-1 4x4 conv at
+            # the same output shape/receptive field class. Compute-
+            # equivalent stand-in for conv_init, not weight-compatible.
+            b, h, w, c = x.shape
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), name="conv_init_s2d")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

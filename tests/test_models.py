@@ -1,0 +1,47 @@
+"""Model-zoo sanity: shapes, dtypes, and the space-to-depth stem option."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bluefog_tpu import models
+
+
+@pytest.mark.slow  # ResNet compilation on the CPU backend is minutes-scale
+@pytest.mark.parametrize("cls", [models.ResNet18, models.ResNet50])
+def test_resnet_forward_shapes(cls):
+    model = cls(num_classes=10)
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    v = model.init(jax.random.PRNGKey(0), x, train=True)
+    logits, updates = model.apply(v, x, train=True, mutable=["batch_stats"])
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32  # head output cast back to f32
+    assert "batch_stats" in updates
+
+
+@pytest.mark.slow  # two ResNet-50 compiles
+def test_space_to_depth_stem_matches_output_geometry():
+    """The MLPerf-style stem must produce the same downstream shapes as the
+    7x7/2 conv stem (112x112 pre-pool at 224 input), differing only in the
+    stem parameters themselves."""
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    conv_model = models.ResNet50(num_classes=7, stem="conv")
+    s2d_model = models.ResNet50(num_classes=7, stem="space_to_depth")
+    vc = conv_model.init(jax.random.PRNGKey(0), x, train=True)
+    vs = s2d_model.init(jax.random.PRNGKey(0), x, train=True)
+    lc, _ = conv_model.apply(vc, x, train=True, mutable=["batch_stats"])
+    ls, _ = s2d_model.apply(vs, x, train=True, mutable=["batch_stats"])
+    assert lc.shape == ls.shape == (1, 7)
+    # stem params: 7x7x3->64 vs 4x4x12->64, same output channel count
+    assert vc["params"]["conv_init"]["kernel"].shape == (7, 7, 3, 64)
+    assert vs["params"]["conv_init_s2d"]["kernel"].shape == (4, 4, 12, 64)
+    # everything downstream is architecturally identical
+    assert set(vc["params"].keys()) - {"conv_init"} == \
+        set(vs["params"].keys()) - {"conv_init_s2d"}
+
+
+def test_odd_input_rejected_by_s2d():
+    model = models.ResNet18(num_classes=3, stem="space_to_depth")
+    x = jnp.zeros((1, 33, 33, 3), jnp.float32)
+    with pytest.raises(Exception):
+        model.init(jax.random.PRNGKey(0), x, train=True)

@@ -151,6 +151,21 @@ class TestNeighborAllreduce:
         np.testing.assert_allclose(np.asarray(out["w"][0]), exp0, atol=1e-5)
         np.testing.assert_allclose(np.asarray(out["b"][0]), exp0, atol=1e-5)
 
+    def test_float16_uses_f32_accumulation(self, bf8):
+        """fp16 ops keep their dtype AND combine in f32 (C11 parity: the
+        reference runs its op suite in fp16 too). The ring's 1/3 weights
+        are not fp16-representable: accumulating in fp16 would give
+        3 * fp16(1/3) = 0.99976 -> fp16 0.9995, while f32 accumulation
+        rounds back to exactly 1.0."""
+        bf8.set_topology(topology_util.RingGraph(8))
+        x = jnp.ones((8, 4), jnp.float16)
+        out = bf8.neighbor_allreduce(x)
+        assert out.dtype == jnp.float16
+        np.testing.assert_array_equal(np.asarray(out), np.float16(1.0))
+        out2 = bf8.allreduce(x)
+        assert out2.dtype == jnp.float16
+        np.testing.assert_array_equal(np.asarray(out2), np.float16(1.0))
+
     def test_average_consensus_converges(self, bf8):
         # the reference's pytorch_average_consensus.py as a test: repeated
         # neighbor averaging over expo2 drives everyone to the global mean
@@ -162,6 +177,24 @@ class TestNeighborAllreduce:
 
 
 class TestDynamicNeighborAllreduce:
+    def test_empty_send_neighbors(self, bf8):
+        """Ranks with no outgoing (or incoming) edges this step keep their
+        own value — the reference's empty-send-neighbor case
+        (torch_ops_test.py dynamic variants)."""
+        sends = {r: ([(r + 1) % 8] if r < 4 else []) for r in range(8)}
+        recv = {r: [] for r in range(8)}
+        for s, ds in sends.items():
+            for d in ds:
+                recv[d].append(s)
+        sw = {r: 1.0 / (len(recv[r]) + 1) for r in range(8)}
+        nw = {r: {s: 1.0 / (len(recv[r]) + 1) for s in recv[r]}
+              for r in range(8)}
+        out = bf8.neighbor_allreduce(
+            rank_tensor(), self_weight=sw, neighbor_weights=nw,
+            send_neighbors=sends)
+        expected = [0.0, 0.5, 1.5, 2.5, 3.5, 5.0, 6.0, 7.0]
+        np.testing.assert_allclose(np.asarray(out)[:, 0], expected, atol=1e-5)
+
     def test_one_peer_ring_step(self, bf8):
         # every rank sends to r+1; recv weight 0.5 / self 0.5
         sends = {r: [(r + 1) % 8] for r in range(8)}
