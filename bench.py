@@ -1,8 +1,10 @@
 """Benchmark: ResNet-50 decentralized training throughput.
 
-Port of the reference harness methodology (examples/pytorch_benchmark.py:
-synthetic ImageNet batches, 10 warmup batches, 10 timed iterations of 10
-batches, img/sec mean) running the flagship fused train step —
+Port of the reference harness (examples/pytorch_benchmark.py: synthetic
+ImageNet batches, 10 warmup batches, then 10 iterations x 10 batches). The
+timed window covers all 100 batches and is closed by ONE host transfer (the
+per-iteration sync of earlier rounds charged remote-tunnel latency, not
+chip time, to the metric — see PERF.md). It runs the flagship fused step —
 per-chip grad -> SGD-momentum update -> Expo-2 neighbor averaging — over all
 available chips. Baseline for vs_baseline: the reference's published
 `Total img/sec on 16 GPU(s): 4310.6` => 269.4 img/sec per V100
@@ -78,16 +80,21 @@ def main() -> None:
         state, metrics = opt.step(state, batch)
     sync(metrics)
 
-    img_secs = []
+    # One timed window over all ITERS x BATCHES_PER_ITER steps, closed by a
+    # single host sync. A per-iteration sync would charge ~64 ms of tunnel
+    # round-trip latency to every 10 batches (~12% of the measurement) —
+    # an artifact of the remote-device link, not the chip. The reference's
+    # harness never fully drains the CUDA queue per iteration either
+    # (pytorch_benchmark.py timeit over async launches); the single final
+    # transfer here drains ALL device work, so the window is honest.
+    t0 = time.perf_counter()
     for _ in range(ITERS):
-        t0 = time.perf_counter()
         for _ in range(BATCHES_PER_ITER):
             state, metrics = opt.step(state, batch)
-        sync(metrics)
-        dt = time.perf_counter() - t0
-        img_secs.append(n * BATCH_PER_CHIP * BATCHES_PER_ITER / dt)
+    sync(metrics)
+    dt = time.perf_counter() - t0
 
-    per_device = float(np.mean(img_secs)) / n
+    per_device = BATCH_PER_CHIP * BATCHES_PER_ITER * ITERS / dt
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(per_device, 2),

@@ -1,0 +1,77 @@
+"""Per-op dispatch/execution microbenchmark.
+
+Analog of the reference's scripts/single_ops_test.py: time each op family
+on the current mesh so dispatch-path regressions (e.g. a collective
+accidentally re-tracing per call) are visible in isolation. Run on the
+default devices, or an 8-device CPU mesh via
+``bfrun --simulate 8 -- python scripts/op_microbench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+
+import bluefog_tpu as bf
+
+
+def timeit(fn, iters):
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    if out is not None:
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--size", type=int, default=1 << 16,
+                   help="elements per rank")
+    p.add_argument("--iters", type=int, default=50)
+    args = p.parse_args()
+
+    import os
+    devices = None
+    if os.environ.get("JAX_PLATFORMS", None) == "" and \
+            not os.environ.get("BLUEFOG_SIMULATE_DEVICES"):
+        devices = jax.devices("cpu")[:8]
+    bf.init(devices=devices)
+    n = bf.size()
+    print(f"mesh: {n} rank(s) on {bf.mesh().devices.flat[0].platform}, "
+          f"{args.size} f32/rank, {args.iters} iters")
+
+    x = bf.shard_rank_stacked(
+        bf.mesh(), np.ones((n, args.size), np.float32))
+    bf.win_create(x, name="mb.win", zero_init=True)
+    peers = {r: r ^ 1 for r in range(n)} if n % 2 == 0 else None
+
+    ops = [
+        ("allreduce", lambda: bf.synchronize(bf.allreduce_nonblocking(x))),
+        ("broadcast", lambda: bf.broadcast(x, 0)),
+        ("allgather", lambda: bf.allgather(x)),
+        ("neighbor_allreduce", lambda: bf.neighbor_allreduce(x)),
+        ("neighbor_allgather", lambda: bf.neighbor_allgather(x)),
+        ("barrier", lambda: bf.barrier()),
+        ("win_put", lambda: bf.win_put(x, "mb.win")),
+        ("win_accumulate", lambda: bf.win_accumulate(x, "mb.win")),
+        ("win_update", lambda: bf.win_update(name="mb.win")),
+    ]
+    if peers:
+        ops.append(("pair_gossip", lambda: bf.pair_gossip(x, peers)))
+
+    for name, fn in ops:
+        dt = timeit(fn, args.iters)
+        print(f"{name:22s} {dt * 1e3:8.3f} ms/call")
+
+    bf.win_free("mb.win")
+    bf.shutdown()
+
+
+if __name__ == "__main__":
+    main()
