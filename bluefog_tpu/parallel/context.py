@@ -185,6 +185,12 @@ def _cp_fn(mesh: Mesh, axis: str, causal: bool, kind: str,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # The pallas HLO *interpreter* (CPU tests) mis-propagates vma through
+        # the kernel's mixed varying/uniform operands and aborts; real TPU
+        # lowering handles it (flash.py declares vma on out_shape). Disable
+        # the check only for interpret mode, per the JAX-suggested
+        # workaround.
+        check_vma=not (use_flash and interpret),
     )
     return jax.jit(mapped)
 

@@ -94,10 +94,18 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
     offs = jnp.asarray([q_off, k_off], jnp.int32)
     grid = (B * H, Sq // tq)
     kernel = functools.partial(_kernel, causal=causal, scale=scale)
+    # Inside shard_map the inputs carry varying-mesh-axes (vma) metadata and
+    # pallas_call requires out_shape to declare the same — without it the
+    # kernel compiles under interpret mode but fails to lower on real TPU.
+    # Union over q/k/v: any varying operand makes the outputs varying (k/v
+    # can be rank-varying while q is replicated, e.g. broadcast-query).
+    vmas = [getattr(jax.typeof(t), "vma", None) for t in (q, k, v)]
+    kw = {} if all(m is None for m in vmas) else {
+        "vma": frozenset().union(*(m for m in vmas if m is not None))}
     out_shape = (
-        jax.ShapeDtypeStruct((B * H, Sq, D), jnp.float32),
-        jax.ShapeDtypeStruct((B * H, Sq, 8), jnp.float32),
-        jax.ShapeDtypeStruct((B * H, Sq, 8), jnp.float32),
+        jax.ShapeDtypeStruct((B * H, Sq, D), jnp.float32, **kw),
+        jax.ShapeDtypeStruct((B * H, Sq, 8), jnp.float32, **kw),
+        jax.ShapeDtypeStruct((B * H, Sq, 8), jnp.float32, **kw),
     )
     if _HAVE_PLTPU:
         grid_spec = pltpu.PrefetchScalarGridSpec(

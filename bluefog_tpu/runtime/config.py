@@ -78,3 +78,20 @@ class Config:
             ignored_set=tuple(k for k in _IGNORED_KNOBS if k in env),
         )
         return cfg
+
+
+def example_devices(n: int = 8):
+    """Device list for examples/scripts run OUTSIDE ``bfrun``.
+
+    Convention shared by every example: an explicitly EMPTY ``JAX_PLATFORMS``
+    means "development CPU mesh with the accelerator plugin also registered"
+    — prefer ``n`` CPU ranks over the (often 1-device) default backend.
+    Returns None otherwise, letting ``bf.init`` use its defaults (which
+    already honor ``bfrun --simulate`` via BLUEFOG_SIMULATE_DEVICES).
+    """
+    if os.environ.get("JAX_PLATFORMS", None) == "" and \
+            not os.environ.get("BLUEFOG_SIMULATE_DEVICES"):
+        import jax
+
+        return jax.devices("cpu")[:n]
+    return None

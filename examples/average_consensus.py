@@ -25,11 +25,11 @@ from bluefog_tpu import topology_util
 
 
 def main() -> int:
-    use_cpu_mesh = os.environ.get("JAX_PLATFORMS", None) == ""
-    devices = jax.devices("cpu")[:8] if use_cpu_mesh else jax.devices()
-    bf.init(topology_util.ExponentialTwoGraph, devices=devices)
+    from bluefog_tpu.runtime.config import example_devices
+
+    bf.init(topology_util.ExponentialTwoGraph, devices=example_devices())
     n = bf.size()
-    print(f"ranks: {n} on {devices[0].platform}")
+    print(f"ranks: {n} on {bf.mesh().devices.flat[0].platform}")
 
     key = jax.random.PRNGKey(0)
     x = jax.random.normal(key, (n, 1000))

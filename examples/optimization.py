@@ -302,15 +302,8 @@ def main() -> None:
                         help="optional path for a semilogy convergence plot")
     args = parser.parse_args()
 
-    import os
-    devices = None
-    if os.environ.get("JAX_PLATFORMS", None) == "" and \
-            not os.environ.get("BLUEFOG_SIMULATE_DEVICES"):
-        # Dev convenience matching average_consensus.py: an explicitly empty
-        # JAX_PLATFORMS means "simulated CPU mesh, accelerator plugin also
-        # registered" — prefer the 8 CPU ranks over the 1-device default.
-        devices = jax.devices("cpu")[:8]
-    bf.init(devices=devices)
+    from bluefog_tpu.runtime.config import example_devices
+    bf.init(devices=example_devices())
     print(f"ranks: {bf.size()} on {bf.mesh().devices.flat[0].platform}")
     _, _, mse = run(method=args.method, task=args.task,
                     topology=args.topology, maxite=args.max_iter,

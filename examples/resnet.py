@@ -220,9 +220,6 @@ def train(args, devices=None):
 
 
 if __name__ == "__main__":
-    args = parse_args()
-    devices = None
-    if os.environ.get("JAX_PLATFORMS", None) == "" and \
-            not os.environ.get("BLUEFOG_SIMULATE_DEVICES"):
-        devices = jax.devices("cpu")[:8]
-    train(args, devices=devices)
+    from bluefog_tpu.runtime.config import example_devices
+
+    train(parse_args(), devices=example_devices())

@@ -36,12 +36,8 @@ def main() -> None:
     p.add_argument("--iters", type=int, default=50)
     args = p.parse_args()
 
-    import os
-    devices = None
-    if os.environ.get("JAX_PLATFORMS", None) == "" and \
-            not os.environ.get("BLUEFOG_SIMULATE_DEVICES"):
-        devices = jax.devices("cpu")[:8]
-    bf.init(devices=devices)
+    from bluefog_tpu.runtime.config import example_devices
+    bf.init(devices=example_devices())
     n = bf.size()
     print(f"mesh: {n} rank(s) on {bf.mesh().devices.flat[0].platform}, "
           f"{args.size} f32/rank, {args.iters} iters")

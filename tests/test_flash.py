@@ -1,0 +1,70 @@
+"""Pallas flash-attention kernel tests.
+
+CPU coverage runs the kernel in interpret mode (pallas has no CPU lowering);
+the TPU test compiles the REAL kernel — this is the path that caught the
+missing vma declaration on pallas_call out_shape, which interpret mode
+masks entirely (the kernel 'worked' on CPU while failing to lower on
+hardware).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from bluefog_tpu.parallel import ring_attention
+from bluefog_tpu.parallel.context import reference_attention
+from bluefog_tpu.parallel.flash import flash_attention
+
+
+def _qkv(B=1, S=256, H=2, D=128, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(3), 3)
+    return (jax.random.normal(k1, (B, S, H, D), dtype),
+            jax.random.normal(k2, (B, S, H, D), dtype),
+            jax.random.normal(k3, (B, S, H, D), dtype))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_dense_interpret(causal):
+    q, k, v = _qkv()
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_ring_attention_flash_path_interpret(bf8):
+    """The flash kernel inside the sharded ring exchange (8-way CPU mesh)."""
+    import bluefog_tpu as bf
+
+    q, k, v = _qkv(S=512)
+    mesh = bf.mesh()
+    got = ring_attention(q, k, v, mesh=mesh, causal=True, use_flash=True,
+                         interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-3, rtol=2e-3)
+
+
+def _tpu_devices():
+    try:
+        return jax.devices("tpu")
+    except RuntimeError:
+        return []
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _tpu_devices(), reason="no TPU available")
+def test_flash_compiles_on_real_tpu():
+    """Compile + execute the real kernel (no interpret) on the TPU chip,
+    inside a 1-device shard_map ring — the vma-carrying path."""
+    dev = _tpu_devices()[0]
+    mesh = Mesh(np.array([dev]), ("rank",))
+    q, k, v = _qkv(S=512, dtype=jnp.bfloat16)
+    got = ring_attention(q, k, v, mesh=mesh, causal=True, use_flash=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=3e-2, rtol=3e-2)
