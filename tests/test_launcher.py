@@ -75,6 +75,32 @@ def test_simulate_single_host():
 
 
 @pytest.mark.slow
+def test_simulate_16_ranks():
+    """A deeper mesh than the 8-device fixture: log2(16)=4 Expo-2 shifts
+    and a 4x4 machine-by-local hierarchy, through the bfrun path."""
+    code = (
+        "import numpy as np, jax, bluefog_tpu as bf; "
+        "bf.init(local_size=4); "
+        "assert bf.size() == 16 and bf.num_machines() == 4; "
+        "x = bf.shard_rank_stacked(bf.mesh(), "
+        "np.arange(16, dtype=np.float32).reshape(16, 1)); "
+        "y = x\n"
+        "for _ in range(40): y = bf.neighbor_allreduce(y)\n"
+        "np.testing.assert_allclose(np.asarray(y), 7.5, atol=1e-3); "
+        "h = bf.hierarchical_neighbor_allreduce(x); "
+        "assert h.shape == (16, 1); "
+        "print('RANKS16_OK')"
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--simulate", "16",
+         "--", sys.executable, "-c", code],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "RANKS16_OK" in out.stdout
+
+
+@pytest.mark.slow
 def test_two_process_launch_smoke():
     """bfrun -np 2 --coordinator: the full multi-controller bootstrap.
 
