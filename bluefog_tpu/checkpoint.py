@@ -32,10 +32,34 @@ from .runtime.logging import logger
 from .runtime.state import _global_state
 
 
+def _check_multicontroller_backend() -> None:
+    """Fail fast when orbax's process identity would be wrong.
+
+    Orbax coordinates multiprocess saves (primary-host finalize, commit
+    barrier) through the DEFAULT backend's process identity. If the job's
+    mesh lives on a different backend than the default (e.g. a multi-process
+    CPU mesh while a single-process accelerator plugin is the default),
+    every controller believes it is the single primary and they race on the
+    rename — observed as a hang/FileExistsError. On real pods the mesh
+    backend IS the default backend and orbax's standard path just works.
+    """
+    st = _global_state()
+    if st.initialized and st.process_count > 1 \
+            and jax.process_count() != st.process_count:
+        raise RuntimeError(
+            "multi-controller checkpointing needs the mesh backend to be "
+            f"jax's default backend (mesh: {st.process_count} processes, "
+            f"default backend: {jax.process_count()} process(es)); orbax "
+            "coordinates its commit barrier via the default backend's "
+            "process identity"
+        )
+
+
 def save(path: str, state: TrainState, step: int = 0, *, force: bool = True) -> str:
     """Write a checkpoint directory at ``path`` (overwrites when ``force``)."""
     if not _HAVE_ORBAX:
         raise RuntimeError("orbax-checkpoint is not available")
+    _check_multicontroller_backend()
     path = os.path.abspath(path)
     ckpt = {
         "params": state.params,
@@ -60,6 +84,7 @@ def restore(path: str, template: Optional[TrainState] = None):
     """
     if not _HAVE_ORBAX:
         raise RuntimeError("orbax-checkpoint is not available")
+    _check_multicontroller_backend()
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         if template is not None:

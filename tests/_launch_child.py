@@ -9,6 +9,8 @@ attach, truthful rank/local_rank introspection, and cross-process compiled
 collectives (gloo) through the public op surface.
 """
 
+import os
+
 import jax
 import numpy as np
 
@@ -52,6 +54,17 @@ def main() -> None:
         want = (global_np[r] + global_np[(r - 1) % 4] + global_np[(r + 1) % 4]) / 3.0
         np.testing.assert_allclose(np.asarray(s.data)[0], want, atol=1e-6)
 
+    # Hierarchical averaging with machine == process: the local pmean stays
+    # intra-process, the machine-graph ppermute crosses the process boundary.
+    h = bf.hierarchical_neighbor_allreduce(x)
+    for s in h.addressable_shards:
+        r = s.index[0].start
+        machine = r // 2
+        local_mean = (global_np[2 * machine] + global_np[2 * machine + 1]) / 2
+        other = (global_np[2 * (1 - machine)] + global_np[2 * (1 - machine) + 1]) / 2
+        np.testing.assert_allclose(
+            np.asarray(s.data)[0], (local_mean + other) / 2.0, atol=1e-6)
+
     # One-sided windows on a multi-controller GLOBAL array (win_create must
     # not materialize the non-addressable input on the host).
     bf.win_create(x, name="smoke.win", zero_init=True)
@@ -59,6 +72,32 @@ def main() -> None:
     got = bf.win_update(name="smoke.win")
     assert got.shape == global_np.shape
     bf.win_free("smoke.win")
+
+    # Multi-controller checkpointing: on a real pod (mesh backend == default
+    # backend) orbax's primary-host path applies; in THIS mixed-backend env
+    # (CPU mesh, accelerator plugin default) the library must fail fast with
+    # the documented error instead of racing on the commit rename.
+    ckdir = os.environ.get("SMOKE_CKPT_DIR")
+    if ckdir:
+        from bluefog_tpu import checkpoint as ck
+        from bluefog_tpu.optimizers import TrainState
+
+        st0 = TrainState(params={"w": x}, opt_state={"m": x * 0.5},
+                         model_state=None)
+        if jax.process_count() == jax.process_count("cpu"):
+            ck.save(ckdir, st0, step=3)
+            restored, step = ck.restore(ckdir, template=st0)
+            assert step == 3
+            for s in restored.params["w"].addressable_shards:
+                r = s.index[0].start
+                np.testing.assert_allclose(np.asarray(s.data),
+                                           global_np[r:r + 1], atol=1e-6)
+        else:
+            try:
+                ck.save(ckdir, st0, step=3)
+                raise AssertionError("expected mixed-backend save to refuse")
+            except RuntimeError as e:
+                assert "default backend" in str(e), e
 
     # Control-plane primitives are live across the two controllers.
     cl = control_plane.client()

@@ -101,15 +101,18 @@ def test_simulate_16_ranks():
 
 
 @pytest.mark.slow
-def test_two_process_launch_smoke():
+def test_two_process_launch_smoke(tmp_path):
     """bfrun -np 2 --coordinator: the full multi-controller bootstrap.
 
     Asserts (in the children, tests/_launch_child.py): distributed init,
     size/rank/local_size/local_rank truthfulness, cross-process allreduce +
-    ring neighbor_allreduce correctness, control-plane fetch_add/barrier.
+    ring neighbor_allreduce + hierarchical correctness, windows on global
+    arrays, a coordinated orbax checkpoint round-trip, and control-plane
+    fetch_add/barrier.
     """
     port = _free_port()
     env = _scrubbed_env()
+    env["SMOKE_CKPT_DIR"] = str(tmp_path / "ck")
 
     def cmd(i):
         return [sys.executable, "-m", "bluefog_tpu.launcher", "-np", "2",
