@@ -1,0 +1,73 @@
+"""Rank-scaling probe: throughput vs simulated mesh size per optimizer.
+
+Analog of the reference's scripts/pytorch_opt_linear_speedup_test.py:
+run the benchmark harness at 1/2/4/8 ranks (each in its own process via
+``bfrun --simulate N`` — the device count is fixed at backend init) and
+report total img/s, so collective overhead growth with rank count is
+visible at a glance. CPU-mesh numbers regression-track the *overhead
+scaling*, not absolute TPU speed.
+
+Usage: python scripts/scaling_test.py [--model mlp] [--ranks 1 2 4 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_one(ranks: int, model: str, dist_opt: str, batch: int) -> float:
+    env = os.environ.copy()
+    # scrub anything that would make the child join a stale distributed
+    # job or foreign control plane instead of benchmarking a local mesh
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE",
+              "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "JAX_PROCESS_ID", "BLUEFOG_CP_HOST", "BLUEFOG_CP_PORT"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher",
+         "--simulate", str(ranks), "--",
+         sys.executable, str(REPO / "examples" / "benchmark.py"),
+         "--model", model, "--batch-size", str(batch),
+         "--num-warmup-batches", "2", "--num-batches-per-iter", "5",
+         "--num-iters", "3", "--dist-optimizer", dist_opt],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(f"ranks={ranks} failed:\n{out.stdout}{out.stderr}")
+    m = re.search(r"Total img/sec on \d+ chip\(s\):\s*([0-9.]+)", out.stdout)
+    assert m, out.stdout
+    return float(m.group(1))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="mlp")
+    p.add_argument("--dist-optimizer", default="neighbor_allreduce")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--ranks", type=int, nargs="+", default=[1, 2, 4, 8])
+    args = p.parse_args()
+
+    base = None
+    print(f"model={args.model} optimizer={args.dist_optimizer} "
+          f"batch={args.batch_size}/rank")
+    print("NOTE: simulated ranks SHARE the host's cores, so the ideal is a "
+          "FLAT total (100% retention), not an Nx speedup; the retention "
+          "column isolates partitioning+collective+dispatch overhead.")
+    print(f"{'ranks':>6} {'total img/s':>12} {'retention':>10}")
+    for n in args.ranks:
+        rate = run_one(n, args.model, args.dist_optimizer, args.batch_size)
+        if base is None:
+            base = rate
+        print(f"{n:>6} {rate:>12.1f} {100 * rate / base:>9.0f}%")
+
+
+if __name__ == "__main__":
+    main()

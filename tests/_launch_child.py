@@ -92,6 +92,13 @@ def main() -> None:
                 r = s.index[0].start
                 np.testing.assert_allclose(np.asarray(s.data),
                                            global_np[r:r + 1], atol=1e-6)
+            # opt_state carries deliberately DIFFERENT values (x * 0.5) so a
+            # params/opt_state key mix-up in restore cannot pass silently
+            for s in restored.opt_state["m"].addressable_shards:
+                r = s.index[0].start
+                np.testing.assert_allclose(np.asarray(s.data),
+                                           0.5 * global_np[r:r + 1],
+                                           atol=1e-6)
         else:
             try:
                 ck.save(ckdir, st0, step=3)
