@@ -10,6 +10,7 @@ collectives (gloo) through the public op surface.
 """
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -112,7 +113,20 @@ def main() -> None:
     assert total in (0, 1)
     bf.barrier()
 
-    bf.shutdown()
+    # Coordinated shutdown, end to end: process 1 leaves first; process 0
+    # (which hosts the control-plane server) must observe the announcement
+    # through its heartbeat monitor before tearing anything down. The
+    # deadline is deliberately short: if process 1 died earlier for an
+    # unrelated reason, failing fast here keeps the report pointed at the
+    # real root cause instead of a 30 s shutdown-protocol red herring.
+    if pid == 1:
+        bf.shutdown()
+    else:
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and not bf.shutdown_requested():
+            time.sleep(0.1)
+        assert bf.shutdown_requested(), "shutdown announcement never seen"
+        bf.shutdown()
     print(f"CHILD_OK {pid}", flush=True)
 
 

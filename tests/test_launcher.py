@@ -113,6 +113,9 @@ def test_two_process_launch_smoke(tmp_path):
     port = _free_port()
     env = _scrubbed_env()
     env["SMOKE_CKPT_DIR"] = str(tmp_path / "ck")
+    # fast heartbeat cadence so the coordinated-shutdown observation at the
+    # end of the child doesn't wait out the default 5 s interval
+    env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.3"
 
     def cmd(i):
         return [sys.executable, "-m", "bluefog_tpu.launcher", "-np", "2",
