@@ -63,9 +63,13 @@ from .ops import (
     allgather_v,
     allreduce,
     allreduce_nonblocking,
+    allreduce_,
+    allreduce_nonblocking_,
     barrier,
     broadcast,
     broadcast_nonblocking,
+    broadcast_,
+    broadcast_nonblocking_,
     pair_gossip,
     pair_gossip_nonblocking,
     hierarchical_neighbor_allreduce,

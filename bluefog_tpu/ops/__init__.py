@@ -6,9 +6,13 @@ from .collectives import (
     allgather_v,
     allreduce,
     allreduce_nonblocking,
+    allreduce_,
+    allreduce_nonblocking_,
     barrier,
     broadcast,
     broadcast_nonblocking,
+    broadcast_,
+    broadcast_nonblocking_,
     pair_gossip,
     pair_gossip_nonblocking,
 )

@@ -306,3 +306,32 @@ def _pair_gossip_fn(mesh, peers: tuple, self_weight: float, pair_weight: float):
         return tuple(outs)
 
     return _jit_smap(mesh, P("rank"), body)
+
+
+# ---------------------------------------------------------------------------
+# in-place name-parity aliases
+# ---------------------------------------------------------------------------
+# The reference's trailing-underscore variants mutate the input tensor and
+# return it (mpi_ops.py:150-201, 265-308). jax.Arrays are immutable: these
+# aliases return the reduced value for callers to rebind, and the true
+# in-place analog — reusing the input buffer — is XLA donation, which the
+# fused optimizer steps already apply (optimizers.py donate_argnums).
+
+def allreduce_(*args, **kwargs):
+    """Name-parity alias of :func:`allreduce` (reference in-place variant)."""
+    return allreduce(*args, **kwargs)
+
+
+def allreduce_nonblocking_(*args, **kwargs) -> int:
+    """Name-parity alias of :func:`allreduce_nonblocking`."""
+    return allreduce_nonblocking(*args, **kwargs)
+
+
+def broadcast_(*args, **kwargs):
+    """Name-parity alias of :func:`broadcast` (reference in-place variant)."""
+    return broadcast(*args, **kwargs)
+
+
+def broadcast_nonblocking_(*args, **kwargs) -> int:
+    """Name-parity alias of :func:`broadcast_nonblocking`."""
+    return broadcast_nonblocking(*args, **kwargs)

@@ -21,6 +21,20 @@ def rank_tensor(n=8, shape=(4,), dtype=jnp.float32):
 
 
 class TestAllreduce:
+    def test_inplace_name_parity_aliases(self, bf8):
+        """allreduce_/broadcast_ (the reference's in-place variants) exist
+        and return the op result; jax arrays are immutable, so rebinding +
+        donation is the in-place analog (mpi_ops.py:150-201)."""
+        x = rank_tensor()
+        np.testing.assert_allclose(
+            np.asarray(bf8.allreduce_(x)), np.asarray(bf8.allreduce(x)))
+        np.testing.assert_allclose(
+            np.asarray(bf8.broadcast_(x, 2)), np.asarray(bf8.broadcast(x, 2)))
+        h = bf8.allreduce_nonblocking_(x)
+        np.testing.assert_allclose(np.asarray(bf8.synchronize(h))[:, 0], 3.5)
+        h2 = bf8.broadcast_nonblocking_(x, 1)
+        np.testing.assert_allclose(np.asarray(bf8.synchronize(h2))[:, 0], 1.0)
+
     def test_average(self, bf8):
         x = rank_tensor()
         out = bf8.allreduce(x, average=True)
