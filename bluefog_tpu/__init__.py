@@ -61,6 +61,7 @@ from .ops import (
     allgather,
     allgather_nonblocking,
     allgather_v,
+    allgather_v_nonblocking,
     allreduce,
     allreduce_nonblocking,
     allreduce_,

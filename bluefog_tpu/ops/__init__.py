@@ -4,6 +4,7 @@ from .collectives import (
     allgather,
     allgather_nonblocking,
     allgather_v,
+    allgather_v_nonblocking,
     allreduce,
     allreduce_nonblocking,
     allreduce_,

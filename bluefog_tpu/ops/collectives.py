@@ -190,15 +190,74 @@ def allgather_v(tensors: Sequence, name: Optional[str] = None):
     """Variable-first-dim allgather: list of per-rank arrays -> concatenation.
 
     The reference supports ragged gathers on its CPU/MPI path via a
-    pre-allgather of first-dim sizes (mpi_context.cc:443-508); the SPMD
-    compiled path cannot trace ragged shapes, so this runs as an eager
-    device concat on the controller.
+    pre-allgather of first-dim sizes followed by MPI_Allgatherv
+    (mpi_context.cc:443-508). The SPMD compiled path cannot trace ragged
+    shapes, so the TPU-native transport is the padded analog: every rank's
+    slice is zero-padded to the max first dim, the padded block rides ONE
+    compiled all_gather over the mesh (real ICI traffic, not a controller
+    concat), and the statically-known sizes trim the padding at the edge.
     """
+    return _handles.synchronize(allgather_v_nonblocking(tensors, name))
+
+
+def allgather_v_nonblocking(tensors: Sequence, name: Optional[str] = None) -> int:
     st = _global_state()
     st.check_initialized()
+    op_name = _auto_name("allgather_v", name)
     if len(tensors) != st.size:
         raise ValueError(f"expected {st.size} per-rank tensors, got {len(tensors)}")
-    return jnp.concatenate(list(tensors), axis=0)
+    tensors = [jnp.asarray(t) for t in tensors]
+    trailing = tensors[0].shape[1:]
+    dtype = tensors[0].dtype
+    for r, t in enumerate(tensors):
+        if t.ndim < 1:
+            raise ValueError(f"allgather_v: rank {r} slice must have a first dim")
+        if t.shape[1:] != trailing or t.dtype != dtype:
+            raise ValueError(
+                f"allgather_v: rank {r} slice {t.dtype}{t.shape} does not match "
+                f"rank 0's trailing shape {dtype}{(-1,) + trailing}"
+            )
+
+    sizes = tuple(int(t.shape[0]) for t in tensors)
+    with timeline_context(op_name, "ALLGATHER_V"):
+        if max(sizes) == 0:
+            # match the compiled path's placement: replicated over the mesh,
+            # not the default device (which may be a different backend)
+            out = jax.device_put(
+                jnp.zeros((0,) + trailing, dtype),
+                jax.sharding.NamedSharding(st.mesh, P()),
+            )
+        else:
+            out = _allgather_v_fn(st.mesh, sizes)(*tensors)
+    return _handles.allocate(op_name, out)
+
+
+@functools.lru_cache(maxsize=64)
+def _allgather_v_fn(mesh, sizes: tuple):
+    b_max = max(sizes)
+    # static gather indices skipping each rank's padding rows
+    idx = np.concatenate(
+        [np.arange(r * b_max, r * b_max + s) for r, s in enumerate(sizes)]
+    ).astype(np.int32)
+
+    def body(x):
+        g = lax.all_gather(x[0], "rank", axis=0, tiled=True)  # [n*b_max, ...]
+        # the trim is identical on every rank, but the gather primitive defeats
+        # shard_map's static replication inference, so it stays rank-stacked
+        return jnp.take(g, idx, axis=0)[None]
+
+    def call(*leaves):
+        # pad + stack + row select all under one jit, so a single host
+        # dispatch covers the whole op (the _jit_smap rationale applies)
+        pad_trailing = [(0, 0)] * (leaves[0].ndim - 1)
+        padded = jnp.stack([
+            jnp.pad(t, [(0, b_max - t.shape[0])] + pad_trailing) for t in leaves
+        ])
+        mapped = jax.shard_map(
+            body, mesh=mesh, in_specs=P("rank"), out_specs=P("rank"))
+        return mapped(padded)[0]
+
+    return jax.jit(call)
 
 
 # ---------------------------------------------------------------------------

@@ -96,6 +96,39 @@ class TestAllgather:
         assert out.shape == (36, 2)
         np.testing.assert_allclose(np.asarray(out[:1]), 0.0)
         np.testing.assert_allclose(np.asarray(out[-8:]), 7.0)
+        # exact ragged concatenation: rank r contributes r+1 rows of value r
+        expected = np.concatenate([np.full((r + 1, 2), float(r)) for r in range(8)])
+        np.testing.assert_allclose(np.asarray(out), expected)
+
+    def test_allgather_v_is_compiled_collective(self, bf8):
+        """Ragged gather rides one padded all_gather program, trimmed statically."""
+        from bluefog_tpu.ops import collectives as co
+
+        co._allgather_v_fn.cache_clear()
+        parts = [jnp.full((r % 3, 2), float(r)) for r in range(8)]  # incl. size-0 ranks
+        out = bf8.allgather_v(parts)
+        assert co._allgather_v_fn.cache_info().misses == 1
+        expected = np.concatenate([np.full((r % 3, 2), float(r)) for r in range(8)])
+        assert out.shape == expected.shape == (7, 2)
+        np.testing.assert_allclose(np.asarray(out), expected)
+        # same size signature reuses the compiled program
+        bf8.allgather_v([jnp.ones((r % 3, 2)) for r in range(8)])
+        assert co._allgather_v_fn.cache_info().misses == 1
+
+    def test_allgather_v_all_empty_and_nonblocking(self, bf8):
+        out = bf8.allgather_v([jnp.zeros((0, 3)) for _ in range(8)])
+        assert out.shape == (0, 3)
+        h = bf8.allgather_v_nonblocking([jnp.full((1,), float(r)) for r in range(8)])
+        out = bf8.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+
+    def test_allgather_v_mismatch_rejected(self, bf8):
+        parts = [jnp.zeros((1, 2)) for _ in range(8)]
+        parts[3] = jnp.zeros((1, 5))
+        with pytest.raises(ValueError, match="trailing shape"):
+            bf8.allgather_v(parts)
+        with pytest.raises(ValueError, match="per-rank tensors"):
+            bf8.allgather_v(parts[:4])
 
 
 class TestNeighborAllreduce:
