@@ -113,6 +113,7 @@ from .optimizers import (
     DistributedAllreduceOptimizer,
     DistributedNeighborAllreduceOptimizer,
     DistributedHierarchicalNeighborAllreduceOptimizer,
+    DistributedShardedAllreduceOptimizer,
     DistributedWinPutOptimizer,
     DistributedPullGetOptimizer,
     DistributedPushSumOptimizer,
