@@ -37,7 +37,10 @@ BATCHES_PER_ITER = 10
 BASELINE_IMG_SEC_PER_DEVICE = 4310.6 / 16  # reference 16xV100 result
 
 
-def main() -> None:
+def setup(batch_per_chip: int = BATCH_PER_CHIP):
+    """Build the benchmark step: (opt, state, batch, sync). Caller owns
+    ``bf.shutdown()``. Shared with scripts/batch_sweep.py so batch-size
+    probes measure exactly the benchmarked step."""
     n = len(jax.devices())
     topo = bf.topology_util.ExponentialTwoGraph(n) if n > 1 else \
         bf.topology_util.FullyConnectedGraph(1)
@@ -45,7 +48,7 @@ def main() -> None:
 
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
-    sample = jnp.zeros((BATCH_PER_CHIP, IMAGE, IMAGE, 3), jnp.float32)
+    sample = jnp.zeros((batch_per_chip, IMAGE, IMAGE, 3), jnp.float32)
     variables = model.init(rng, sample, train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
@@ -63,11 +66,11 @@ def main() -> None:
     state = opt.init(params, model_state=batch_stats)
 
     images = jax.device_put(
-        jax.random.normal(rng, (n, BATCH_PER_CHIP, IMAGE, IMAGE, 3),
+        jax.random.normal(rng, (n, batch_per_chip, IMAGE, IMAGE, 3),
                           jnp.float32),
         bf.rank_sharding(bf.mesh()))
     labels = jax.device_put(
-        jnp.zeros((n, BATCH_PER_CHIP), jnp.int32),
+        jnp.zeros((n, batch_per_chip), jnp.int32),
         bf.rank_sharding(bf.mesh()))
     batch = (images, labels)
 
@@ -75,6 +78,12 @@ def main() -> None:
         # A host transfer is the only reliable completion barrier over the
         # remote-device tunnel (block_until_ready can return early there).
         return float(np.asarray(m["loss"])[0])
+
+    return opt, state, batch, sync
+
+
+def main() -> None:
+    opt, state, batch, sync = setup()
 
     for _ in range(WARMUP):
         state, metrics = opt.step(state, batch)

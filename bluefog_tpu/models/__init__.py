@@ -12,6 +12,7 @@ with float32 parameters/batch-stats, channel counts that are multiples of
 from .mlp import MLP, LeNet5
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
 from .transformer import TransformerLM, apply_rope
+from .vgg import VGG, VGG11, VGG16, VGG19
 
 __all__ = [
     "MLP",
@@ -23,4 +24,8 @@ __all__ = [
     "ResNet101",
     "TransformerLM",
     "apply_rope",
+    "VGG",
+    "VGG11",
+    "VGG16",
+    "VGG19",
 ]

@@ -1,0 +1,90 @@
+"""VGG in flax — the reference benchmark's second model family.
+
+The reference's harness loads any torchvision model by name and its docs
+exercise ``--model vgg16`` alongside resnet50 (reference:
+examples/pytorch_benchmark.py model arg). From-scratch flax implementation
+of Simonyan & Zisserman 2014 configurations A/D/E (VGG-11/16/19), with the
+batch-norm variant as default — same TPU recipe as the ResNets: bfloat16
+compute, float32 params/statistics, NHWC, static shapes.
+
+The torchvision-parity classifier head (two 4096-wide dense layers on the
+7x7 feature map) is kept: those matmuls are where VGG's FLOPs live, and
+4096 is MXU-lane aligned. torchvision reaches the fixed 7x7 map with an
+adaptive average pool; the static-shape analog here average-pools whenever
+the post-conv map is a multiple of 7 (224, 448, ... inputs), so those
+resolutions share classifier shapes. Other resolutions flatten as-is —
+shapes are fixed at init, the XLA contract.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Union
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+# torchvision cfgs: ints are conv widths, "M" is 2x2 max-pool.
+_CFGS = {
+    11: (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    """Configurable VGG over NHWC inputs.
+
+    With ``batch_norm=True`` (default) apply like the ResNets plus a dropout
+    stream: ``model.apply({'params': p, 'batch_stats': s}, x, train=True,
+    mutable=['batch_stats'], rngs={'dropout': key})``; with
+    ``batch_norm=False`` there is no mutable state and ``train`` only gates
+    dropout. ``train=False`` (or ``dropout_rate=0``) needs no rngs.
+    """
+
+    cfg: Sequence[Union[int, str]]
+    num_classes: int = 1000
+    batch_norm: bool = True
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(
+            nn.Conv, kernel_size=(3, 3), use_bias=not self.batch_norm,
+            dtype=self.dtype, param_dtype=jnp.float32, padding="SAME",
+        )
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
+        )
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32)
+
+        x = x.astype(self.dtype)
+        for i, v in enumerate(self.cfg):
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = conv(v, name=f"conv_{i}")(x)
+                if self.batch_norm:
+                    x = norm(name=f"bn_{i}")(x)
+                x = nn.relu(x)
+        # static-shape analog of torchvision's AdaptiveAvgPool2d((7, 7)):
+        # inputs whose post-conv map is a multiple of 7 (224 -> 7, 448 -> 14)
+        # pool down to the canonical 7x7, sharing classifier shapes.
+        h, w = x.shape[1], x.shape[2]
+        if (h, w) != (7, 7) and h % 7 == 0 and w % 7 == 0:
+            x = nn.avg_pool(x, (h // 7, w // 7), strides=(h // 7, w // 7))
+        x = x.reshape((x.shape[0], -1))  # [b, 7*7*512] at 224^2 input
+        for j in range(2):
+            x = nn.relu(dense(4096, name=f"fc_{j}")(x))
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = dense(self.num_classes, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = partial(VGG, cfg=_CFGS[11])
+VGG16 = partial(VGG, cfg=_CFGS[16])
+VGG19 = partial(VGG, cfg=_CFGS[19])

@@ -34,7 +34,7 @@ import bluefog_tpu as bf
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet34", "resnet18", "mlp"])
+                   choices=["resnet50", "resnet34", "resnet18", "vgg16", "mlp"])
     p.add_argument("--batch-size", type=int, default=64,
                    help="per-chip batch size")
     p.add_argument("--num-warmup-batches", type=int, default=10)
@@ -58,7 +58,7 @@ def make_model(args):
         classes = 10
     else:
         cls = {"resnet50": bf.models.ResNet50, "resnet34": bf.models.ResNet34,
-               "resnet18": bf.models.ResNet18}[args.model]
+               "resnet18": bf.models.ResNet18, "vgg16": bf.models.VGG16}[args.model]
         model = cls(num_classes=1000, dtype=jnp.bfloat16)
         sample = jnp.zeros(
             (args.batch_size, args.image_size, args.image_size, 3), jnp.float32)
@@ -76,11 +76,20 @@ def main():
     variables = model.init(rng, sample, train=True)
 
     if has_bn:
+        # Dropout-bearing models (vgg16) train with their standard dropout
+        # active, like the reference harness. Folding a traced value into
+        # the key keeps mask generation inside the compiled step (a plain
+        # closed-over key is a compile-time constant XLA could fold away),
+        # so the measured compute matches a real training step.
+        use_dropout = args.model == "vgg16"
+
         def loss_fn(p, ms, batch):
             images, labels = batch
+            rngs = {"dropout": jax.random.fold_in(
+                jax.random.PRNGKey(1), labels[0])} if use_dropout else None
             logits, updates = model.apply(
                 {"params": p, "batch_stats": ms}, images, train=True,
-                mutable=["batch_stats"])
+                mutable=["batch_stats"], rngs=rngs)
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels).mean()
             return loss, (updates["batch_stats"], {})

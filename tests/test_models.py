@@ -45,3 +45,48 @@ def test_odd_input_rejected_by_s2d():
     x = jnp.zeros((1, 33, 33, 3), jnp.float32)
     with pytest.raises(Exception):
         model.init(jax.random.PRNGKey(0), x, train=True)
+
+
+def test_vgg_forward_bn_and_plain():
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    bn = models.VGG11(num_classes=10)
+    v = bn.init(jax.random.PRNGKey(0), x, train=True)
+    logits, updates = bn.apply(v, x, train=True, mutable=["batch_stats"],
+                               rngs={"dropout": jax.random.PRNGKey(1)})
+    assert logits.shape == (2, 10) and logits.dtype == jnp.float32
+    assert "batch_stats" in updates
+
+    plain = models.VGG11(num_classes=10, batch_norm=False)
+    v = plain.init(jax.random.PRNGKey(0), x, train=False)
+    assert "batch_stats" not in v
+    logits = plain.apply(v, x, train=False)
+    assert logits.shape == (2, 10)
+
+
+def test_vgg16_config_matches_torchvision_layout():
+    # config D: 13 convs + 3 dense; conv widths per stage 2,2,3,3,3
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    model = models.VGG16(num_classes=5, dropout_rate=0.0)
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    convs = [k for k in v["params"] if k.startswith("conv_")]
+    assert len(convs) == 13
+    widths = [v["params"][k]["kernel"].shape[-1] for k in sorted(
+        convs, key=lambda s: int(s.split("_")[1]))]
+    assert widths == [64, 64, 128, 128, 256, 256, 256, 512, 512, 512,
+                      512, 512, 512]
+    assert v["params"]["fc_0"]["kernel"].shape[-1] == 4096
+    assert v["params"]["head"]["kernel"].shape == (4096, 5)
+
+
+def test_vgg_resolution_portability_via_7x7_pool():
+    # 224-class resolutions (multiples of 7 post-conv) share classifier shapes
+    model = models.VGG11(num_classes=3, dropout_rate=0.0, batch_norm=False)
+    v224 = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)),
+                      train=False)
+    v448 = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 448, 448, 3)),
+                      train=False)
+    assert v224["params"]["fc_0"]["kernel"].shape == \
+        v448["params"]["fc_0"]["kernel"].shape == (7 * 7 * 512, 4096)
+    # params from one resolution apply at the other
+    out = model.apply(v224, jnp.zeros((1, 448, 448, 3)), train=False)
+    assert out.shape == (1, 3)
