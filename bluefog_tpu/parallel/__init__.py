@@ -11,6 +11,9 @@ makes that substrate a first-class capability of the rebuild:
   * ``ulysses_attention`` — all-to-all sequence parallelism: re-shard
     sequence -> heads, run dense local attention, re-shard back.
   * ``sequence_sharding`` — place [B, S, H, D] arrays sequence-sharded.
+
+Plus tensor parallelism (``tensor.py``): Megatron-style model sharding via
+GSPMD annotations over a 2-D (data, model) mesh.
 """
 
 from .context import (
@@ -23,6 +26,13 @@ from .context import (
 )
 from .flash import flash_attention, flash_block
 from .lm import cp_apply, cp_loss_fn
+from .tensor import (
+    LM_TP_RULES,
+    tp_apply,
+    tp_loss_fn,
+    tp_mesh,
+    tp_shard_params,
+)
 
 __all__ = [
     "flash_attention",
@@ -35,4 +45,9 @@ __all__ = [
     "sequence_sharding",
     "cp_apply",
     "cp_loss_fn",
+    "LM_TP_RULES",
+    "tp_apply",
+    "tp_loss_fn",
+    "tp_mesh",
+    "tp_shard_params",
 ]

@@ -1,0 +1,136 @@
+"""Tensor parallelism for the transformer LM — the GSPMD/scaling-book recipe.
+
+Net-new vs the reference (data-parallel only, SURVEY §2.6): shard the
+*model* dimension over a mesh axis. Unlike the explicitly-scheduled
+collectives elsewhere in this package (shard_map + ppermute, where the
+schedule IS the product), tensor parallelism on TPU is best expressed as
+sharding annotations: pick a 2-D ``(data, model)`` mesh, place each weight
+with a `NamedSharding`, and let XLA's SPMD partitioner insert the
+all-reduces — the canonical Megatron scheme falls out of the layout.
+
+The layout (`LM_TP_RULES`) is Megatron-style:
+
+  * ``qkv``/``up`` kernels   column-parallel  P(None, "model")
+  * ``out``/``down`` kernels row-parallel     P("model", None)
+    (XLA inserts one psum over "model" after each row-parallel matmul —
+    two per block, exactly Megatron's communication count)
+  * ``lm_head``              column-parallel  (vocab sharded)
+  * ``embed``                P(None, "model") (features sharded)
+  * norms                    replicated
+
+Composes with the rest of the stack: the batch dim rides the "data" axis
+(plain DP over that axis), and sequence parallelism (``cp_apply``) consumes
+a different mesh axis by design.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# path-regex -> spec for TransformerLM params (models/transformer.py).
+LM_TP_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*/(qkv|up)/kernel$", P(None, "model")),
+    (r".*/(out|down)/kernel$", P("model", None)),
+    (r".*lm_head/kernel$", P(None, "model")),
+    (r".*embed/embedding$", P(None, "model")),
+)
+
+
+def tp_mesh(n_data: int, n_model: int,
+            devices: Optional[Sequence] = None) -> Mesh:
+    """A 2-D ``(data, model)`` mesh over ``n_data * n_model`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices[: n_data * n_model])
+    if devices.size != n_data * n_model:
+        raise ValueError(
+            f"need {n_data * n_model} devices, have {devices.size}")
+    return Mesh(devices.reshape(n_data, n_model), ("data", "model"))
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def tp_shard_params(params, mesh: Mesh,
+                    rules: Sequence[Tuple[str, P]] = LM_TP_RULES):
+    """Place a param pytree on the mesh per the TP layout rules.
+
+    Leaves matching no rule are replicated. Matching leaves whose sharded
+    dimension does not divide the "model" axis size fall back to replicated
+    (correctness never depends on the hint).
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def place(path, x):
+        s = _path_str(path)
+        for pat, spec in compiled:
+            if pat.match(s):
+                ok = x.ndim >= len(spec) and all(
+                    ax is None or x.shape[d] % mesh.shape[ax] == 0
+                    for d, ax in enumerate(spec))
+                if ok:
+                    return jax.device_put(x, NamedSharding(mesh, spec))
+                break
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+@functools.lru_cache(maxsize=16)
+def _tp_forward(model, mesh: Mesh):
+    data_sh = NamedSharding(mesh, P("data"))
+
+    def fwd(params, tokens):
+        logits = model.apply({"params": params}, tokens)
+        return jax.lax.with_sharding_constraint(logits, data_sh)
+
+    return jax.jit(fwd)
+
+
+def tp_apply(model, params, tokens, mesh: Mesh):
+    """Forward pass with TP-sharded params and batch over the "data" axis.
+
+    ``params`` should come from :func:`tp_shard_params`; jit honors the
+    committed input shardings and the SPMD partitioner propagates them
+    through the matmuls, inserting the Megatron all-reduces.
+    """
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+    return _tp_forward(model, mesh)(params, tokens)
+
+
+def tp_loss_fn(model, mesh: Mesh):
+    """``loss_fn(params, (tokens, targets)) -> loss`` under the TP layout.
+
+    Differentiate directly. For layout-stable training steps, pin the
+    gradient shardings to the param shardings::
+
+        out_sh = jax.tree.map(lambda p: p.sharding, params)
+        grads = jax.jit(jax.grad(loss_fn), out_shardings=out_sh)(params, batch)
+
+    (without the pin, XLA may choose different output layouts per compile).
+    """
+
+    data_sh = NamedSharding(mesh, P("data"))
+
+    def loss_fn(params, batch):
+        tokens, targets = batch
+        # keep the batch on the data axis (an unconstrained batch is free to
+        # replicate across the whole mesh under the partitioner)
+        tokens = jax.lax.with_sharding_constraint(tokens, data_sh)
+        targets = jax.lax.with_sharding_constraint(targets, data_sh)
+        logits = model.apply({"params": params}, tokens)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return -jnp.mean(ll)
+
+    return loss_fn
