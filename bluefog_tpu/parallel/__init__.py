@@ -13,7 +13,8 @@ makes that substrate a first-class capability of the rebuild:
   * ``sequence_sharding`` — place [B, S, H, D] arrays sequence-sharded.
 
 Plus tensor parallelism (``tensor.py``): Megatron-style model sharding via
-GSPMD annotations over a 2-D (data, model) mesh.
+GSPMD annotations over a 2-D (data, model) mesh; and pipeline parallelism
+(``pipeline.py``): GPipe microbatching with ppermute stage handoffs.
 """
 
 from .context import (
@@ -26,6 +27,13 @@ from .context import (
 )
 from .flash import flash_attention, flash_block
 from .lm import cp_apply, cp_loss_fn
+from .pipeline import (
+    pp_apply,
+    pp_forward_fn,
+    pp_mesh,
+    pp_place_params,
+    pp_stack_params,
+)
 from .tensor import (
     LM_TP_RULES,
     tp_apply,
@@ -50,4 +58,9 @@ __all__ = [
     "tp_loss_fn",
     "tp_mesh",
     "tp_shard_params",
+    "pp_apply",
+    "pp_forward_fn",
+    "pp_place_params",
+    "pp_mesh",
+    "pp_stack_params",
 ]

@@ -1,0 +1,182 @@
+"""Pipeline parallelism — GPipe microbatching over a "pipe" mesh axis.
+
+Net-new vs the reference (data-parallel only, SURVEY §2.6). The TPU-native
+shape of pipeline parallelism: transformer blocks are *stage-stacked* (the
+same rank-stacked idiom the collectives use — leaf ``x[s]`` is stage s's
+layer chunk, sharded one stage per device), activations hand off between
+stages with one ``lax.ppermute`` per tick, and the whole GPipe schedule
+(fill, steady state, drain — M + S - 1 ticks for M microbatches over S
+stages) is a single ``lax.scan`` inside one compiled program. Every stage
+runs the same SPMD code; "stage 0 ingests" / "last stage records" are
+``lax.select`` on ``axis_index``, not control flow.
+
+Embedding, final norm, and the LM head are replicated and run outside the
+pipelined block stack (they are a few percent of the FLOPs; the block stack
+is the memory that forces pipelining).
+
+Exact by construction: the pipeline computes the same composition of blocks
+as the dense model, so tests assert equality with the single-device oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .context import _pvary, reference_attention
+
+
+def pp_mesh(n_stages: int, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D ``("pipe",)`` mesh over ``n_stages`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n_stages:
+        raise ValueError(f"need {n_stages} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_stages]), ("pipe",))
+
+
+def pp_stack_params(params, n_stages: int):
+    """Split TransformerLM params into (stage-stacked blocks, shared rest).
+
+    ``params["block_i"]`` subtrees are stacked along a new leading stage
+    axis as ``[n_stages, layers_per_stage, ...]`` leaves; everything else
+    (embed, final_norm, lm_head) is returned as-is for the replicated
+    prologue/epilogue.
+    """
+    blocks = sorted(
+        (k for k in params if k.startswith("block_")),
+        key=lambda k: int(k.split("_")[1]))
+    n_layers = len(blocks)
+    if n_layers == 0 or n_layers % n_stages:
+        raise ValueError(
+            f"num_layers {n_layers} must be a positive multiple of "
+            f"n_stages {n_stages}")
+    per = n_layers // n_stages
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves).reshape(
+            (n_stages, per) + leaves[0].shape),
+        *[params[k] for k in blocks])
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    return stacked, rest
+
+
+@functools.lru_cache(maxsize=16)
+def _pp_fn(model, mesh: Mesh, n_stages: int, n_micro: int):
+    # deferred: models.transformer imports parallel.context at package
+    # import time, so a top-level import here would be circular
+    from ..models.transformer import Block
+
+    block = Block(
+        model.num_heads, model.d_ff, model.dtype,
+        model.attn_fn or functools.partial(reference_attention, causal=True))
+
+    def per_stage(stage_params, mb_acts, positions):
+        # stage_params: [1, per, ...] this stage's layer chunk
+        # mb_acts:      [n_micro, mb, seq, d_model] (replicated)
+        me = lax.axis_index("pipe")
+        sp = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+
+        def apply_chunk(x):
+            def body(h, p):
+                return block.apply({"params": p}, h, positions), None
+            out, _ = lax.scan(body, x, sp)
+            return out
+
+        zero = jnp.zeros_like(mb_acts[0])
+        outputs = jnp.zeros_like(mb_acts)
+
+        def tick(carry, t):
+            x_cur, outputs = carry
+            y = apply_chunk(x_cur)
+            # last stage records microbatch t-(S-1) when it has drained
+            idx = t - (n_stages - 1)
+            rec = lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(idx, 0, n_micro - 1), axis=0)
+            outputs = jnp.where(
+                jnp.logical_and(me == n_stages - 1, idx >= 0), rec, outputs)
+            # hand y to the next stage; stage 0's incoming slot is fed the
+            # next microbatch instead (the wrap-around edge carries garbage)
+            nxt = lax.ppermute(
+                y, "pipe", [(s, (s + 1) % n_stages) for s in range(n_stages)])
+            ingest = lax.dynamic_index_in_dim(
+                mb_acts, jnp.clip(t + 1, 0, n_micro - 1), axis=0,
+                keepdims=False)
+            x_next = jnp.where(me == 0,
+                               jnp.where(t + 1 < n_micro, ingest, zero), nxt)
+            return (x_next, outputs), None
+
+        x0 = jnp.where(me == 0, mb_acts[0], zero)  # varying via me
+        # the replicated zero-init output buffer becomes stage-varying
+        # inside the loop; declare it up front so the scan carry types match
+        outputs = _pvary(outputs, ("pipe",))
+        (_, outputs), _ = lax.scan(
+            tick, (x0, outputs), jnp.arange(n_micro + n_stages - 1))
+        # replicate the recorded outputs off the last stage
+        return lax.psum(
+            jnp.where(me == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            "pipe")
+
+    spec_stage = P("pipe")
+    mapped = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(spec_stage, P(), P()),
+        out_specs=P(),
+    )
+
+    import flax.linen as nn
+    emb_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype,
+                       param_dtype=jnp.float32)
+    norm_mod = nn.RMSNorm(dtype=model.dtype, param_dtype=jnp.float32)
+    head_mod = nn.Dense(model.vocab_size, dtype=model.dtype,
+                        param_dtype=jnp.float32, use_bias=False)
+
+    def fwd(stacked_blocks, rest, tokens):
+        b, seq = tokens.shape
+        if b % n_micro:
+            raise ValueError(
+                f"batch {b} must divide into {n_micro} microbatches")
+        positions = jnp.arange(seq)
+        x = emb_mod.apply({"params": rest["embed"]}, tokens)
+        mb = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+        out = mapped(stacked_blocks, mb, positions)
+        x = out.reshape((b, seq, out.shape[-1]))
+        x = norm_mod.apply({"params": rest["final_norm"]}, x)
+        logits = head_mod.apply({"params": rest["lm_head"]}, x)
+        return logits.astype(jnp.float32)
+
+    return jax.jit(fwd)
+
+
+def pp_forward_fn(model, mesh: Mesh, n_micro: int = 2):
+    """Compiled pipelined forward: ``fwd(stacked_blocks, rest, tokens)``.
+
+    The step-over-step training path: stage-stack and place the params ONCE
+    (:func:`pp_stack_params` + :func:`pp_place_params`), then call the
+    returned function every step without restacking.
+    """
+    return _pp_fn(model, mesh, mesh.shape["pipe"], n_micro)
+
+
+def pp_place_params(stacked, mesh: Mesh):
+    """Put a stage-stacked block tree on the mesh, one stage per device."""
+    return jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
+
+
+def pp_apply(model, params, tokens, mesh: Mesh, n_micro: int = 2):
+    """One-shot pipelined forward: GPipe schedule over the "pipe" axis.
+
+    ``params`` is the plain TransformerLM param dict; it is stage-stacked
+    and placed on every call — convenient for evaluation. For training
+    loops use :func:`pp_forward_fn` with pre-placed params.
+    """
+    n_stages = mesh.shape["pipe"]
+    stacked, rest = pp_stack_params(params, n_stages)
+    return pp_forward_fn(model, mesh, n_micro)(
+        pp_place_params(stacked, mesh), rest, tokens)
