@@ -12,9 +12,11 @@ makes that substrate a first-class capability of the rebuild:
     sequence -> heads, run dense local attention, re-shard back.
   * ``sequence_sharding`` — place [B, S, H, D] arrays sequence-sharded.
 
-Plus tensor parallelism (``tensor.py``): Megatron-style model sharding via
-GSPMD annotations over a 2-D (data, model) mesh; and pipeline parallelism
-(``pipeline.py``): GPipe microbatching with ppermute stage handoffs.
+Plus the rest of the parallelism axes: tensor parallelism (``tensor.py``,
+Megatron layout via GSPMD annotations over a 2-D (data, model) mesh),
+pipeline parallelism (``pipeline.py``, GPipe microbatching with ppermute
+stage handoffs), and expert parallelism (``expert.py``, Switch MoE with
+all_to_all dispatch).
 """
 
 from .context import (
@@ -24,6 +26,13 @@ from .context import (
     sequence_sharding,
     ulysses_attention,
     ulysses_attention_shard,
+)
+from .expert import (
+    SwitchFFN,
+    ep_apply,
+    ep_mesh,
+    ep_place_params,
+    load_balance_loss,
 )
 from .flash import flash_attention, flash_block
 from .lm import cp_apply, cp_loss_fn
@@ -63,4 +72,9 @@ __all__ = [
     "pp_place_params",
     "pp_mesh",
     "pp_stack_params",
+    "SwitchFFN",
+    "ep_apply",
+    "ep_place_params",
+    "ep_mesh",
+    "load_balance_loss",
 ]

@@ -169,6 +169,18 @@ def sequence_sharding(mesh: Mesh, axis: str = "rank") -> NamedSharding:
     return NamedSharding(mesh, P(None, axis))
 
 
+def mesh_1d(n: int, axis: str, devices=None) -> Mesh:
+    """A 1-D mesh of ``n`` devices under the given axis name (shared by the
+    pipe/expert mesh builders)."""
+    import numpy as _np
+
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    return Mesh(_np.asarray(devices[:n]), (axis,))
+
+
 @functools.lru_cache(maxsize=32)
 def _cp_fn(mesh: Mesh, axis: str, causal: bool, kind: str,
            use_flash: bool = False, interpret: bool = False):

@@ -1,0 +1,168 @@
+"""Expert parallelism — Switch-style MoE with all_to_all dispatch.
+
+Net-new vs the reference (data-parallel only, SURVEY §2.6). The GShard/
+Switch recipe in its TPU-native form: one expert FFN per device along an
+"expert" mesh axis, top-1 gating, capacity-bounded dispatch expressed as
+static-shape einsums, and exactly two ``lax.all_to_all`` hops per layer
+(tokens to their expert, results back). Everything is static shapes — the
+capacity bound C is what makes data-dependent routing compile.
+
+Semantics (standard Switch): each token goes to its top-scoring expert,
+scaled by the gate probability; tokens beyond an expert's capacity are
+dropped (output zero) — choose ``capacity_factor >= num_experts`` to make
+dropping impossible, which is how the exactness tests pin the SPMD path to
+the dense oracle (``SwitchFFN``'s plain ``__call__``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def ep_mesh(n_experts: int, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D ``("expert",)`` mesh over ``n_experts`` devices."""
+    from .context import mesh_1d
+    return mesh_1d(n_experts, "expert", devices)
+
+
+class SwitchFFN(nn.Module):
+    """Mixture-of-experts FFN, top-1 (Switch) routing.
+
+    ``__call__`` is the dense single-device oracle: it evaluates every
+    expert on every token and selects with a one-hot — O(E) FLOPs, used for
+    init, small models, and as the correctness reference for
+    :func:`ep_apply`, which computes the same function sparsely across the
+    expert mesh.
+    """
+
+    num_experts: int
+    d_ff: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        gate = self.param("gate", nn.initializers.lecun_normal(),
+                          (d, self.num_experts), jnp.float32)
+        up = self.param("up", nn.initializers.lecun_normal(),
+                        (self.num_experts, d, self.d_ff), jnp.float32)
+        down = self.param("down", nn.initializers.lecun_normal(),
+                          (self.num_experts, self.d_ff, d), jnp.float32)
+        x = x.astype(self.dtype)
+        probs = jax.nn.softmax(
+            (x @ gate.astype(self.dtype)).astype(jnp.float32), axis=-1)
+        best = jnp.argmax(probs, axis=-1)                       # [..,]
+        sel = jax.nn.one_hot(best, self.num_experts, dtype=self.dtype)
+        h = jnp.einsum("...d,edf->...ef", x, up.astype(self.dtype))
+        h = nn.gelu(h)
+        y = jnp.einsum("...ef,efd->...ed", h, down.astype(self.dtype))
+        p_best = jnp.max(probs, axis=-1).astype(self.dtype)
+        out = jnp.einsum("...ed,...e->...d", y, sel) * p_best[..., None]
+        return out.astype(x.dtype)
+
+
+def load_balance_loss(probs, best, num_experts: int):
+    """Switch aux loss: ``E * sum_e f_e * P_e`` (Fedus et al. 2021, eq. 4)."""
+    f = jnp.mean(jax.nn.one_hot(best, num_experts, dtype=jnp.float32),
+                 axis=tuple(range(best.ndim)))
+    pbar = jnp.mean(probs, axis=tuple(range(probs.ndim - 1)))
+    return num_experts * jnp.sum(f * pbar)
+
+
+@functools.lru_cache(maxsize=16)
+def _ep_fn(mesh: Mesh, num_experts: int, capacity: int, dtype):
+    def per_device(gate, up, down, x):
+        # gate [d, E] replicated; up [1, d, d_ff] / down [1, d_ff, d] = this
+        # device's expert; x [b_local, s, d] = this device's tokens.
+        b, s, d = x.shape
+        t = b * s
+        xt = x.reshape(t, d).astype(dtype)
+        probs = jax.nn.softmax(
+            (xt @ gate.astype(dtype)).astype(jnp.float32), axis=-1)
+        best = jnp.argmax(probs, axis=-1)                        # [t]
+        p_best = jnp.max(probs, axis=-1).astype(dtype)
+        sel = jax.nn.one_hot(best, num_experts, dtype=jnp.int32)  # [t, E]
+        # position of each token within its expert's send buffer
+        pos = jnp.cumsum(sel, axis=0) * sel - 1                   # [t, E]
+        keep = (pos < capacity) & (sel > 0)
+        # dispatch[t, e, c]: token t occupies slot c of the buffer to e
+        disp = keep[..., None] & (
+            jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                           dtype=jnp.int32) > 0)
+        disp = disp.astype(dtype)                                 # [t, E, C]
+        send = jnp.einsum("tec,td->ecd", disp, xt)                # [E, C, d]
+        # tokens to their expert: device e receives one [C, d] block per peer
+        recv = lax.all_to_all(send, "expert", split_axis=0, concat_axis=0,
+                              tiled=True)                         # [E, C, d]
+        h = nn.gelu(jnp.einsum("ncd,df->ncf", recv, up[0].astype(dtype)))
+        y = jnp.einsum("ncf,fd->ncd", h, down[0].astype(dtype))   # [E, C, d]
+        # results back to the token-owning devices
+        back = lax.all_to_all(y, "expert", split_axis=0, concat_axis=0,
+                              tiled=True)                         # [E, C, d]
+        out = jnp.einsum("tec,ecd->td", disp, back) * p_best[:, None]
+        aux = load_balance_loss(probs, best, num_experts)
+        return out.reshape(b, s, d).astype(x.dtype), aux[None]
+
+    mapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P("expert"), P("expert"), P("expert")),
+        out_specs=(P("expert"), P("expert")),
+    )
+    return jax.jit(lambda g, u, dn, x: mapped(g, u, dn, x))
+
+
+def ep_place_params(params, mesh: Mesh):
+    """Place a SwitchFFN param dict on the expert mesh ONCE (gate
+    replicated, up/down one expert per device); re-placing already-placed
+    arrays is a no-op, so training loops can pass the result to
+    :func:`ep_apply` every step without transfers."""
+    return {
+        "gate": jax.device_put(params["gate"], NamedSharding(mesh, P())),
+        "up": jax.device_put(params["up"], NamedSharding(mesh, P("expert"))),
+        "down": jax.device_put(params["down"],
+                               NamedSharding(mesh, P("expert"))),
+    }
+
+
+def ep_apply(params, x, mesh: Mesh, capacity_factor: float = 2.0,
+             dtype=None) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel SwitchFFN forward.
+
+    ``params`` is a :class:`SwitchFFN` param dict (``gate``/``up``/``down``)
+    with ``num_experts == mesh.shape["expert"]``; ``x`` is ``[B, S, d]``
+    with B divisible by the expert-axis size (tokens ride the same devices
+    as experts, the standard DP+EP co-location). Returns ``(y, aux)`` where
+    ``aux`` is the per-device Switch load-balance loss ``[n]``.
+
+    ``dtype`` is the compute dtype and must match the ``SwitchFFN.dtype``
+    used as the oracle (default: ``x.dtype``, which equals the module
+    default of float32 for float32 inputs).
+
+    Capacity per expert and source device is
+    ``ceil(capacity_factor * local_tokens / num_experts)``; overflowed
+    tokens get zero output (Switch semantics). ``capacity_factor >=
+    num_experts`` guarantees no drops.
+    """
+    n = mesh.shape["expert"]
+    if params["up"].shape[0] != n:
+        raise ValueError(
+            f"params have {params['up'].shape[0]} experts but the mesh "
+            f"axis is {n}")
+    b, s, d = x.shape
+    if b % n:
+        raise ValueError(f"batch {b} must divide the expert axis size {n}")
+    local_tokens = (b // n) * s
+    capacity = int(np.ceil(capacity_factor * local_tokens / n))
+    placed = ep_place_params(params, mesh)
+    x = jax.device_put(x, NamedSharding(mesh, P("expert")))
+    return _ep_fn(mesh, n, capacity, jnp.dtype(dtype or x.dtype).name)(
+        placed["gate"], placed["up"], placed["down"], x)

@@ -35,11 +35,8 @@ from .context import _pvary, reference_attention
 
 def pp_mesh(n_stages: int, devices: Optional[Sequence] = None) -> Mesh:
     """A 1-D ``("pipe",)`` mesh over ``n_stages`` devices."""
-    if devices is None:
-        devices = jax.devices()
-    if len(devices) < n_stages:
-        raise ValueError(f"need {n_stages} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:n_stages]), ("pipe",))
+    from .context import mesh_1d
+    return mesh_1d(n_stages, "pipe", devices)
 
 
 def pp_stack_params(params, n_stages: int):
@@ -130,6 +127,10 @@ def _pp_fn(model, mesh: Mesh, n_stages: int, n_micro: int):
         out_specs=P(),
     )
 
+    # Mirrors TransformerLM.__call__'s prologue/epilogue (same modules, same
+    # param keys). The coupling is pinned loudly, not silently: every
+    # pp-vs-oracle exactness test compares against TransformerLM.apply, so a
+    # structural change there fails tests until this mirror is updated.
     import flax.linen as nn
     emb_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype,
                        param_dtype=jnp.float32)
