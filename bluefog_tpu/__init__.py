@@ -45,7 +45,7 @@ from .runtime.state import (
 from .runtime.handles import poll, synchronize, wait
 
 # failure detection / coordinated shutdown (multi-controller)
-from .runtime.heartbeat import shutdown_requested
+from .runtime.heartbeat import dead_controllers, shutdown_requested
 
 # timeline
 from .runtime.timeline import (

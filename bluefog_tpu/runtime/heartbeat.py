@@ -194,3 +194,19 @@ def shutdown_requested() -> bool:
 
     mon = _global_state().peer_monitor
     return bool(mon is not None and mon.shutdown_seen)
+
+
+def dead_controllers() -> set:
+    """Controller process indexes whose heartbeats have gone silent.
+
+    A peer lands here after ``BLUEFOG_HEARTBEAT_TIMEOUT`` seconds without a
+    counter advance — a *crash* signal (no coordinated announce), the
+    cross-process analog of the reference's missing-rank stall report
+    (operations.cc:387-432). Training loops can poll this alongside
+    :func:`shutdown_requested` to abandon collectives that would hang on
+    the departed peer. Empty in single-controller jobs.
+    """
+    from .state import _global_state
+
+    mon = _global_state().peer_monitor
+    return mon.dead_peers() if mon is not None else set()

@@ -1,0 +1,55 @@
+"""Child program for the peer-crash fault-injection test.
+
+Launched (twice) by tests/test_launcher.py::test_peer_crash_detected via
+``bfrun -np 2 --coordinator ...``. Process 1 hard-crashes mid-job
+(``os._exit`` — no announce, no atexit, the SIGKILL shape of failure);
+process 0 must DETECT the silent death through the heartbeat monitor
+(``bf.dead_controllers()``) within the configured timeout instead of
+hanging in a collective, then leave without waiting on the corpse.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+import bluefog_tpu as bf
+
+
+def main() -> None:
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert bf.size() == 4, bf.size()
+
+    # both controllers do one real cross-process collective first, proving
+    # the job was healthy before the injected fault
+    x = bf.shard_rank_stacked(bf.mesh(), np.ones((4, 2), np.float32))
+    y = bf.allreduce(x)
+    jax.block_until_ready(y)
+    print(f"HEALTHY {pid}", flush=True)
+
+    if pid == 1:
+        # the fault: die silently — no announce_shutdown, no atexit hooks
+        os._exit(17)
+
+    # survivor: poll the failure detector (never a collective — that would
+    # hang on the corpse, which is exactly what detection exists to avoid)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if bf.dead_controllers() == {1}:
+            print("SURVIVOR_DETECTED 1", flush=True)
+            # skip graceful teardown: jax.distributed barriers would block
+            # on the dead peer; detection IS the deliverable here
+            os._exit(0)
+        assert not bf.shutdown_requested(), \
+            "crash must be detected as a DEAD peer, not a coordinated shutdown"
+        time.sleep(0.1)
+    print("SURVIVOR_TIMEOUT", flush=True)
+    os._exit(3)
+
+
+if __name__ == "__main__":
+    main()

@@ -100,28 +100,15 @@ def test_simulate_16_ranks():
     assert "RANKS16_OK" in out.stdout
 
 
-@pytest.mark.slow
-def test_two_process_launch_smoke(tmp_path):
-    """bfrun -np 2 --coordinator: the full multi-controller bootstrap.
-
-    Asserts (in the children, tests/_launch_child.py): distributed init,
-    size/rank/local_size/local_rank truthfulness, cross-process allreduce +
-    ring neighbor_allreduce + hierarchical correctness, windows on global
-    arrays, a coordinated orbax checkpoint round-trip, and control-plane
-    fetch_add/barrier.
-    """
+def _launch_pair(child_script: str, env):
+    """Run a 2-process bfrun job of ``child_script``; return (procs, outs)."""
     port = _free_port()
-    env = _scrubbed_env()
-    env["SMOKE_CKPT_DIR"] = str(tmp_path / "ck")
-    # fast heartbeat cadence so the coordinated-shutdown observation at the
-    # end of the child doesn't wait out the default 5 s interval
-    env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.3"
 
     def cmd(i):
         return [sys.executable, "-m", "bluefog_tpu.launcher", "-np", "2",
                 "--coordinator", f"127.0.0.1:{port}", "--process-id", str(i),
                 "--simulate", "2",
-                "--", sys.executable, str(TESTS / "_launch_child.py")]
+                "--", sys.executable, str(TESTS / child_script)]
 
     procs = [subprocess.Popen(cmd(i), env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True)
@@ -135,6 +122,42 @@ def test_two_process_launch_smoke(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return procs, outs
+
+
+@pytest.mark.slow
+def test_two_process_launch_smoke(tmp_path):
+    """bfrun -np 2 --coordinator: the full multi-controller bootstrap.
+
+    Asserts (in the children, tests/_launch_child.py): distributed init,
+    size/rank/local_size/local_rank truthfulness, cross-process allreduce +
+    ring neighbor_allreduce + hierarchical correctness, windows on global
+    arrays, a coordinated orbax checkpoint round-trip, and control-plane
+    fetch_add/barrier.
+    """
+    env = _scrubbed_env()
+    env["SMOKE_CKPT_DIR"] = str(tmp_path / "ck")
+    # fast heartbeat cadence so the coordinated-shutdown observation at the
+    # end of the child doesn't wait out the default 5 s interval
+    env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.3"
+    procs, outs = _launch_pair("_launch_child.py", env)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
         assert f"CHILD_OK {i}" in out
+
+
+@pytest.mark.slow
+def test_peer_crash_detected():
+    """Fault injection: a controller dies silently; the survivor's heartbeat
+    monitor reports it as a DEAD peer (bf.dead_controllers()) instead of a
+    coordinated shutdown, within the configured timeout. SURVEY §5.3: the
+    reference only *warns* about missing ranks; this asserts the detection
+    end-to-end across real processes."""
+    env = _scrubbed_env()
+    env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.2"
+    env["BLUEFOG_HEARTBEAT_TIMEOUT"] = "1.5"
+    procs, outs = _launch_pair("_fault_child.py", env)
+    assert procs[1].returncode == 17, f"faulty process:\n{outs[1]}"
+    assert procs[0].returncode == 0, f"survivor failed:\n{outs[0]}"
+    assert "SURVIVOR_DETECTED 1" in outs[0]
+    assert "HEALTHY 0" in outs[0] and "HEALTHY 1" in outs[1]
