@@ -1,0 +1,42 @@
+# Test/bench targets, the analog of the reference's Makefile (whose targets
+# wrap pytest under mpirun; here the multi-process harness is the 8-device
+# CPU-simulated mesh — see tests/conftest.py and SURVEY.md §4).
+
+PYTEST      = python -m pytest
+MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
+        test_launcher bench dryrun native
+
+test:            ## full suite (slow: ~1 h on a shared-core CPU mesh)
+	$(PYTEST) tests/ -q
+
+test_fast:       ## quick subset (skips @slow)
+	$(PYTEST) tests/ -q -m "not slow"
+
+# per-area targets mirroring the reference's test_torch_ops / test_torch_win_ops / ...
+test_ops:
+	$(PYTEST) tests/test_ops.py tests/test_basics.py tests/test_topology.py -q
+
+test_win_ops:
+	$(PYTEST) tests/test_win_ops.py -q
+
+test_optimizers:
+	$(PYTEST) tests/test_optimizers.py tests/test_optimization.py -q
+
+test_parallel:
+	$(PYTEST) tests/test_parallel.py tests/test_transformer_cp.py \
+	    tests/test_tensor_parallel.py tests/test_pipeline_parallel.py \
+	    tests/test_expert_parallel.py tests/test_flash.py -q
+
+test_launcher:
+	$(PYTEST) tests/test_launcher.py tests/test_heartbeat.py -q
+
+bench:           ## headline benchmark on the default backend (real chip)
+	python bench.py
+
+dryrun:          ## multi-chip sharding validation on the simulated mesh
+	$(MESH_ENV) python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+native:          ## build the native runtime extension
+	bash csrc/build.sh

@@ -48,19 +48,22 @@ def main() -> None:
     p.add_argument("--aux-weight", type=float, default=0.01)
     args = p.parse_args()
 
-    E, d, d_ff, classes = args.experts, 16, 64, 8
-    if (classes * 64) % E:
-        raise SystemExit(
-            f"--experts {E} must divide the {classes * 64}-token dataset "
-            f"(try 2, 4, 8, 16, ...)")
+    E, d, d_ff, classes, per = args.experts, 16, 64, 8, 64
     devices = jax.devices()
     if len(devices) < E:  # forced-CPU simulation: the default backend may
         devices = jax.devices("cpu")  # be a single real chip
+    tokens = classes * per
+    if tokens % E or len(devices) < E:
+        usable = [e for e in (2, 4, 8, 16, 32)
+                  if tokens % e == 0 and e <= len(devices)]
+        raise SystemExit(
+            f"--experts {E} needs to divide the {tokens}-token dataset and "
+            f"fit the {len(devices)} available devices (try {usable})")
     mesh = bfp.ep_mesh(E, devices)
     print(f"experts: {E} on {mesh.devices.flat[0].platform}")
 
     key = jax.random.PRNGKey(0)
-    x, y = make_data(key, n_clusters=classes, d=d)
+    x, y = make_data(key, n_clusters=classes, per=per, d=d)
     # [B, S, d] layout with B divisible by the expert axis
     x = x.reshape(E, -1, d)
     y = y.reshape(E, -1)
