@@ -37,40 +37,75 @@ except ImportError:  # pragma: no cover
 _NEG = -1e30
 
 
+def _tile(s: int, candidates) -> int:
+    """Largest candidate tile evenly dividing s (1 is always a candidate)."""
+    return next(t for t in candidates if s % t == 0)
+
+
 def _q_tile(sq: int) -> int:
-    for t in (256, 128, 64, 32, 16, 8, 4, 2, 1):
-        if sq % t == 0:
-            return t
-    return 1
+    return _tile(sq, (256, 128, 64, 32, 16, 8, 4, 2, 1))
+
+
+def _k_tile(sk: int) -> int:
+    # bound the [TQ, TK] f32 score tile (+ K/V tiles) well inside VMEM:
+    # holding the whole K/V block per kernel invocation overflows the 16 MB
+    # scoped limit past S~4k
+    return _tile(sk, (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1))
 
 
 def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
             causal: bool, scale: float):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale      # [TQ, D]
-    k = k_ref[0].astype(jnp.float32)              # [Sk, D]
-    v = v_ref[0].astype(jnp.float32)
-    tq, sk = q.shape[0], k.shape[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    kj = pl.program_id(2)
+    tq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+
+    # the K dimension iterates innermost over the same output block, so the
+    # out refs double as the online-softmax running state
+    @pl.when(kj == 0)
+    def _init():
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+        m_ref[0] = jnp.full_like(m_ref[0], _NEG)
+        l_ref[0] = jnp.zeros_like(l_ref[0])
+
+    def body():
+        q = q_ref[0].astype(jnp.float32) * scale      # [TQ, D]
+        k = k_ref[0].astype(jnp.float32)              # [TK, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = offs_ref[0] + qi * tq + jax.lax.broadcasted_iota(
+                jnp.int32, (tq, tk), 0)
+            k_pos = offs_ref[1] + kj * tk + jax.lax.broadcasted_iota(
+                jnp.int32, (tq, tk), 1)
+            allowed = q_pos >= k_pos
+            s = jnp.where(allowed, s, _NEG)
+        m_prev = m_ref[0][:, 0]                       # [TQ]
+        l_prev = l_ref[0][:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)               # 0 on the first block
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(allowed, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        o_ref[0] = alpha[:, None] * o_ref[0] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # m/l carry a size-8 lane dim purely for TPU tiling (sublane x lane
+        # constraints); consumers read lane 0.
+        m_ref[0] = jnp.broadcast_to(m_new[:, None], (tq, 8))
+        l_ref[0] = jnp.broadcast_to(l_new[:, None], (tq, 8))
+
     if causal:
-        q_pos = offs_ref[0] + qi * tq + jax.lax.broadcasted_iota(
-            jnp.int32, (tq, sk), 0)
-        k_pos = offs_ref[1] + jax.lax.broadcasted_iota(jnp.int32, (tq, sk), 1)
-        allowed = q_pos >= k_pos
-        s = jnp.where(allowed, s, _NEG)
-    m = jnp.max(s, axis=-1)                       # [TQ]
-    p = jnp.exp(s - m[:, None])
-    if causal:
-        p = jnp.where(allowed, p, 0.0)
-    l = jnp.sum(p, axis=-1)                       # [TQ]
-    o = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    o_ref[0] = o
-    # m/l carry a size-8 lane dim purely for TPU tiling (sublane x lane
-    # constraints); consumers read lane 0.
-    m_ref[0] = jnp.broadcast_to(m[:, None], (tq, 8))
-    l_ref[0] = jnp.broadcast_to(l[:, None], (tq, 8))
+        # skip k-blocks that lie entirely in the future of this q tile
+        # (~half the grid for single-device causal attention)
+        live = (offs_ref[1] + kj * tk
+                <= offs_ref[0] + qi * tq + tq - 1)
+        pl.when(live)(body)
+    else:
+        body()
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "interpret"))
@@ -91,8 +126,9 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
     def bhsd(x):  # [B, S, H, D] -> [B*H, S, D]
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
 
+    tk = _k_tile(Sk)
     offs = jnp.asarray([q_off, k_off], jnp.int32)
-    grid = (B * H, Sq // tq)
+    grid = (B * H, Sq // tq, Sk // tk)
     kernel = functools.partial(_kernel, causal=causal, scale=scale)
     # Inside shard_map the inputs carry varying-mesh-axes (vma) metadata and
     # pallas_call requires out_shape to declare the same — without it the
@@ -112,14 +148,14 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, tq, D), lambda bh, qi, offs: (bh, qi, 0)),
-                pl.BlockSpec((1, Sk, D), lambda bh, qi, offs: (bh, 0, 0)),
-                pl.BlockSpec((1, Sk, D), lambda bh, qi, offs: (bh, 0, 0)),
+                pl.BlockSpec((1, tq, D), lambda bh, qi, kj, offs: (bh, qi, 0)),
+                pl.BlockSpec((1, tk, D), lambda bh, qi, kj, offs: (bh, kj, 0)),
+                pl.BlockSpec((1, tk, D), lambda bh, qi, kj, offs: (bh, kj, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, tq, D), lambda bh, qi, offs: (bh, qi, 0)),
-                pl.BlockSpec((1, tq, 8), lambda bh, qi, offs: (bh, qi, 0)),
-                pl.BlockSpec((1, tq, 8), lambda bh, qi, offs: (bh, qi, 0)),
+                pl.BlockSpec((1, tq, D), lambda bh, qi, kj, offs: (bh, qi, 0)),
+                pl.BlockSpec((1, tq, 8), lambda bh, qi, kj, offs: (bh, qi, 0)),
+                pl.BlockSpec((1, tq, 8), lambda bh, qi, kj, offs: (bh, qi, 0)),
             ],
         )
         o, m, l = pl.pallas_call(

@@ -56,9 +56,13 @@ def main() -> None:
     if tokens % E or len(devices) < E:
         usable = [e for e in (2, 4, 8, 16, 32)
                   if tokens % e == 0 and e <= len(devices)]
+        hint = f"try --experts {usable}" if usable else (
+            "run under JAX_PLATFORMS='' "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8 for a "
+            "simulated 8-device mesh")
         raise SystemExit(
             f"--experts {E} needs to divide the {tokens}-token dataset and "
-            f"fit the {len(devices)} available devices (try {usable})")
+            f"fit the {len(devices)} available devices ({hint})")
     mesh = bfp.ep_mesh(E, devices)
     print(f"experts: {E} on {mesh.devices.flat[0].platform}")
 
