@@ -158,9 +158,14 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
                 pl.BlockSpec((1, tq, 8), lambda bh, qi, kj, offs: (bh, qi, 0)),
             ],
         )
+        # bh/qi grid dims are independent (parallel); kj is the sequential
+        # online-softmax accumulation and must stay "arbitrary"
+        params = {} if interpret else {
+            "compiler_params": pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"))}
         o, m, l = pl.pallas_call(
             kernel, grid_spec=grid_spec, out_shape=out_shape,
-            interpret=interpret,
+            interpret=interpret, **params,
         )(offs, bhsd(q), bhsd(k), bhsd(v))
     else:  # pragma: no cover - pltpu always importable in this image
         raise RuntimeError("pallas TPU backend unavailable")
@@ -171,8 +176,84 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
     return sbhd(o), sbhd(m)[..., 0], sbhd(l)[..., 0]
 
 
-def flash_attention(q, k, v, *, causal: bool = True,
-                    interpret: bool = False):
-    """Single-device flash attention over [B, S, H, D] (normalized output)."""
+def _blockwise_attention(q, k, v, causal: bool, tk: int):
+    """Pure-XLA blockwise attention: lax.scan over K blocks with online
+    softmax, each step under jax.checkpoint. Numerically the same function
+    as the pallas kernel, O(S*tk) live memory — the autodiff twin used for
+    flash_attention's backward (its VJP recomputes per-block instead of
+    materializing the [S, S] score tensor)."""
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    nk = Sk // tk
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32) * scale
+    # keep K/V in their input dtype; each block upcasts inside the
+    # checkpointed step, so only one block's f32 copy is ever live
+    kb = k.reshape(B, nk, tk, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, nk, tk, H, D).transpose(1, 0, 2, 3, 4)
+    q_pos = jnp.arange(S)
+
+    @jax.checkpoint
+    def step(carry, inp):
+        o, m, l = carry
+        kj, kblk, vblk = inp
+        kblk = kblk.astype(jnp.float32)
+        vblk = vblk.astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf, kblk,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = kj * tk + jnp.arange(tk)
+            allowed = (q_pos[None, :, None, None] >= k_pos[None, None, None, :])
+            s = jnp.where(allowed, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(allowed, p, 0.0)
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        o_new = alpha[..., None] * o + jnp.einsum(
+            "bqhk,bkhd->bqhd", p, vblk, preferred_element_type=jnp.float32)
+        return (o_new, m_new, l_new), None
+
+    init = (jnp.zeros((B, S, H, D), jnp.float32),
+            jnp.full((B, S, H), _NEG, jnp.float32),
+            jnp.zeros((B, S, H), jnp.float32))
+    (o, m, l), _ = jax.lax.scan(step, init, (jnp.arange(nk), kb, vb))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
     o, m, l = flash_block(q, k, v, 0, 0, causal=causal, interpret=interpret)
     return (o / l[..., None]).astype(q.dtype)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    return _flash(q, k, v, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, res, g):
+    q, k, v = res
+    # small backward tile (same ladder as _q_tile): the recomputed
+    # [B, S, H, TK] probability tile is the live-memory high-water mark
+    tk = _q_tile(k.shape[1])
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _blockwise_attention(q_, k_, v_, causal, tk),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    interpret: bool = False):
+    """Single-device flash attention over [B, S, H, D] (normalized output).
+
+    Differentiable: the forward runs the pallas VMEM kernel; the backward is
+    the VJP of a checkpointed blockwise-scan twin (`_blockwise_attention`),
+    so neither direction materializes the [S, S] score tensor — long-context
+    training works on a single chip at sequence lengths where dense
+    attention is OOM-bound.
+    """
+    return _flash(q, k, v, causal, interpret)

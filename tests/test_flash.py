@@ -68,3 +68,37 @@ def test_flash_compiles_on_real_tpu():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         atol=3e-2, rtol=3e-2)
+
+
+def test_flash_gradient_matches_dense():
+    """flash_attention differentiates: grads match the dense oracle (the
+    backward is the VJP of the checkpointed blockwise twin)."""
+    B, S, H, D = 1, 64, 2, 8
+    keys = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in keys)
+
+    def flash_loss(q, k, v):
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        return jnp.sum(out * jnp.cos(out))
+
+    def dense_loss(q, k, v):
+        out = reference_attention(q, k, v, causal=True)
+        return jnp.sum(out * jnp.cos(out))
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5,
+            err_msg=f"grad mismatch for {name}")
+
+
+def test_blockwise_twin_matches_kernel_values():
+    from bluefog_tpu.parallel.flash import _blockwise_attention
+
+    B, S, H, D = 2, 32, 2, 8
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in keys)
+    a = flash_attention(q, k, v, causal=True, interpret=True)
+    b = _blockwise_attention(q, k, v, causal=True, tk=8)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
