@@ -8,6 +8,10 @@ here it rides the same ring machinery as `neighbor_allreduce`.
 
 Run (simulated 8-device mesh):
     bfrun --simulate 8 -- python examples/long_context_lm.py --seq-len 512
+
+``--attention flash`` instead trains full-sequence on ONE chip through the
+pallas flash kernel (custom VJP, no [S, S] scores in either direction) —
+the single-device long-context path for when a mesh isn't available.
 """
 
 from __future__ import annotations
@@ -40,18 +44,27 @@ def main():
     p.add_argument("--num-heads", type=int, default=8)
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--attention", default="ring", choices=["ring", "ulysses"])
+    p.add_argument("--attention", default="ring",
+                   choices=["ring", "ulysses", "flash"])
     args = p.parse_args()
 
     bf.init()
     n = bf.size()
-    if args.seq_len % n:
+    if args.attention != "flash" and args.seq_len % n:
         raise SystemExit(f"--seq-len must be divisible by {n} chips")
 
+    attn_fn = None
+    if args.attention == "flash":
+        from functools import partial
+        from bluefog_tpu.parallel.flash import flash_attention
+        # real pallas kernel on TPU, interpret mode on CPU dev boxes /
+        # --simulate runs (no Mosaic lowering off-TPU)
+        attn_fn = partial(flash_attention, causal=True,
+                          interpret=jax.default_backend() != "tpu")
     model = TransformerLM(
         vocab_size=args.vocab, num_layers=args.num_layers,
         num_heads=args.num_heads, d_model=args.d_model,
-        d_ff=4 * args.d_model, dtype=jnp.bfloat16)
+        d_ff=4 * args.d_model, dtype=jnp.bfloat16, attn_fn=attn_fn)
 
     rng = np.random.RandomState(0)
     # synthetic "copy task"-flavored data: next token = current + 1 mod V
@@ -61,7 +74,14 @@ def main():
     targets = jnp.roll(tokens, -1, axis=1)
 
     params = model.init(jax.random.PRNGKey(0), tokens[:, : args.seq_len])["params"]
-    loss_fn = bfp.cp_loss_fn(model, kind=args.attention)
+    if args.attention == "flash":
+        def loss_fn(p_, batch):
+            x, y = batch
+            logits = model.apply({"params": p_}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+    else:
+        loss_fn = bfp.cp_loss_fn(model, kind=args.attention)
     opt = optax.adam(3e-3)
     opt_state = opt.init(params)
 
@@ -71,8 +91,13 @@ def main():
         updates, s_ = opt.update(g, s_, p_)
         return optax.apply_updates(p_, updates), s_, l
 
-    print(f"{n} chip(s), seq {args.seq_len} ({args.seq_len // n}/chip), "
-          f"{args.attention} attention")
+    if args.attention == "flash":
+        # no sequence sharding: one chip owns the full context (the kernel,
+        # not the mesh, is what makes the length affordable)
+        print(f"seq {args.seq_len} full-sequence on one chip, flash attention")
+    else:
+        print(f"{n} chip(s), seq {args.seq_len} ({args.seq_len // n}/chip), "
+              f"{args.attention} attention")
     t0 = time.time()
     for i in range(args.steps):
         params, opt_state, loss = step(params, opt_state, (tokens, targets))
