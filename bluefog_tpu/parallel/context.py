@@ -63,11 +63,16 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
     ``use_flash=True`` computes each block's partials with the pallas VMEM
     kernel (parallel.flash.flash_block) instead of XLA einsums: scores never
     reach HBM, which is what lets per-chip K/V blocks grow long. ``interpret``
-    runs that kernel in interpreter mode (CPU test meshes).
+    runs that kernel in interpreter mode (CPU test meshes). Both paths
+    differentiate — the flash path's custom VJP backs onto the einsum ring
+    (numerically the same function), so flash training works in-ring too.
     """
     if use_flash:
-        return _ring_attention_flash(q, k, v, axis_name=axis_name,
-                                     causal=causal, interpret=interpret)
+        return _ring_flash_diff(q, k, v, axis_name, causal, interpret)
+    return _ring_attention_einsum(q, k, v, axis_name=axis_name, causal=causal)
+
+
+def _ring_attention_einsum(q, k, v, *, axis_name: str, causal: bool):
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -80,7 +85,11 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
     # holds block (me - t) % n.
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(t, carry):
+    # checkpointed: reverse-mode recomputes each step's score/probability
+    # block instead of saving all n of them — backward memory stays at one
+    # block, matching the flash path's promise (and its VJP rides this)
+    @jax.checkpoint
+    def body(carry, t):
         o, m, l, kc, vc = carry
         blk = (me - t) % n
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32))
@@ -99,14 +108,14 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
         o_new = o * jnp.moveaxis(corr, 1, -1)[..., None] + pv
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return o_new, m_new, l_new, kc, vc
+        return (o_new, m_new, l_new, kc, vc), None
 
     # pvary: the accumulators are device-varying from step 0 (shard_map's
     # varying-manual-axes check requires carry types to match body outputs).
     o0 = _pvary(jnp.zeros((B, Sq, H, D), jnp.float32), (axis_name,))
     m0 = _pvary(jnp.full((B, H, Sq), _NEG, jnp.float32), (axis_name,))
     l0 = _pvary(jnp.zeros((B, H, Sq), jnp.float32), (axis_name,))
-    o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
+    (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v), jnp.arange(n))
     out = o / jnp.moveaxis(l, 1, -1)[..., None]
     return out.astype(q.dtype)
 
@@ -142,6 +151,29 @@ def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool,
     l0 = _pvary(jnp.zeros((B, Sq, H), jnp.float32), (axis_name,))
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
     return (o / l[..., None]).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash_diff(q, k, v, axis_name, causal, interpret):
+    return _ring_attention_flash(q, k, v, axis_name=axis_name, causal=causal,
+                                 interpret=interpret)
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    return _ring_flash_diff(q, k, v, axis_name, causal, interpret), (q, k, v)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, res, g):
+    # the einsum ring computes the identical function; its VJP (ppermute
+    # transposes and all) is the flash ring's gradient
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _ring_attention_einsum(
+            q_, k_, v_, axis_name=axis_name, causal=causal), q, k, v)
+    return vjp(g)
+
+
+_ring_flash_diff.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ulysses_attention_shard(q, k, v, *, axis_name: str, causal: bool = False):
