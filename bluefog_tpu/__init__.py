@@ -124,6 +124,7 @@ from .utils import (
     broadcast_parameters,
     allreduce_parameters,
     broadcast_optimizer_state,
+    resnet_from_torch,
 )
 
 from . import checkpoint

@@ -96,10 +96,19 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        conv = partial(
-            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
-            padding="SAME",
-        )
+        def conv(features, kernel_size, strides=(1, 1), name=None):
+            # explicit ((k-1)//2, k//2) padding: identical to SAME at
+            # stride 1 for every kernel, and — symmetric for the odd
+            # kernels the architecture uses — matches torch's
+            # Conv2d(padding=k//2) at stride 2 too (where SAME pads
+            # asymmetrically). Keeps forwards numerically equal to
+            # torchvision weights loaded via utils/torch_interop.py.
+            k = kernel_size[0]
+            return nn.Conv(
+                features, kernel_size, strides, use_bias=False,
+                dtype=self.dtype, param_dtype=jnp.float32,
+                padding=(((k - 1) // 2, k // 2), ((k - 1) // 2, k // 2)),
+                name=name)
         norm = partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
@@ -122,7 +131,8 @@ class ResNet(nn.Module):
             x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                        padding=((1, 1), (1, 1)))
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)

@@ -86,8 +86,11 @@ def _ring_attention_einsum(q, k, v, *, axis_name: str, causal: bool):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # checkpointed: reverse-mode recomputes each step's score/probability
-    # block instead of saving all n of them — backward memory stays at one
-    # block, matching the flash path's promise (and its VJP rides this)
+    # block instead of saving all n of them. Scope note: scan's reverse pass
+    # still saves the per-step K/V carries (O(S) per chip across the ring
+    # trip) — what the checkpoint removes is the O(S*S/n) score residuals,
+    # the quadratic term; a reverse-rotation backward that re-derives the
+    # carries would get K/V down to O(S/n) and is future work.
     @jax.checkpoint
     def body(carry, t):
         o, m, l, kc, vc = carry

@@ -12,9 +12,11 @@ from .params import (
     broadcast_optimizer_state,
     broadcast_parameters,
 )
+from .torch_interop import resnet_from_torch
 
 __all__ = [
     "broadcast_parameters",
     "allreduce_parameters",
     "broadcast_optimizer_state",
+    "resnet_from_torch",
 ]
