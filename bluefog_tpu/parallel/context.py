@@ -64,15 +64,21 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
     kernel (parallel.flash.flash_block) instead of XLA einsums: scores never
     reach HBM, which is what lets per-chip K/V blocks grow long. ``interpret``
     runs that kernel in interpreter mode (CPU test meshes). Both paths
-    differentiate — the flash path's custom VJP backs onto the einsum ring
-    (numerically the same function), so flash training works in-ring too.
+    differentiate through the same reverse-rotation ring backward
+    (``_ring_backward``): one more K/V trip around the ring with gradient
+    blocks traveling alongside — residuals and carries are O(S/n) per chip,
+    with one [S/n, S/n] score block live per step (same per-step shape as
+    the einsum forward). Reverse-mode only: the custom VJP means
+    ``jax.jvp``/forward-over-reverse is unsupported on both ring paths.
     """
     if use_flash:
         return _ring_flash_diff(q, k, v, axis_name, causal, interpret)
-    return _ring_attention_einsum(q, k, v, axis_name=axis_name, causal=causal)
+    return _ring_einsum_diff(q, k, v, axis_name, causal)
 
 
-def _ring_attention_einsum(q, k, v, *, axis_name: str, causal: bool):
+def _ring_einsum_partials(q, k, v, axis_name: str, causal: bool):
+    """Einsum ring forward; returns (normalized out, row max m, row sum l),
+    m/l in [B, Sq, H] layout — the backward's softmax reconstruction keys."""
     n = lax.psum(1, axis_name)
     me = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
@@ -85,13 +91,6 @@ def _ring_attention_einsum(q, k, v, *, axis_name: str, causal: bool):
     # holds block (me - t) % n.
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # checkpointed: reverse-mode recomputes each step's score/probability
-    # block instead of saving all n of them. Scope note: scan's reverse pass
-    # still saves the per-step K/V carries (O(S) per chip across the ring
-    # trip) — what the checkpoint removes is the O(S*S/n) score residuals,
-    # the quadratic term; a reverse-rotation backward that re-derives the
-    # carries would get K/V down to O(S/n) and is future work.
-    @jax.checkpoint
     def body(carry, t):
         o, m, l, kc, vc = carry
         blk = (me - t) % n
@@ -120,12 +119,94 @@ def _ring_attention_einsum(q, k, v, *, axis_name: str, causal: bool):
     l0 = _pvary(jnp.zeros((B, H, Sq), jnp.float32), (axis_name,))
     (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v), jnp.arange(n))
     out = o / jnp.moveaxis(l, 1, -1)[..., None]
-    return out.astype(q.dtype)
+    return (out.astype(q.dtype),
+            jnp.moveaxis(m, 1, -1), jnp.moveaxis(l, 1, -1))
+
+
+def _ring_backward(axis_name: str, causal: bool, res, g):
+    """Reverse-rotation ring-attention backward.
+
+    One more K/V trip around the ring: per-block softmax probabilities are
+    reconstructed from the saved final (m, l) row statistics, and each K/V
+    block's gradient accumulates on a buffer that TRAVELS with the block —
+    after n steps every gradient block is back on its home chip. Residuals
+    and carries are all O(S/n) per chip; nothing quadratic, nothing
+    sequence-global (the standard ring-attention backward schedule).
+    """
+    q, k, v, out, m, l = res
+    n = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32) * scale
+    gf = g.astype(jnp.float32)
+    # D_i = sum_d g_i * out_i: the softmax-jacobian projection term
+    d_term = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # [B, Sq, H]
+    m_b = jnp.moveaxis(m, -1, 1)          # [B, H, Sq]
+    inv_l = 1.0 / jnp.moveaxis(l, -1, 1)  # l > 0 for every valid row
+    d_b = jnp.moveaxis(d_term, -1, 1)
+    q_pos = me * Sq + jnp.arange(Sq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, t):
+        dq, kc, vc, dkc, dvc = carry
+        blk = (me - t) % n
+        kcf = kc.astype(jnp.float32)
+        vcf = vc.astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kcf)
+        k_pos = blk * Sk + jnp.arange(Sk)
+        if causal:
+            allowed = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(allowed[None, None], s, _NEG)
+        p = jnp.exp(s - m_b[..., None]) * inv_l[..., None]
+        if causal:
+            p = jnp.where(allowed[None, None], p, 0.0)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vcf)
+        ds = p * (dp - d_b[..., None])
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kcf) * scale
+        dkc = dkc + jnp.einsum("bhqk,bqhd->bkhd", ds, qf)  # qf carries scale
+        dvc = dvc + jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        dkc = lax.ppermute(dkc, axis_name, perm)
+        dvc = lax.ppermute(dvc, axis_name, perm)
+        return (dq, kc, vc, dkc, dvc), None
+
+    dq0 = _pvary(jnp.zeros((B, Sq, H, D), jnp.float32), (axis_name,))
+    dk0 = _pvary(jnp.zeros((B, Sk, H, D), jnp.float32), (axis_name,))
+    dv0 = _pvary(jnp.zeros((B, Sk, H, D), jnp.float32), (axis_name,))
+    (dq, _, _, dk, dv), _ = lax.scan(
+        body, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_einsum_diff(q, k, v, axis_name, causal):
+    out, _, _ = _ring_einsum_partials(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_einsum_fwd(q, k, v, axis_name, causal):
+    out, m, l = _ring_einsum_partials(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, m, l)
+
+
+def _ring_einsum_bwd(axis_name, causal, res, g):
+    return _ring_backward(axis_name, causal, res, g)
+
+
+_ring_einsum_diff.defvjp(_ring_einsum_fwd, _ring_einsum_bwd)
 
 
 def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool,
                           interpret: bool):
-    """Ring loop whose per-block compute is the pallas flash kernel."""
+    """Ring loop whose per-block compute is the pallas flash kernel.
+
+    Returns (normalized out, m, l) — the same partials contract as
+    :func:`_ring_einsum_partials`, so both forwards share
+    :func:`_ring_backward`.
+    """
     from .flash import flash_block
 
     n = lax.psum(1, axis_name)
@@ -153,27 +234,26 @@ def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool,
     m0 = _pvary(jnp.full((B, Sq, H), _NEG, jnp.float32), (axis_name,))
     l0 = _pvary(jnp.zeros((B, Sq, H), jnp.float32), (axis_name,))
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o0, m0, l0, k, v))
-    return (o / l[..., None]).astype(q.dtype)
+    return (o / l[..., None]).astype(q.dtype), m, l
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _ring_flash_diff(q, k, v, axis_name, causal, interpret):
-    return _ring_attention_flash(q, k, v, axis_name=axis_name, causal=causal,
-                                 interpret=interpret)
+    out, _, _ = _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                      causal=causal, interpret=interpret)
+    return out
 
 
 def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
-    return _ring_flash_diff(q, k, v, axis_name, causal, interpret), (q, k, v)
+    out, m, l = _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                      causal=causal, interpret=interpret)
+    return out, (q, k, v, out, m, l)
 
 
 def _ring_flash_bwd(axis_name, causal, interpret, res, g):
-    # the einsum ring computes the identical function; its VJP (ppermute
-    # transposes and all) is the flash ring's gradient
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _ring_attention_einsum(
-            q_, k_, v_, axis_name=axis_name, causal=causal), q, k, v)
-    return vjp(g)
+    # same reverse-rotation backward as the einsum ring: the flash kernel's
+    # (m, l) partials are the identical softmax statistics
+    return _ring_backward(axis_name, causal, res, g)
 
 
 _ring_flash_diff.defvjp(_ring_flash_fwd, _ring_flash_bwd)

@@ -64,6 +64,18 @@ def resnet_from_torch(state_dict: Mapping, depth: int) -> Dict[str, Any]:
     params: Dict[str, Any] = {}
     stats: Dict[str, Any] = {}
 
+    try:
+        return _convert(state_dict, depth, stages, block_name,
+                        convs_per_block, params, stats)
+    except KeyError as exc:
+        raise ValueError(
+            f"state_dict is missing {exc} — not a complete depth-{depth} "
+            f"torchvision ResNet checkpoint; pass the matching depth"
+        ) from None
+
+
+def _convert(state_dict, depth, stages, block_name, convs_per_block,
+             params, stats):
     params["conv_init"] = {"kernel": _conv(state_dict["conv1.weight"])}
     params["bn_init"], stats["bn_init"] = _bn(state_dict, "bn1")
 

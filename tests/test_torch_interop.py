@@ -170,3 +170,10 @@ def test_depth_mismatch_rejected():
             sd[k.replace("layer4.1.", "layer4.2.")] = sd[k]
     with pytest.raises(ValueError, match="beyond a depth-18"):
         resnet_from_torch(sd, 18)
+
+
+def test_shallow_checkpoint_rejected_loudly():
+    torch.manual_seed(0)
+    sd = dict(TorchResNet18(num_classes=10).state_dict())
+    with pytest.raises(ValueError, match="matching depth"):
+        resnet_from_torch(sd, 34)  # resnet34 expects layer1.2.* etc.
