@@ -6,7 +6,7 @@ PYTEST      = python -m pytest
 MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
-        test_launcher bench dryrun native
+        test_launcher test_models bench dryrun native
 
 test:            ## full suite (slow: ~1 h on a shared-core CPU mesh)
 	$(PYTEST) tests/ -q
@@ -31,6 +31,9 @@ test_parallel:
 
 test_launcher:
 	$(PYTEST) tests/test_launcher.py tests/test_heartbeat.py -q
+
+test_models:
+	$(PYTEST) tests/test_models.py tests/test_torch_interop.py -q
 
 bench:           ## headline benchmark on the default backend (real chip)
 	python bench.py
