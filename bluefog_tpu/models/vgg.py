@@ -52,8 +52,11 @@ class VGG(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        # torchvision's make_layers uses bias=True for every conv even in
+        # the batch-norm variant; keep that parameter set so a future
+        # vgg_from_torch interop (like resnet_from_torch) maps name-for-name.
         conv = partial(
-            nn.Conv, kernel_size=(3, 3), use_bias=not self.batch_norm,
+            nn.Conv, kernel_size=(3, 3), use_bias=True,
             dtype=self.dtype, param_dtype=jnp.float32, padding="SAME",
         )
         norm = partial(

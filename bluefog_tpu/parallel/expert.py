@@ -57,6 +57,7 @@ class SwitchFFN(nn.Module):
                         (self.num_experts, d, self.d_ff), jnp.float32)
         down = self.param("down", nn.initializers.lecun_normal(),
                           (self.num_experts, self.d_ff, d), jnp.float32)
+        in_dtype = x.dtype
         x = x.astype(self.dtype)
         probs = jax.nn.softmax(
             (x @ gate.astype(self.dtype)).astype(jnp.float32), axis=-1)
@@ -67,7 +68,7 @@ class SwitchFFN(nn.Module):
         y = jnp.einsum("...ef,efd->...ed", h, down.astype(self.dtype))
         p_best = jnp.max(probs, axis=-1).astype(self.dtype)
         out = jnp.einsum("...ed,...e->...d", y, sel) * p_best[..., None]
-        return out.astype(x.dtype)
+        return out.astype(in_dtype)
 
 
 def load_balance_loss(probs, best, num_experts: int):

@@ -14,6 +14,15 @@ Embedding, final norm, and the LM head are replicated and run outside the
 pipelined block stack (they are a few percent of the FLOPs; the block stack
 is the memory that forces pipelining).
 
+Memory model, stated honestly: this schedule shards *parameters* (one stage
+chunk per device) but the microbatch activation buffer ``mb_acts`` and the
+recorded outputs are replicated across stages, and every stage computes its
+block chunk on whatever sits in its incoming slot during fill/drain ticks
+(garbage that is never recorded). Pipelining here buys parameter memory and
+exactness, not activation memory. The training path
+(:func:`pp_train_step_fn`) recovers activation memory with
+``jax.checkpoint`` over the scan instead.
+
 Exact by construction: the pipeline computes the same composition of blocks
 as the dense model, so tests assert equality with the single-device oracle.
 """

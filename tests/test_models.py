@@ -76,6 +76,9 @@ def test_vgg16_config_matches_torchvision_layout():
                       512, 512, 512]
     assert v["params"]["fc_0"]["kernel"].shape[-1] == 4096
     assert v["params"]["head"]["kernel"].shape == (4096, 5)
+    # torchvision keeps conv biases even under batch norm; the interop
+    # contract (a future vgg_from_torch) needs the same parameter set
+    assert all("bias" in v["params"][k] for k in convs)
 
 
 def test_vgg_resolution_portability_via_7x7_pool():
