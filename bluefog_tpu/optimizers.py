@@ -129,6 +129,115 @@ _unstack = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
 _restack = lambda t: jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], t)
 
 
+def build_fused_step(mesh, kind: str, loss, opt, plan: Optional[CombinePlan]):
+    """Construct the fused per-step SPMD program for one comm strategy.
+
+    Module-level so it works over ANY mesh — the live rank mesh inside
+    :class:`_FusedOptimizer`, or a ``jax.sharding.AbstractMesh`` for AOT
+    lowering (the compile-time scaling evidence in ``bluefog_tpu.scaling``
+    asserts collective counts on exactly the program built here).
+
+    ``kind``: gradient_allreduce | allreduce | neighbor_allreduce |
+    hierarchical | none. Hierarchical expects a ("machine", "local") mesh.
+    Returns a jitted ``fn(w, params, opt_state, model_state, batch)`` over
+    rank-stacked trees with donated state.
+    """
+    shifts = plan.shifts if plan is not None else ()
+    use_gather = plan.use_gather if plan is not None else False
+    pn = plan.n if plan is not None else 0
+    axis = "machine" if kind == "hierarchical" else "rank"
+
+    def per_rank(w, params, opt_state, model_state, batch):
+        p = _unstack(params)
+        os_ = _unstack(opt_state)
+        ms = _unstack(model_state)
+        b = _unstack(batch)
+
+        (l, (new_ms, aux)), grads = jax.value_and_grad(
+            lambda p_: loss(p_, ms, b), has_aux=True)(p)
+        if kind == "gradient_allreduce":
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, mesh.axis_names), grads)
+        updates, new_os = opt.update(grads, os_, p)
+        p = optax.apply_updates(p, updates)
+        if kind == "allreduce":
+            p = jax.tree_util.tree_map(
+                lambda x: lax.pmean(x, mesh.axis_names), p)
+        elif kind == "neighbor_allreduce":
+            p = spmd_combine(w, p, axis=axis, n=pn, shifts=shifts,
+                             use_gather=use_gather, stacked=False)
+        elif kind == "hierarchical":
+            p = jax.tree_util.tree_map(lambda x: lax.pmean(x, "local"), p)
+            p = spmd_combine(w, p, axis="machine", n=pn, shifts=shifts,
+                             use_gather=use_gather, stacked=False)
+        metrics = {"loss": l, "aux": aux}
+        return (_restack(p), _restack(new_os), _restack(new_ms),
+                _restack(metrics))
+
+    spec = P(mesh.axis_names)
+    mapped = jax.shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(P(), spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
+    )
+    # Donate params/opt_state/model_state: the caller always replaces
+    # them with the step outputs, and donation lets XLA update in place
+    # instead of double-buffering the model in HBM.
+    return jax.jit(mapped, donate_argnums=(1, 2, 3))
+
+
+def _flat_shard(flat, n: int, me):
+    """(my [ceil(size/n)] shard of a padded flat buffer, shard length).
+
+    The single source of truth for ZeRO-1 shard sizing — used by both the
+    step program and the optimizer-state init so they cannot diverge."""
+    size = -(-flat.size // n)
+    padded = jnp.pad(flat, (0, size * n - flat.size))
+    return lax.dynamic_slice(padded, (me * size,), (size,)), size
+
+
+def build_sharded_step(mesh, loss, opt):
+    """ZeRO-1 step over an arbitrary mesh (see :func:`build_fused_step`):
+    psum_scatter grads, update the local 1/n flat shard, all_gather params."""
+    n = mesh.size  # Mesh and AbstractMesh both implement it
+    axis = mesh.axis_names
+
+    def per_rank(w, params, opt_state, model_state, batch):
+        p = _unstack(params)
+        os_ = _unstack(opt_state)
+        ms = _unstack(model_state)
+        b = _unstack(batch)
+
+        (l, (new_ms, aux)), grads = jax.value_and_grad(
+            lambda p_: loss(p_, ms, b), has_aux=True)(p)
+        flat_g, _ = jax.flatten_util.ravel_pytree(grads)
+        flat_p, unravel = jax.flatten_util.ravel_pytree(p)
+        total = flat_p.size
+        size = -(-total // n)
+        me = lax.axis_index(axis)
+        g_shard = lax.psum_scatter(
+            jnp.pad(flat_g, (0, size * n - total)), axis,
+            scatter_dimension=0, tiled=True) / n
+        p_shard, _ = _flat_shard(flat_p, n, me)
+        updates, new_os = opt.update(g_shard, os_, p_shard)
+        new_flat = lax.all_gather(
+            optax.apply_updates(p_shard, updates), axis, tiled=True)
+        p_new = unravel(new_flat[:total])
+        metrics = {"loss": l, "aux": aux}
+        return (_restack(p_new), _restack(new_os), _restack(new_ms),
+                _restack(metrics))
+
+    spec = P(mesh.axis_names)
+    mapped = jax.shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(P(), spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, spec),
+    )
+    return jax.jit(mapped, donate_argnums=(1, 2, 3))
+
+
 class _FusedOptimizer:
     """Shared machinery: fused per-step SPMD program with cached jits."""
 
@@ -177,55 +286,9 @@ class _FusedOptimizer:
     # -- the fused step ---------------------------------------------------
 
     def _build(self, key, plan: Optional[CombinePlan], do_comm: bool):
-        st = _global_state()
         mesh, _ = self._mesh_axes()
         kind = self._comm_kind if do_comm else "none"
-        loss = self._loss
-        opt = self.base
-        shifts = plan.shifts if plan is not None else ()
-        use_gather = plan.use_gather if plan is not None else False
-        pn = plan.n if plan is not None else 0
-        hier = kind == "hierarchical"
-        axis = "machine" if hier else "rank"
-
-        def per_rank(w, params, opt_state, model_state, batch):
-            p = _unstack(params)
-            os_ = _unstack(opt_state)
-            ms = _unstack(model_state)
-            b = _unstack(batch)
-
-            (l, (new_ms, aux)), grads = jax.value_and_grad(
-                lambda p_: loss(p_, ms, b), has_aux=True)(p)
-            if kind == "gradient_allreduce":
-                grads = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(g, mesh.axis_names), grads)
-            updates, new_os = opt.update(grads, os_, p)
-            p = optax.apply_updates(p, updates)
-            if kind == "allreduce":
-                p = jax.tree_util.tree_map(
-                    lambda x: lax.pmean(x, mesh.axis_names), p)
-            elif kind == "neighbor_allreduce":
-                p = spmd_combine(w, p, axis=axis, n=pn, shifts=shifts,
-                                 use_gather=use_gather, stacked=False)
-            elif kind == "hierarchical":
-                p = jax.tree_util.tree_map(lambda x: lax.pmean(x, "local"), p)
-                p = spmd_combine(w, p, axis="machine", n=pn, shifts=shifts,
-                                 use_gather=use_gather, stacked=False)
-            metrics = {"loss": l, "aux": aux}
-            return (_restack(p), _restack(new_os), _restack(new_ms),
-                    _restack(metrics))
-
-        spec = P(mesh.axis_names)
-        mapped = jax.shard_map(
-            per_rank,
-            mesh=mesh,
-            in_specs=(P(), spec, spec, spec, spec),
-            out_specs=(spec, spec, spec, spec),
-        )
-        # Donate params/opt_state/model_state: the caller always replaces
-        # them with the step outputs, and donation lets XLA update in place
-        # instead of double-buffering the model in HBM.
-        return jax.jit(mapped, donate_argnums=(1, 2, 3))
+        return build_fused_step(mesh, kind, self._loss, self.base, plan)
 
     def _weights_and_key(self):
         plan = self._plan()
@@ -395,11 +458,7 @@ class DistributedShardedAllreduceOptimizer(_FusedOptimizer):
                 "num_steps_per_communication=1: a local step cannot update "
                 "replicated params from sharded optimizer state")
 
-    @staticmethod
-    def _shard_of(flat, n: int, me):
-        size = -(-flat.size // n)
-        padded = jnp.pad(flat, (0, size * n - flat.size))
-        return lax.dynamic_slice(padded, (me * size,), (size,)), size
+    _shard_of = staticmethod(_flat_shard)
 
     def init(self, params, model_state=None) -> TrainState:
         st = _global_state()
@@ -424,46 +483,8 @@ class DistributedShardedAllreduceOptimizer(_FusedOptimizer):
         )
 
     def _build(self, key, plan, do_comm):
-        st = _global_state()
         mesh, _ = self._mesh_axes()
-        n = mesh.devices.size
-        axis = mesh.axis_names
-        loss = self._loss
-        opt = self.base
-
-        def per_rank(w, params, opt_state, model_state, batch):
-            p = _unstack(params)
-            os_ = _unstack(opt_state)
-            ms = _unstack(model_state)
-            b = _unstack(batch)
-
-            (l, (new_ms, aux)), grads = jax.value_and_grad(
-                lambda p_: loss(p_, ms, b), has_aux=True)(p)
-            flat_g, _ = jax.flatten_util.ravel_pytree(grads)
-            flat_p, unravel = jax.flatten_util.ravel_pytree(p)
-            total = flat_p.size
-            size = -(-total // n)
-            me = lax.axis_index(axis)
-            g_shard = lax.psum_scatter(
-                jnp.pad(flat_g, (0, size * n - total)), axis,
-                scatter_dimension=0, tiled=True) / n
-            p_shard, _ = self._shard_of(flat_p, n, me)
-            updates, new_os = opt.update(g_shard, os_, p_shard)
-            new_flat = lax.all_gather(
-                optax.apply_updates(p_shard, updates), axis, tiled=True)
-            p_new = unravel(new_flat[:total])
-            metrics = {"loss": l, "aux": aux}
-            return (_restack(p_new), _restack(new_os), _restack(new_ms),
-                    _restack(metrics))
-
-        spec = P(mesh.axis_names)
-        mapped = jax.shard_map(
-            per_rank,
-            mesh=mesh,
-            in_specs=(P(), spec, spec, spec, spec),
-            out_specs=(spec, spec, spec, spec),
-        )
-        return jax.jit(mapped, donate_argnums=(1, 2, 3))
+        return build_sharded_step(mesh, self._loss, self.base)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,109 @@
+"""Compile-time scaling evidence: the HLO-level communication contract.
+
+These tests AOT-lower the PRODUCTION train-step programs
+(optimizers.build_fused_step / build_sharded_step) for abstract TPU meshes
+of 8-128 devices and assert the collective structure the >95 %@128 scaling
+claim rests on (reference docs/performance.rst:44-48). No devices needed:
+lowering is pure compilation, so the 128-chip program is checked on this
+dev box exactly as XLA would receive it on a pod.
+"""
+
+import math
+
+import pytest
+
+from bluefog_tpu import scaling, topology
+
+
+NS = (8, 16, 64, 128)
+
+
+@pytest.mark.parametrize("n", NS)
+def test_static_expo2_step_is_logn_permutes_no_allreduce(n):
+    c = scaling.count_step_collectives("neighbor_static_expo2", n)
+    assert c["collective_permute"] == math.ceil(math.log2(n))
+    assert c["all_reduce"] == 0
+    assert c["all_gather"] == 0 and c["reduce_scatter"] == 0
+
+
+@pytest.mark.parametrize("n", NS)
+def test_dynamic_onepeer_step_is_one_permute_no_allreduce(n):
+    c = scaling.count_step_collectives("neighbor_dynamic_onepeer", n)
+    assert c["collective_permute"] == 1
+    assert c["all_reduce"] == 0
+    assert c["all_gather"] == 0 and c["reduce_scatter"] == 0
+
+
+def test_dynamic_onepeer_every_step_in_cycle_is_one_shift():
+    # the one-peer schedule stays one-permute-per-step across its whole
+    # cycle, not just at step 0 (each step is a distinct edge set / plan)
+    n = 16
+    for step in range(math.ceil(math.log2(n))):
+        plan = scaling.dynamic_onepeer_plan(n, step=step)
+        assert len(plan.shifts) == 1, (step, plan.shifts)
+
+
+@pytest.mark.parametrize("n", NS)
+def test_hierarchical_allreduce_is_local_axis_only(n):
+    local = 4
+    txt = scaling.lower_train_step("hierarchical", n, local_size=local)
+    c = scaling.collective_counts(txt)
+    m = n // local
+    assert c["all_reduce"] == 1  # the intra-machine pmean
+    assert c["collective_permute"] == (math.ceil(math.log2(m)) if m > 1 else 0)
+    # the all_reduce's replica groups span local_size devices, never n:
+    # machine-crossing traffic is exclusively the permute ops
+    import re
+    ar = txt[txt.index("stablehlo.all_reduce"):]
+    shape = re.search(
+        r"replica_groups\s*=\s*dense<.*?>\s*:\s*tensor<(\d+)x(\d+)xi64>",
+        ar, re.S)
+    n_groups, group_size = int(shape.group(1)), int(shape.group(2))
+    assert group_size == local and n_groups == n // local
+
+
+@pytest.mark.parametrize("n", NS)
+def test_zero1_is_reduce_scatter_plus_all_gather(n):
+    c = scaling.count_step_collectives("zero1", n)
+    assert c["reduce_scatter"] == 1 and c["all_gather"] == 1
+    assert c["all_reduce"] == 0
+
+
+@pytest.mark.parametrize("n", NS)
+def test_global_allreduce_baselines(n):
+    for kind in ("allreduce", "gradient_allreduce"):
+        c = scaling.count_step_collectives(kind, n)
+        assert c["all_reduce"] == 1
+        assert c["collective_permute"] == 0
+
+
+def test_permute_count_is_per_leaf_linear():
+    # StableHLO emits one permute per shift per leaf; XLA's collective
+    # combiner merges them downstream. Lock the per-leaf contract so a
+    # regression to e.g. per-element permutes cannot hide.
+    n = 8
+    one = scaling.count_step_collectives(
+        "neighbor_static_expo2", n, n_leaves=1)["collective_permute"]
+    three = scaling.count_step_collectives(
+        "neighbor_static_expo2", n, n_leaves=3)["collective_permute"]
+    assert three == 3 * one == 9
+
+
+def test_wire_bytes_model_dynamic_beats_allreduce_everywhere():
+    for n in NS:
+        dyn, rounds = scaling.wire_bytes_per_chip(
+            "neighbor_dynamic_onepeer", n, scaling.RESNET50_BYTES)
+        ar, ar_rounds = scaling.wire_bytes_per_chip(
+            "allreduce", n, scaling.RESNET50_BYTES)
+        assert dyn < ar and rounds == 1 and ar_rounds == 2 * (n - 1)
+
+
+def test_scaling_md_is_current(tmp_path):
+    # regenerating the checked-in artifact must reproduce it (table drift
+    # against the lowered HLO fails here, not in review)
+    import pathlib
+    out = tmp_path / "SCALING.md"
+    scaling.write_scaling_md(str(out))
+    committed = (pathlib.Path(__file__).parent.parent /
+                 "SCALING.md").read_text()
+    assert out.read_text() == committed
