@@ -25,7 +25,11 @@ Semantics preserved from the reference:
   * ``self_weight`` on put/accumulate rescales the locally stored window
     tensor after the send (the push-sum "self down-weighting").
   * per-edge version counters: bumped on put/get/accumulate, cleared when
-    win_update reads the buffer (mpi_controller.cc:1281-1393).
+    win_update reads the buffer (mpi_controller.cc:1281-1393). Advisory, as
+    in the reference: on the hosted plane an origin's bump can race the
+    owner's post-drain reset (a deposit may briefly coexist with version 0
+    and be consumed one update late); use ``require_mutex`` where strict
+    write/read exclusion matters, exactly as the reference prescribes.
   * per-rank mutexes with host-side lock tables (the MPI_Fetch_and_op
     spin-lock, mpi_controller.cc:1532-1602, owned by the controller).
   * associated-p scalars: optional parallel channel carrying the push-sum
@@ -35,6 +39,8 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
+import os
+import struct
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -72,7 +78,7 @@ class _LocalWinHost:
         self.p_mail = np.zeros((n, d_max), np.float64)
         self.mutexes = [threading.RLock() for _ in range(n)]
 
-    def bump_version(self, dst: int, k: int) -> None:
+    def bump_version(self, dst: int, k: int, force: bool = False) -> None:
         self.version[dst, k] += 1
 
     def reset_versions(self, pairs) -> None:
@@ -84,6 +90,20 @@ class _LocalWinHost:
 
     def read_p(self) -> np.ndarray:
         return self.p.copy()
+
+    def read_p_owned(self) -> Dict[int, float]:
+        return {r: float(self.p[r]) for r in range(self.n)}
+
+    def read_p_mail_owned(self) -> Dict[int, np.ndarray]:
+        return {r: self.p_mail[r].copy() for r in range(self.n)}
+
+    def write_p_entries(self, entries: Dict[int, float]) -> None:
+        for r, v in entries.items():
+            self.p[r] = v
+
+    def write_p_mail_rows(self, rows: Dict[int, np.ndarray]) -> None:
+        for r, v in rows.items():
+            self.p_mail[r] = np.asarray(v, np.float64)
 
     def write_p(self, values: np.ndarray) -> None:
         self.p = np.asarray(values, np.float64).copy()
@@ -145,8 +165,11 @@ class _ControlPlaneWinHost:
                 _cp.put_float(self._cl, f"{self._pre}.m.{dst}.{k}", 0.0)
         self.flush()
 
-    def bump_version(self, dst: int, k: int) -> None:
-        if dst in self.owned:
+    def bump_version(self, dst: int, k: int, force: bool = False) -> None:
+        # ``force``: origin-side bump in the hosted (one-sided) plane — slot
+        # (dst, k) maps 1:1 to a source rank, so the origin may bump a
+        # non-owned destination's counter without write contention.
+        if force or dst in self.owned:
             self._cl.fetch_add(f"{self._pre}.v.{dst}.{k}", 1)
 
     def reset_versions(self, pairs) -> None:
@@ -157,10 +180,53 @@ class _ControlPlaneWinHost:
     def get_version(self, dst: int, k: int) -> int:
         return int(self._cl.get(f"{self._pre}.v.{dst}.{k}"))
 
+    @staticmethod
+    def _bits_to_float(v: int) -> float:
+        import struct as _st
+        return _st.unpack("<d", _st.pack("<q", v))[0]
+
+    @staticmethod
+    def _float_to_bits(v: float) -> int:
+        import struct as _st
+        return _st.unpack("<q", _st.pack("<d", float(v)))[0]
+
     def read_p(self) -> np.ndarray:
-        return np.array([
-            _cp.get_float(self._cl, f"{self._pre}.p.{r}") for r in range(self.n)
-        ])
+        vals = self._cl.get_many(
+            [f"{self._pre}.p.{r}" for r in range(self.n)])
+        return np.array([self._bits_to_float(v) for v in vals])
+
+    def read_p_owned(self) -> Dict[int, float]:
+        """Batched read of only this controller's ranks (the hosted hot
+        path: one pipelined round-trip, no n-scaling)."""
+        owned = sorted(self.owned)
+        vals = self._cl.get_many([f"{self._pre}.p.{r}" for r in owned])
+        return {r: self._bits_to_float(v) for r, v in zip(owned, vals)}
+
+    def read_p_mail_owned(self) -> Dict[int, np.ndarray]:
+        owned = sorted(self.owned)
+        keys = [f"{self._pre}.m.{r}.{k}"
+                for r in owned for k in range(self.d_max)]
+        vals = self._cl.get_many(keys)
+        out: Dict[int, np.ndarray] = {}
+        i = 0
+        for r in owned:
+            out[r] = np.array([self._bits_to_float(v)
+                               for v in vals[i:i + self.d_max]])
+            i += self.d_max
+        return out
+
+    def write_p_entries(self, entries: Dict[int, float]) -> None:
+        items = sorted(entries.items())
+        self._cl.put_many([f"{self._pre}.p.{r}" for r, _ in items],
+                          [self._float_to_bits(v) for _, v in items])
+
+    def write_p_mail_rows(self, rows: Dict[int, np.ndarray]) -> None:
+        keys, vals = [], []
+        for r in sorted(rows):
+            for k in range(self.d_max):
+                keys.append(f"{self._pre}.m.{r}.{k}")
+                vals.append(self._float_to_bits(float(rows[r][k])))
+        self._cl.put_many(keys, vals)
 
     def write_p(self, values: np.ndarray) -> None:
         for r in self.owned:
@@ -266,8 +332,67 @@ class _GraphLayout:
                     self.has_edge[si, dst] = True
 
 
+def _hosted_mode_enabled() -> bool:
+    """Whether new windows use the hosted (host-tensor-transport) data plane.
+
+    Default policy: ON for multi-controller jobs with a control plane (the
+    deployments where the collective plane's all-controllers-must-dispatch
+    contract breaks asynchrony), OFF for single-controller (the compiled
+    ppermute plane is strictly faster on-device and the controller owns all
+    ranks anyway). ``BLUEFOG_WIN_HOST_PLANE=1/0`` forces either way.
+    """
+    if not _cp.active():
+        return False
+    env = os.environ.get("BLUEFOG_WIN_HOST_PLANE")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return _cp.world() > 1
+
+
+def _owned_rows(tensor, owned) -> Dict[int, np.ndarray]:
+    """Extract this controller's rank rows of a rank-stacked tensor as numpy.
+
+    Works for host arrays, fully-addressable device arrays, and
+    multi-controller global arrays (via addressable_shards)."""
+    if isinstance(tensor, jax.Array) and not tensor.is_fully_addressable:
+        rows: Dict[int, np.ndarray] = {}
+        for shard in tensor.addressable_shards:
+            idx = shard.index[0]
+            r0 = idx.start or 0
+            data = np.asarray(shard.data)
+            for i in range(data.shape[0]):
+                rows[r0 + i] = data[i]
+        missing = set(owned) - set(rows)
+        if missing:
+            raise ValueError(
+                f"input tensor is missing addressable rows for owned ranks "
+                f"{sorted(missing)}")
+        return {r: rows[r] for r in owned}
+    host = np.asarray(tensor)
+    return {r: np.array(host[r]) for r in owned}
+
+
 class Window:
-    """Mailbox state for one named window over the current topology."""
+    """Mailbox state for one named window over the current topology.
+
+    Two data planes:
+
+    * **collective** (single-controller default): one compiled SPMD program
+      per op — ppermute per circulant shift, on-device mailbox blend.
+    * **hosted** (multi-controller default; the reference's one-sided
+      semantics): tensors move through the control-plane server's bulk-bytes
+      mailboxes (csrc/bf_runtime.cc kAppendBytes/kTakeBytes). An origin
+      controller deposits into a remote rank's server mailbox and returns —
+      the target drains deposits at ITS next win_update, so a slow or
+      sleeping controller never blocks a fast one (the property the
+      reference gets from passive-target MPI_Win_lock RMA,
+      mpi_controller.cc:953-1034, and its NCCL passive-recv thread,
+      nccl_controller.cc:1113-1238). Each rank's current window tensor is
+      also published to the server (the "exposed window" copy) so win_get
+      stays one-sided.
+    """
 
     def __init__(self, name: str, tensor, zero_init: bool) -> None:
         st = _global_state()
@@ -279,40 +404,16 @@ class Window:
         self.layout = _GraphLayout(st.topology, st.size)
         self.in_neighbors = self.layout.in_nbrs
         self.out_neighbors = self.layout.out_nbrs
-        sh = NamedSharding(st.mesh, P("rank"))
         d = self.layout.d_max
         # Mailboxes for integer windows store floats: weighted contributions
         # stay exact until win_update casts the combined result back.
-        mail_dtype = tensor.dtype if jnp.issubdtype(tensor.dtype, jnp.floating) \
-            else jnp.float32
-        mail_shape = (st.size, d) + tensor.shape[1:]
-        if isinstance(tensor, jax.Array):
-            # Device input (possibly a multi-controller global array that
-            # CANNOT be materialized on the host): reshard directly, and
-            # build the neighbor-buffer copy with eager device ops — every
-            # controller executes the same sequence, so this is SPMD-safe.
-            self.self_value = jax.device_put(tensor, sh)
-            if zero_init:
-                mail = jax.device_put(np.zeros(mail_shape, mail_dtype), sh)
-            else:
-                # Neighbor buffers start as a copy of the local tensor
-                # (mpi_ops.py:890-915 zero_init=False default).
-                mail = jnp.broadcast_to(
-                    self.self_value[:, None], mail_shape).astype(mail_dtype)
-                mail = jax.device_put(mail, sh)
-        else:
-            # Host input: stage via numpy so nothing hops through the
-            # DEFAULT device, which may be a different backend than the
-            # window's mesh (e.g. a remote TPU while the mesh is CPU).
-            host = np.asarray(tensor)
-            self.self_value = jax.device_put(host, sh)
-            if zero_init:
-                mail = np.zeros(mail_shape, mail_dtype)
-            else:
-                mail = np.broadcast_to(host[:, None], mail_shape).astype(
-                    mail_dtype)
-            mail = jax.device_put(mail, sh)
-        self.mail = mail
+        self.dtype = jnp.dtype(tensor.dtype)
+        mail_dtype = self.dtype if jnp.issubdtype(self.dtype, jnp.floating) \
+            else jnp.dtype(jnp.float32)
+        self.mail_dtype = mail_dtype
+        self.row_shape = tuple(tensor.shape[1:])
+        mail_shape = (st.size, d) + self.row_shape
+        self.hosted = _hosted_mode_enabled()
         # Scalar protocols (versions / push-sum p / mutexes): controller-local
         # host memory, or the job-wide control plane when one is attached
         # (multi-controller; reference mpi_controller.cc:1281-1393, 1532-1602).
@@ -323,7 +424,64 @@ class Window:
             self.host = _ControlPlaneWinHost(name, st.size, self.layout.d_max,
                                              owned)
         else:
+            owned = list(range(st.size))
             self.host = _LocalWinHost(name, st.size, self.layout.d_max)
+        self.owned = sorted(owned)
+
+        if self.hosted:
+            # defensive: discard any deposit records a crashed predecessor
+            # window of the same name left on the server
+            cl = _cp.client()
+            for r in self.owned:
+                for k in range(self.layout.d_max):
+                    while cl.take_bytes(self._dep_key(r, k)):
+                        pass
+            rows = _owned_rows(tensor, self.owned)
+            self._rows = {r: v.astype(self.dtype) for r, v in rows.items()}
+            if zero_init:
+                self._mail_rows = {
+                    r: np.zeros((d,) + self.row_shape, mail_dtype)
+                    for r in self.owned}
+            else:
+                self._mail_rows = {
+                    r: np.broadcast_to(
+                        self._rows[r][None], (d,) + self.row_shape
+                    ).astype(mail_dtype).copy()
+                    for r in self.owned}
+            for r in self.owned:
+                self._publish_self(r)
+            # creation is aligned across controllers (like MPI_Win_create);
+            # data-plane OPS afterwards never barrier — that's the point
+            self.host.flush()
+        else:
+            sh = NamedSharding(st.mesh, P("rank"))
+            if isinstance(tensor, jax.Array):
+                # Device input (possibly a multi-controller global array that
+                # CANNOT be materialized on the host): reshard directly, and
+                # build the neighbor-buffer copy with eager device ops — every
+                # controller executes the same sequence, so this is SPMD-safe.
+                self._self_value = jax.device_put(tensor, sh)
+                if zero_init:
+                    mail = jax.device_put(np.zeros(mail_shape, mail_dtype), sh)
+                else:
+                    # Neighbor buffers start as a copy of the local tensor
+                    # (mpi_ops.py:890-915 zero_init=False default).
+                    mail = jnp.broadcast_to(
+                        self._self_value[:, None], mail_shape).astype(mail_dtype)
+                    mail = jax.device_put(mail, sh)
+            else:
+                # Host input: stage via numpy so nothing hops through the
+                # DEFAULT device, which may be a different backend than the
+                # window's mesh (e.g. a remote TPU while the mesh is CPU).
+                host = np.asarray(tensor)
+                self._self_value = jax.device_put(host, sh)
+                if zero_init:
+                    mail = np.zeros(mail_shape, mail_dtype)
+                else:
+                    mail = np.broadcast_to(host[:, None], mail_shape).astype(
+                        mail_dtype)
+                mail = jax.device_put(mail, sh)
+            self.mail = mail
         # Serializes the whole-array read-modify-write of mail/self_value:
         # ops touching disjoint edges hold disjoint rank mutexes yet still
         # reassign the same arrays, so every op takes this lock around its
@@ -332,6 +490,107 @@ class Window:
         self.state_mu = threading.RLock()
         self._exchange_cache: Dict[Tuple, object] = {}
         self._update_cache: Dict[Tuple, object] = {}
+
+    # -- self_value: a property so both planes share the publish contract ---
+
+    @property
+    def self_value(self):
+        if not self.hosted:
+            return self._self_value
+        return _assemble_global(self, self._rows)
+
+    @self_value.setter
+    def self_value(self, value) -> None:
+        if not self.hosted:
+            self._self_value = value
+            return
+        rows = _owned_rows(value, self.owned)
+        with self.state_mu:
+            for r in self.owned:
+                self._rows[r] = np.asarray(rows[r]).astype(self.dtype)
+                self._publish_self(r)
+
+    # -- hosted-plane internals --------------------------------------------
+
+    def _self_key(self, rank: int) -> str:
+        return f"w.{self.name}.self.{rank}"
+
+    def _dep_key(self, dst: int, k: int) -> str:
+        return f"w.{self.name}.dep.{dst}.{k}"
+
+    def _publish_self(self, rank: int) -> None:
+        """Refresh rank's 'exposed window' copy on the server (win_get)."""
+        _cp.client().put_bytes(self._self_key(rank),
+                               self._rows[rank].tobytes())
+
+    def _read_remote_self(self, rank: int) -> np.ndarray:
+        raw = _cp.client().get_bytes(self._self_key(rank))
+        expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
+            self.dtype.itemsize
+        if len(raw) != expect:
+            raise RuntimeError(
+                f"window '{self.name}': published tensor for rank {rank} has "
+                f"{len(raw)} bytes, expected {expect}")
+        return np.frombuffer(raw, self.dtype).reshape(self.row_shape).copy()
+
+    def _fold_record(self, dst: int, k: int, mode: int,
+                     contrib: np.ndarray) -> None:
+        """Fold one deposit into the local mailbox slot (owner side).
+
+        Same cast discipline as the compiled plane: accumulate in the acc
+        dtype, cast back to the mail dtype per record."""
+        acc_t = np.dtype(_win_acc_dtype(self.mail_dtype))
+        cur = self._mail_rows[dst][k]
+        if mode == _DEP_ACC:
+            new = (cur.astype(acc_t) + contrib.astype(acc_t)).astype(
+                self.mail_dtype)
+        else:
+            new = contrib.astype(self.mail_dtype)
+        self._mail_rows[dst][k] = new
+
+    def _drain_deposits(self) -> None:
+        """Take pending server deposits for every owned rank and fold them
+        in deposit order. Called under state_mu (win_update). Loops per key:
+        the server bounds each take reply (kMaxTakeReply), so a long backlog
+        from a slept-through stretch drains in several bounded rounds."""
+        cl = _cp.client()
+        for r in self.owned:
+            for k in range(self.layout.d_max):
+                while True:
+                    records = cl.take_bytes(self._dep_key(r, k))
+                    if not records:
+                        break
+                    for rec in records:
+                        mode, has_p, pc = struct.unpack_from("<BBd", rec)
+                        contrib = np.frombuffer(
+                            rec[_DEP_HDR:],
+                            np.dtype(_win_acc_dtype(self.mail_dtype)),
+                        ).reshape(self.row_shape)
+                        self._fold_record(r, k, mode, contrib)
+                        if has_p:
+                            if mode == _DEP_ACC:
+                                self.host.add_p_mail(r, k, pc)
+                            else:
+                                self.host.set_p_mail(r, k, pc)
+
+    def close(self) -> None:
+        """Release hosted-plane server state (win_free).
+
+        Like MPI_Win_free, freeing is collective: the first barrier aligns
+        every controller past its last data op on this window, then each
+        owner discards its ranks' pending deposits and published tensors so
+        a later window under the same name starts clean; the second barrier
+        keeps any controller from re-creating the name mid-cleanup."""
+        if not self.hosted:
+            return
+        self.host.flush()
+        cl = _cp.client()
+        for r in self.owned:
+            for k in range(self.layout.d_max):
+                while cl.take_bytes(self._dep_key(r, k)):
+                    pass
+            cl.put_bytes(self._self_key(r), b"")
+        self.host.flush()
 
     # -- compiled programs -------------------------------------------------
 
@@ -410,6 +669,31 @@ class Window:
         fn = jax.jit(mapped)
         self._update_cache[key] = fn
         return fn
+
+
+# deposit record: u8 mode | u8 has_p | f64 p_contrib | payload (acc dtype)
+_DEP_PUT = 0
+_DEP_ACC = 1
+_DEP_HDR = struct.calcsize("<BBd")
+
+
+def _assemble_global(win: Window, rows: Dict[int, np.ndarray]):
+    """Build the rank-stacked global array from this controller's rows.
+
+    Metadata-only across controllers: each controller contributes exactly its
+    addressable shards (jax.make_array_from_single_device_arrays), so no
+    cross-controller dispatch happens — the one-sided property survives the
+    return path."""
+    st = _global_state()
+    sh = NamedSharding(st.mesh, P("rank"))
+    shape = (st.size,) + win.row_shape
+    if len(rows) == st.size:
+        stacked = np.stack([rows[r] for r in range(st.size)])
+        return jax.device_put(stacked, sh)
+    shards = [
+        jax.device_put(rows[r][None], st.devices[r]) for r in sorted(rows)
+    ]
+    return jax.make_array_from_single_device_arrays(shape, sh, shards)
 
 
 def _get_window(name: str) -> Window:
@@ -527,10 +811,13 @@ def win_free(name: Optional[str] = None) -> bool:
     st = _global_state()
     st.check_initialized()
     if name is None:
+        for win in st.windows.values():
+            win.close()
         st.windows.clear()
         return True
     if name not in st.windows:
         return False
+    st.windows[name].close()
     del st.windows[name]
     return True
 
@@ -539,8 +826,102 @@ def win_free(name: Optional[str] = None) -> bool:
 # put / accumulate / get
 # ---------------------------------------------------------------------------
 
+def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
+                     require_mutex: bool, activity: str, from_get: bool):
+    """One-sided put/accumulate/get over the host tensor transport.
+
+    Only THIS controller's owned source ranks act; contributions to remote
+    destinations become server deposits (kAppendBytes) that the owning
+    controller folds at its next win_update. Nothing here waits on another
+    controller — the reference's passive-target property
+    (mpi_controller.cc:953-1034) restated for multi-controller TPU jobs.
+    """
+    st = _global_state()
+    acc_t = np.dtype(_win_acc_dtype(win.mail_dtype))
+    owned = set(win.owned)
+    if from_get:
+        # a get READS the published source tensors: lock the sources
+        touched = sorted({src for src in range(win.size)
+                          if table[src] and set(table[src]) & owned})
+    else:
+        touched = sorted({dst for src in owned
+                          for dst in table.get(src, {})})
+    # Server locks directly (no owner filter): the origin takes the remote
+    # target's mutex exactly like MPI_Win_lock on the target window. Sorted
+    # order keeps concurrent origins deadlock-free.
+    if require_mutex:
+        for r in touched:
+            win.host.mutex_acquire(r)
+    try:
+        with timeline_context(win.name, activity), win.state_mu:
+            use_p = st.win_ops_with_associated_p
+            if not from_get:
+                # batched owned-only read: the hosted hot path never pays
+                # n-scaling server round-trips for ranks it doesn't own
+                p_own = win.host.read_p_owned() if use_p else None
+                rows = _owned_rows(tensor, win.owned)
+                for src in win.owned:
+                    x = rows[src].astype(acc_t)
+                    for dst in sorted(table.get(src, {})):
+                        wt = float(table[src][dst])
+                        k = win.layout.slot_of[dst][src]
+                        contrib = x * np.asarray(wt, acc_t)
+                        pc = float(p_own[src] * wt) if use_p else 0.0
+                        mode = _DEP_ACC if accumulate else _DEP_PUT
+                        if dst in owned:
+                            win._fold_record(dst, k, mode, contrib)
+                            if use_p:
+                                if accumulate:
+                                    win.host.add_p_mail(dst, k, pc)
+                                else:
+                                    win.host.set_p_mail(dst, k, pc)
+                        else:
+                            rec = struct.pack("<BBd", mode, int(use_p), pc) \
+                                + contrib.astype(acc_t).tobytes()
+                            _cp.client().append_bytes(
+                                win._dep_key(dst, k), rec)
+                        win.host.bump_version(dst, k, force=True)
+                    # post-send self scaling (the push-sum down-weighting)
+                    win._rows[src] = (
+                        rows[src].astype(acc_t) * np.asarray(
+                            sw_list[src], acc_t)).astype(win.dtype)
+                    win._publish_self(src)
+                if use_p:
+                    win.host.write_p_entries({
+                        src: p_own[src] * float(sw_list[src])
+                        for src in win.owned})
+            else:
+                # pull each in-edge source's published tensor into MY
+                # mailbox; a get may read a REMOTE source's p scalar
+                p_all = win.host.read_p() if use_p else None
+                for dst in win.owned:
+                    for src in range(win.size):
+                        wt = table[src].get(dst)
+                        if wt is None:
+                            continue
+                        k = win.layout.slot_of[dst][src]
+                        val = (win._rows[src] if src in owned
+                               else win._read_remote_self(src))
+                        win._fold_record(dst, k, _DEP_PUT,
+                                         val.astype(acc_t) * np.asarray(
+                                             wt, acc_t))
+                        if use_p:
+                            win.host.set_p_mail(dst, k,
+                                                float(p_all[src] * wt))
+                        win.host.bump_version(dst, k)
+    finally:
+        if require_mutex:
+            for r in reversed(touched):
+                win.host.mutex_release(r)
+    return _handles.allocate(f"{activity.lower()}.{win.name}",
+                             np.zeros((), np.float32))
+
+
 def _do_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                  require_mutex: bool, activity: str, from_get: bool = False):
+    if win.hosted:
+        return _hosted_exchange(win, tensor, table, sw_list, accumulate,
+                                require_mutex, activity, from_get)
     st = _global_state()
     w, active = _edge_arrays(win, table)
     if from_get:
@@ -721,6 +1102,10 @@ def win_update(
             nw[r, k] = wt
             read_mask[r, k] = 1.0
 
+    if win.hosted:
+        return _hosted_update(win, sw_list, nw_table, nw, read_mask,
+                              reset, clone, require_mutex)
+
     with timeline_context(name, "WIN_UPDATE"):
         _acquire(win, range(n), require_mutex)
         win.state_mu.acquire()
@@ -751,6 +1136,71 @@ def win_update(
             win.state_mu.release()
             _release(win, range(n), require_mutex)
     return result
+
+
+def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
+                   reset: bool, clone: bool, require_mutex: bool):
+    """Owner-local combine for the hosted plane.
+
+    Drains this controller's pending server deposits, folds them, then runs
+    the weighted combine for OWNED ranks only — other controllers' ranks are
+    their own business (that is what makes a sleeping peer harmless). The
+    result is the rank-stacked global array assembled from owned shards.
+    """
+    st = _global_state()
+    acc_t = np.dtype(_win_acc_dtype(win.mail_dtype))
+    lay = win.layout
+    with timeline_context(win.name, "WIN_UPDATE"):
+        # lock only OWNED ranks (the reference's win_update locks the local
+        # window; remote ranks' updates are their owners' job)
+        if require_mutex:
+            for r in win.owned:
+                win.host.mutex_acquire(r)
+        win.state_mu.acquire()
+        try:
+            win._drain_deposits()
+            use_p = st.win_ops_with_associated_p
+            if use_p:
+                # batched, owned-only: no n-scaling server traffic
+                p_own = win.host.read_p_owned()
+                p_mail = win.host.read_p_mail_owned()
+            results: Dict[int, np.ndarray] = {}
+            for r in win.owned:
+                combined = np.asarray(sw_list[r], acc_t) * \
+                    win._rows[r].astype(acc_t)
+                for src, wt in nw_table.get(r, {}).items():
+                    k = lay.slot_of[r][src]
+                    combined = combined + np.asarray(wt, acc_t) * \
+                        win._mail_rows[r][k].astype(acc_t)
+                results[r] = combined.astype(win.dtype)
+                if reset:
+                    keep = (1.0 - read_mask[r]).reshape(
+                        (lay.d_max,) + (1,) * len(win.row_shape))
+                    win._mail_rows[r] = (
+                        win._mail_rows[r].astype(acc_t) * keep.astype(acc_t)
+                    ).astype(win.mail_dtype)
+            win.host.reset_versions(
+                (r, lay.slot_of[r][src])
+                for r in win.owned for src in nw_table.get(r, {}))
+            if reset and use_p:
+                win.host.write_p_mail_rows({
+                    r: p_mail[r] * (1.0 - read_mask[r].astype(np.float64))
+                    for r in win.owned})
+            if not clone:
+                for r in win.owned:
+                    win._rows[r] = results[r]
+                    win._publish_self(r)
+                if use_p:
+                    win.host.write_p_entries({
+                        r: float(sw_list[r]) * p_own[r] + float(
+                            np.sum(nw[r].astype(np.float64) * p_mail[r]))
+                        for r in win.owned})
+        finally:
+            win.state_mu.release()
+            if require_mutex:
+                for r in reversed(win.owned):
+                    win.host.mutex_release(r)
+    return _assemble_global(win, results)
 
 
 def win_update_then_collect(name: str, require_mutex: bool = True):

@@ -19,6 +19,15 @@ Knobs kept:
                            under jit there is never a negotiation stage)
   BLUEFOG_SIMULATE_DEVICES N -> init() ranks over N forced-CPU devices even
                            when an accelerator is present (bfrun --simulate)
+  BLUEFOG_WIN_HOST_PLANE   '1'/'0' forces the hosted (host-tensor-transport)
+                           window data plane on/off; default: on for
+                           multi-controller jobs (one-sided gossip across
+                           controllers), off for single-controller (the
+                           compiled ppermute plane is faster on-device)
+  BLUEFOG_CP_HOST/PORT/RANK/WORLD/DISABLE/SERVE/CONNECT_TIMEOUT
+                           control-plane wiring (runtime/control_plane.py);
+                           auto-derived from the jax.distributed coordinator
+                           in multi-controller jobs
 
 Knobs with no TPU meaning (accepted, ignored, logged once at init):
   BLUEFOG_*_BY_MPI routing, BLUEFOG_OPS_ON_CPU, BLUEFOG_WIN_ON_GPU,

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import subprocess
 import threading
 from typing import Optional
@@ -55,6 +56,25 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn = getattr(lib, fname)
         fn.restype = ctypes.c_int64
         fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    for fname in ("bf_cp_append_bytes", "bf_cp_put_bytes"):
+        fn = getattr(lib, fname)
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                       ctypes.c_int64]
+    for fname in ("bf_cp_take_bytes", "bf_cp_get_bytes"):
+        fn = getattr(lib, fname)
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                       ctypes.POINTER(ctypes.c_void_p),
+                       ctypes.POINTER(ctypes.c_int64)]
+    lib.bf_cp_free.restype = None
+    lib.bf_cp_free.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_multi.restype = ctypes.c_int64
+    lib.bf_cp_multi.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+    ]
     lib.bf_cp_disconnect.restype = None
     lib.bf_cp_disconnect.argtypes = [ctypes.c_void_p]
     return lib
@@ -143,6 +163,102 @@ class ControlPlaneClient:
 
     def get(self, name: str) -> int:
         return self._lib.bf_cp_get(self._h, name.encode())
+
+    # -- pipelined batches --------------------------------------------------
+
+    def get_many(self, names) -> list:
+        """Batched get: n keys, one round-trip's latency."""
+        names = list(names)
+        if not names:
+            return []
+        n = len(names)
+        out = (ctypes.c_int64 * n)()
+        r = self._lib.bf_cp_multi(self._h, 6, "\n".join(names).encode(),
+                                  None, out, n)
+        if r < 0:
+            raise OSError("control plane get_many failed")
+        return list(out)
+
+    def put_many(self, names, values) -> None:
+        """Batched put: n (key, int64) pairs, one round-trip's latency."""
+        names = list(names)
+        if not names:
+            return
+        n = len(names)
+        args = (ctypes.c_int64 * n)(*[int(v) for v in values])
+        if self._lib.bf_cp_multi(self._h, 5, "\n".join(names).encode(),
+                                 args, None, n) < 0:
+            raise OSError("control plane put_many failed")
+
+    # -- bulk bytes: the host tensor transport for one-sided windows --------
+
+    # request framing overhead (header + key) must stay under the server's
+    # 1 GiB message ceiling; reject oversized payloads client-side instead of
+    # poisoning the connection (the server drops it without replying)
+    _MAX_PAYLOAD = (1 << 30) - 4096
+
+    def _check_payload(self, what: str, data: bytes) -> None:
+        if len(data) > self._MAX_PAYLOAD:
+            raise ValueError(
+                f"{what}: payload of {len(data)} bytes exceeds the control "
+                f"plane's {self._MAX_PAYLOAD}-byte per-message ceiling; "
+                "split the window tensor into smaller leaves")
+
+    def append_bytes(self, name: str, data: bytes) -> int:
+        """Append one deposit record to the named server mailbox; returns the
+        record count after the append. One-sided: only this client blocks."""
+        self._check_payload("append_bytes", data)
+        r = self._lib.bf_cp_append_bytes(self._h, name.encode(), data,
+                                         len(data))
+        if r < 0:
+            raise OSError("control plane append_bytes failed")
+        return int(r)
+
+    def take_bytes(self, name: str) -> list:
+        """Atomically drain the named mailbox; returns records in deposit
+        order (empty list when nothing is pending)."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        r = self._lib.bf_cp_take_bytes(self._h, name.encode(),
+                                       ctypes.byref(out),
+                                       ctypes.byref(out_len))
+        if r < 0:
+            raise OSError("control plane take_bytes failed")
+        try:
+            payload = ctypes.string_at(out.value, out_len.value) \
+                if out_len.value else b""
+        finally:
+            self._lib.bf_cp_free(out)
+        records = []
+        off = 0
+        while off < len(payload):
+            (rl,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            records.append(payload[off:off + rl])
+            off += rl
+        return records
+
+    def put_bytes(self, name: str, data: bytes) -> None:
+        """Overwrite the named bytes slot (the 'exposed window' copy)."""
+        self._check_payload("put_bytes", data)
+        if self._lib.bf_cp_put_bytes(self._h, name.encode(), data,
+                                     len(data)) < 0:
+            raise OSError("control plane put_bytes failed")
+
+    def get_bytes(self, name: str) -> bytes:
+        """Read the named bytes slot (empty when never put)."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        r = self._lib.bf_cp_get_bytes(self._h, name.encode(),
+                                      ctypes.byref(out),
+                                      ctypes.byref(out_len))
+        if r < 0:
+            raise OSError("control plane get_bytes failed")
+        try:
+            return ctypes.string_at(out.value, out_len.value) \
+                if out_len.value else b""
+        finally:
+            self._lib.bf_cp_free(out)
 
     def close(self) -> None:
         if self._h:

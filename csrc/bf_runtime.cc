@@ -28,6 +28,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -158,18 +159,39 @@ void bf_timeline_close(void* handle) {
 //
 // Wire format (all little-endian, client -> server):
 //   u32 payload_len | u8 op | i32 rank | u16 key_len | key bytes | i64 arg
+//   [| data bytes — bulk ops only]
 // Server -> client: u32 payload_len(=8) | i64 value
-// Ops: 1=barrier 2=lock 3=unlock 4=fetch_add 5=put 6=get 7=shutdown.
+//   (take_bytes / get_bytes reply u32 payload_len | payload instead)
+// Ops: 1=barrier 2=lock 3=unlock 4=fetch_add 5=put 6=get 7=shutdown
+//      8=append_bytes 9=take_bytes 10=put_bytes 11=get_bytes.
 // Barrier and lock block server-side (each connection owns a handler
 // thread, the MPI "passive target" made explicit — cf. the reference's
 // passive-recv thread design, nccl_controller.cc:1113-1238).
+//
+// The bulk-bytes ops are the host tensor transport for one-sided window
+// gossip across controllers (the analog of the reference's passive-recv
+// data path, nccl_controller.cc:1113-1238, with the server as the passive
+// party): an origin controller APPENDs a deposit record addressed to a
+// remote mailbox slot and returns immediately; the owning controller
+// TAKEs (drains) its slot's records whenever it next runs win_update —
+// the target's compute loop is never involved in the origin's progress.
+// put_bytes/get_bytes hold each rank's published window tensor (the
+// "exposed window" MPI_Win memory analog) for one-sided win_get.
 
 namespace {
 
 enum Op : uint8_t {
   kBarrier = 1, kLock = 2, kUnlock = 3, kFetchAdd = 4, kPut = 5, kGet = 6,
-  kShutdown = 7,
+  kShutdown = 7, kAppendBytes = 8, kTakeBytes = 9, kPutBytes = 10,
+  kGetBytes = 11,
 };
+
+constexpr uint32_t kMaxMsg = 1u << 30;       // 1 GiB bulk-payload ceiling
+// Per-reply ceiling for kTakeBytes: a drain takes at most this many payload
+// bytes per call (plus one record, so a single oversized record still moves);
+// the remainder stays queued and the client loops until empty. Keeps a long
+// backlog from a sleeping controller from producing an unbounded reply.
+constexpr size_t kMaxTakeReply = 64u << 20;  // 64 MiB
 
 struct ControlServer {
   int listen_fd = -1;
@@ -182,6 +204,8 @@ struct ControlServer {
   std::mutex mu;
   std::condition_variable cv;
   std::map<std::string, int64_t> kv;
+  std::map<std::string, std::vector<std::string>> mailbox;  // append/take
+  std::map<std::string, std::string> bytes_kv;              // put/get bytes
   std::map<std::string, int> lock_owner;           // key -> rank (or -1)
   std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
   std::map<std::string, int> barrier_count;
@@ -190,7 +214,7 @@ struct ControlServer {
     for (;;) {
       uint32_t len;
       if (!ReadAll(fd, &len, 4)) break;
-      if (len < 15 || len > 4096) break;
+      if (len < 15 || len > kMaxMsg) break;
       std::vector<char> buf(len);
       if (!ReadAll(fd, buf.data(), len)) break;
       uint8_t op = buf[0];
@@ -198,12 +222,15 @@ struct ControlServer {
       std::memcpy(&rank, buf.data() + 1, 4);
       uint16_t klen;
       std::memcpy(&klen, buf.data() + 5, 2);
-      if (7 + klen + 8 > len) break;
+      if (7u + klen + 8u > len) break;
       std::string key(buf.data() + 7, klen);
       int64_t arg;
       std::memcpy(&arg, buf.data() + 7 + klen, 8);
+      const char* data = buf.data() + 7 + klen + 8;
+      size_t dlen = len - (7 + klen + 8);
       int64_t reply = 0;
       bool quit = false;
+      bool replied = false;
       switch (op) {
         case kBarrier: {
           std::unique_lock<std::mutex> lk(mu);
@@ -258,6 +285,66 @@ struct ControlServer {
           reply = kv.count(key) ? kv[key] : 0;
           break;
         }
+        case kAppendBytes: {
+          std::lock_guard<std::mutex> lk(mu);
+          auto& box = mailbox[key];
+          box.emplace_back(data, dlen);
+          reply = static_cast<int64_t>(box.size());
+          break;
+        }
+        case kTakeBytes: {
+          // Atomically drain (a bounded prefix, preserving deposit order):
+          // reply is concat(u32 reclen | rec bytes ...).
+          std::vector<std::string> records;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = mailbox.find(key);
+            if (it != mailbox.end()) {
+              auto& box = it->second;
+              size_t total = 0, i = 0;
+              while (i < box.size()) {
+                size_t next = total + 4 + box[i].size();
+                if (i > 0 && next > kMaxTakeReply) break;
+                total = next;
+                ++i;
+              }
+              if (i >= box.size()) {
+                records.swap(box);
+                mailbox.erase(it);
+              } else {
+                records.assign(std::make_move_iterator(box.begin()),
+                               std::make_move_iterator(box.begin() + i));
+                box.erase(box.begin(), box.begin() + i);
+              }
+            }
+          }
+          std::string payload;
+          for (const auto& r : records) {
+            uint32_t rl = static_cast<uint32_t>(r.size());
+            payload.append(reinterpret_cast<const char*>(&rl), 4);
+            payload.append(r);
+          }
+          if (!SendBytesReply(fd, payload)) return CloseFd(fd);
+          replied = true;
+          break;
+        }
+        case kPutBytes: {
+          std::lock_guard<std::mutex> lk(mu);
+          bytes_kv[key].assign(data, dlen);
+          reply = 1;
+          break;
+        }
+        case kGetBytes: {
+          std::string payload;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = bytes_kv.find(key);
+            if (it != bytes_kv.end()) payload = it->second;
+          }
+          if (!SendBytesReply(fd, payload)) return CloseFd(fd);
+          replied = true;
+          break;
+        }
         case kShutdown:
           quit = true;
           reply = 1;
@@ -265,11 +352,13 @@ struct ControlServer {
         default:
           break;
       }
-      uint32_t rlen = 8;
-      char out[12];
-      std::memcpy(out, &rlen, 4);
-      std::memcpy(out + 4, &reply, 8);
-      if (!WriteAll(fd, out, 12)) break;
+      if (!replied) {
+        uint32_t rlen = 8;
+        char out[12];
+        std::memcpy(out, &rlen, 4);
+        std::memcpy(out + 4, &reply, 8);
+        if (!WriteAll(fd, out, 12)) break;
+      }
       if (quit) {
         stopping.store(true);
         cv.notify_all();
@@ -277,6 +366,14 @@ struct ControlServer {
       }
     }
     ::close(fd);
+  }
+
+  static void CloseFd(int fd) { ::close(fd); }
+
+  static bool SendBytesReply(int fd, const std::string& payload) {
+    uint32_t rlen = static_cast<uint32_t>(payload.size());
+    if (!WriteAll(fd, &rlen, 4)) return false;
+    return payload.empty() || WriteAll(fd, payload.data(), payload.size());
   }
 
   static bool ReadAll(int fd, void* p, size_t n) {
@@ -324,9 +421,9 @@ struct ControlClient {
   std::mutex mu;
 
   void Encode(std::vector<char>* buf, uint8_t op, const std::string& key,
-              int64_t arg) {
+              int64_t arg, const void* data = nullptr, size_t dlen = 0) {
     uint16_t klen = static_cast<uint16_t>(key.size());
-    uint32_t len = 1 + 4 + 2 + klen + 8;
+    uint32_t len = static_cast<uint32_t>(1 + 4 + 2 + klen + 8 + dlen);
     size_t base = buf->size();
     buf->resize(base + 4 + len);
     std::memcpy(buf->data() + base, &len, 4);
@@ -335,6 +432,7 @@ struct ControlClient {
     std::memcpy(buf->data() + base + 9, &klen, 2);
     std::memcpy(buf->data() + base + 11, key.data(), klen);
     std::memcpy(buf->data() + base + 11 + klen, &arg, 8);
+    if (dlen) std::memcpy(buf->data() + base + 11 + klen + 8, data, dlen);
   }
 
   bool ReadReply(int64_t* reply) {
@@ -344,14 +442,37 @@ struct ControlClient {
     return ControlServer::ReadAll(fd, reply, 8);
   }
 
-  int64_t Call(uint8_t op, const std::string& key, int64_t arg) {
+  int64_t Call(uint8_t op, const std::string& key, int64_t arg,
+               const void* data = nullptr, size_t dlen = 0) {
     std::lock_guard<std::mutex> lk(mu);
     std::vector<char> buf;
-    Encode(&buf, op, key, arg);
+    Encode(&buf, op, key, arg, data, dlen);
     if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
     int64_t reply;
     if (!ReadReply(&reply)) return -1;
     return reply;
+  }
+
+  // Bulk-reply call (take_bytes / get_bytes): returns a malloc'd payload the
+  // caller frees with bf_cp_free; length via *out_len; -1 on wire failure.
+  int64_t CallBytes(uint8_t op, const std::string& key, void** out,
+                    int64_t* out_len) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<char> buf;
+    Encode(&buf, op, key, 0);
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+    uint32_t rlen;
+    if (!ControlServer::ReadAll(fd, &rlen, 4)) return -1;
+    if (rlen > kMaxMsg) return -1;
+    char* payload = static_cast<char*>(std::malloc(rlen ? rlen : 1));
+    if (!payload) return -1;
+    if (rlen && !ControlServer::ReadAll(fd, payload, rlen)) {
+      std::free(payload);
+      return -1;
+    }
+    *out = payload;
+    *out_len = rlen;
+    return rlen;
   }
 
   // Pipelined batch: send every request, then drain every reply. The server
@@ -474,6 +595,34 @@ int64_t bf_cp_put(void* h, const char* key, int64_t value) {
 }
 int64_t bf_cp_get(void* h, const char* key) {
   return static_cast<ControlClient*>(h)->Call(kGet, key, 0);
+}
+int64_t bf_cp_append_bytes(void* h, const char* key, const void* data,
+                           int64_t len) {
+  return static_cast<ControlClient*>(h)->Call(
+      kAppendBytes, key, len, data, static_cast<size_t>(len));
+}
+int64_t bf_cp_take_bytes(void* h, const char* key, void** out,
+                         int64_t* out_len) {
+  return static_cast<ControlClient*>(h)->CallBytes(kTakeBytes, key, out,
+                                                   out_len);
+}
+int64_t bf_cp_put_bytes(void* h, const char* key, const void* data,
+                        int64_t len) {
+  return static_cast<ControlClient*>(h)->Call(
+      kPutBytes, key, len, data, static_cast<size_t>(len));
+}
+int64_t bf_cp_get_bytes(void* h, const char* key, void** out,
+                        int64_t* out_len) {
+  return static_cast<ControlClient*>(h)->CallBytes(kGetBytes, key, out,
+                                                   out_len);
+}
+void bf_cp_free(void* p) { std::free(p); }
+// Pipelined batch of n same-op requests (newline-separated keys): one
+// latency round-trip for n key operations. args/out may be null.
+int64_t bf_cp_multi(void* h, int op, const char* keys_nl, const int64_t* args,
+                    int64_t* out, int n) {
+  return static_cast<ControlClient*>(h)->CallMulti(
+      static_cast<uint8_t>(op), keys_nl, args, out, n);
 }
 void bf_cp_disconnect(void* h) {
   auto* cl = static_cast<ControlClient*>(h);

@@ -1,0 +1,139 @@
+"""Child program for the one-sided window-transport tests (2 processes).
+
+Launched twice via ``python -m bluefog_tpu.launcher -np 2 --coordinator ...``,
+2 forced CPU devices each: a 2-controller, size-4 job over a ring. Proves the
+VERDICT-r2 #1 property: window gossip progresses on one controller while the
+other is asleep mid-step — the reference's passive-target one-sidedness
+(mpi_controller.cc:953-1034) over the host tensor transport.
+
+Phase A (sleeping target): process 1 sleeps; process 0 completes 5 rounds of
+win_put + win_update in bounded time and with exact values. Process 1 then
+wakes, drains the deposits, and checks ITS exact values.
+
+Phase B (skewed push-sum): process 0 gossips 30 rounds at full speed while
+process 1 crawls through 8 slow rounds; process 0 must finish first (no rate
+coupling), and after a final coordinated drain the push-sum invariants hold
+globally: sum of numerators == sum of inputs, sum of p == world size.
+"""
+
+import time
+
+import numpy as np
+
+import jax
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import windows as win_ops
+from bluefog_tpu.runtime import control_plane
+
+
+def owned_rows(arr, owned):
+    rows = {}
+    for s in arr.addressable_shards:
+        rows[s.index[0].start or 0] = np.asarray(s.data)[0]
+    return {r: rows[r] for r in owned}
+
+
+def main() -> None:
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert bf.size() == 4
+    bf.set_topology(bf.topology_util.RingGraph(4))
+    assert control_plane.active()
+    cl = control_plane.client()
+
+    x_np = (np.arange(4, dtype=np.float32) + 1.0).reshape(4, 1)
+
+    # ---- Phase A: target asleep ----------------------------------------
+    assert bf.win_create(x_np, "os.a", zero_init=True)
+    win = win_ops._get_window("os.a")
+    assert win.hosted, "multi-controller windows must use the hosted plane"
+    assert win.owned == ([0, 1] if pid == 0 else [2, 3]), win.owned
+
+    if pid == 1:
+        time.sleep(6.0)  # asleep "inside its step"
+        # woke up: drain the deposits process 0 made while we slept
+        got = owned_rows(bf.win_update("os.a"), [2, 3])
+        # ring in-edges: 2 <- {1, 3}, 3 <- {2, 0}; only cross-process
+        # sources (1 -> 2, 0 -> 3) deposited; same-process sources slept.
+        np.testing.assert_allclose(got[2], (x_np[2] + x_np[1]) / 3.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(got[3], (x_np[3] + x_np[0]) / 3.0,
+                                   rtol=1e-6)
+    else:
+        t0 = time.monotonic()
+        for _ in range(5):
+            bf.win_put(x_np, "os.a")
+        got = owned_rows(bf.win_update("os.a"), [0, 1])
+        dt = time.monotonic() - t0
+        # the whole gossip ran while the peer slept: bounded time, no
+        # dependence on the peer's dispatch
+        assert dt < 4.0, f"one-sided gossip took {dt:.1f}s with peer asleep"
+        np.testing.assert_allclose(got[0], (x_np[0] + x_np[1]) / 3.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(got[1], (x_np[1] + x_np[0]) / 3.0,
+                                   rtol=1e-6)
+        print(f"PHASE_A_BOUNDED {dt:.2f}", flush=True)
+    bf.barrier()
+    bf.win_free("os.a")
+
+    # ---- Phase B: skewed-speed push-sum --------------------------------
+    bf.turn_on_win_ops_with_associated_p()
+    assert bf.win_create(x_np, "os.ps", zero_init=True)
+    topo = bf.load_topology()
+    outd = {r: len(bf.topology_util.out_neighbor_ranks(topo, r))
+            for r in range(4)}
+    sw = {r: 1.0 / (outd[r] + 1) for r in range(4)}
+    dw = {r: {d: 1.0 / (outd[r] + 1)
+              for d in bf.topology_util.out_neighbor_ranks(topo, r)}
+          for r in range(4)}
+    owned = [0, 1] if pid == 0 else [2, 3]
+    est = {r: float(x_np[r, 0]) for r in owned}
+
+    rounds = 30 if pid == 0 else 8
+    for i in range(rounds):
+        if pid == 1:
+            time.sleep(0.4)  # the deliberately slow controller
+        p_all = bf.win_associated_p_all("os.ps")
+        numer = np.zeros((4, 1), np.float32)
+        for r in owned:
+            numer[r, 0] = est[r] * p_all[r]
+        bf.win_accumulate(numer, "os.ps", self_weight=sw, dst_weights=dw,
+                          require_mutex=True)
+        collected = owned_rows(
+            bf.win_update_then_collect("os.ps"), owned)
+        p_new = bf.win_associated_p_all("os.ps")
+        for r in owned:
+            est[r] = float(collected[r][0]) / p_new[r]
+    if pid == 0:
+        # the fast controller must NOT have been rate-limited by the slow
+        # one: the slow loop takes >= 8 * 0.4s and we finish well before it
+        assert cl.get("os.b.done") == 0, \
+            "fast controller finished after the slow one — gossip is coupled"
+        print("PHASE_B_UNCOUPLED", flush=True)
+    else:
+        cl.put("os.b.done", 1)
+    bf.barrier()
+
+    # final coordinated drain: all in-flight deposits fold, then the global
+    # invariants must hold exactly
+    collected = owned_rows(bf.win_update_then_collect("os.ps"), owned)
+    part = sum(float(collected[r][0]) for r in owned)
+    control_plane.put_float(cl, f"os.b.part.{pid}", part)
+    bf.barrier()
+    if pid == 0:
+        total = sum(control_plane.get_float(cl, f"os.b.part.{i}")
+                    for i in range(2))
+        p_final = bf.win_associated_p_all("os.ps")
+        assert abs(total - 10.0) < 1e-3, f"mass not conserved: {total}"
+        assert abs(p_final.sum() - 4.0) < 1e-9, f"p mass: {p_final}"
+        print(f"PHASE_B_INVARIANT {total:.4f}", flush=True)
+    bf.barrier()
+    bf.win_free("os.ps")
+    bf.turn_off_win_ops_with_associated_p()
+    bf.shutdown()
+    print(f"CHILD_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

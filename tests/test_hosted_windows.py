@@ -1,0 +1,176 @@
+"""Hosted (host-tensor-transport) window plane: single-process parity.
+
+The hosted plane is the multi-controller default (one-sided gossip across
+controllers; tests/_onesided_child.py proves the asynchrony end-to-end).
+These tests force it in a world-1 job (``BLUEFOG_WIN_HOST_PLANE=1``) and pin
+its numerics to the compiled collective plane's contracts: put/get/update
+values, versions, push-sum invariants, and the window optimizers.
+"""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import windows as win_ops
+from bluefog_tpu.runtime import control_plane as cp
+from bluefog_tpu.runtime import native
+
+from conftest import cpu_devices
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native runtime unavailable")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def bf_hosted():
+    """bf over 8 CPU devices, control plane + forced hosted window plane."""
+    env = {
+        "BLUEFOG_CP_HOST": "127.0.0.1",
+        "BLUEFOG_CP_PORT": str(_free_port()),
+        "BLUEFOG_CP_WORLD": "1",
+        "BLUEFOG_CP_RANK": "0",
+        "BLUEFOG_WIN_HOST_PLANE": "1",
+    }
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(8))
+    assert cp.active()
+    yield bf
+    bf.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    cp.reset_for_test()
+
+
+def test_hosted_plane_selected(bf_hosted):
+    assert bf.win_create(jnp.ones((8, 2)), "h.sel")
+    win = win_ops._get_window("h.sel")
+    assert win.hosted and win.owned == list(range(8))
+    bf.win_free("h.sel")
+
+
+def test_put_update_matches_collective_numerics(bf_hosted):
+    x = jnp.arange(8.0).reshape(8, 1) + 1.0
+    assert bf.win_create(x, "h.num")
+    bf.win_put(x, "h.num")
+    got = np.asarray(bf.win_update("h.num"))
+    topo = bf.load_topology()
+    expect = np.zeros((8, 1))
+    for r in range(8):
+        nbrs = bf.topology_util.in_neighbor_ranks(topo, r)
+        u = 1.0 / (len(nbrs) + 1)
+        expect[r] = u * (r + 1) + u * sum(s + 1.0 for s in nbrs)
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+    bf.win_free("h.num")
+
+
+def test_versions_bump_and_reset(bf_hosted):
+    x = jnp.ones((8, 3))
+    assert bf.win_create(x, "h.ver")
+    bf.win_put(x, "h.ver")
+    bf.win_put(x, "h.ver")
+    assert all(v == 2 for v in bf.get_win_version("h.ver", rank=3).values())
+    bf.win_update("h.ver")
+    for r in range(8):
+        assert all(v == 0 for v in bf.get_win_version("h.ver", rank=r).values())
+    bf.win_free("h.ver")
+
+
+def test_get_pulls_published_tensors(bf_hosted):
+    x = jnp.arange(8.0).reshape(8, 1) + 1.0
+    assert bf.win_create(x, "h.get", zero_init=True)
+    bf.win_get("h.get")
+    got = np.asarray(bf.win_update("h.get"))
+    topo = bf.load_topology()
+    for r in range(8):
+        nbrs = bf.topology_util.in_neighbor_ranks(topo, r)
+        u = 1.0 / (len(nbrs) + 1)
+        want = u * (r + 1) + u * sum(s + 1.0 for s in nbrs)
+        np.testing.assert_allclose(got[r], want, rtol=1e-6)
+    bf.win_free("h.get")
+
+
+def test_accumulate_stacks_deposits(bf_hosted):
+    x = jnp.ones((8, 2))
+    assert bf.win_create(x, "h.acc", zero_init=True)
+    bf.win_accumulate(x, "h.acc")
+    bf.win_accumulate(x, "h.acc")
+    got = np.asarray(bf.win_update(
+        "h.acc", self_weight=0.0,
+        neighbor_weights={r: {s: 1.0 for s in
+                              win_ops._get_window("h.acc").in_neighbors[r]}
+                          for r in range(8)}))
+    topo = bf.load_topology()
+    for r in range(8):
+        indeg = len(bf.topology_util.in_neighbor_ranks(topo, r))
+        np.testing.assert_allclose(got[r], 2.0 * indeg, rtol=1e-6)
+    bf.win_free("h.acc")
+
+
+def test_push_sum_invariant_hosted(bf_hosted):
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        x = jnp.arange(8.0).reshape(8, 1) + 1.0
+        assert bf.win_create(x, "h.ps", zero_init=True)
+        topo = bf.load_topology()
+        outd = {r: len(bf.topology_util.out_neighbor_ranks(topo, r))
+                for r in range(8)}
+        sw = {r: 1.0 / (outd[r] + 1) for r in range(8)}
+        dw = {r: {d: 1.0 / (outd[r] + 1)
+                  for d in bf.topology_util.out_neighbor_ranks(topo, r)}
+              for r in range(8)}
+        val = x
+        for _ in range(5):
+            bf.win_accumulate(val, "h.ps", self_weight=sw, dst_weights=dw,
+                              require_mutex=True)
+            val = bf.win_update_then_collect("h.ps")
+            p = bf.win_associated_p_all("h.ps")
+            assert abs(float(np.asarray(val).sum()) - 36.0) < 1e-3
+            assert abs(p.sum() - 8.0) < 1e-9
+        est = np.asarray(val)[:, 0] / p
+        assert np.abs(est - 4.5).max() < 2.0
+        bf.win_free("h.ps")
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+
+
+def test_win_put_optimizer_over_hosted_plane(bf_hosted):
+    """The window-optimizer gossip path (fusion pack -> win ops) runs
+    unchanged over the hosted plane and still descends on the quadratic."""
+    target = jnp.asarray([1.0, -2.0, 3.0])
+
+    def loss(params, batch):
+        return jnp.sum((params["w"] - target) ** 2)
+
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.05), loss_fn=loss)
+    state = opt.init({"w": jnp.zeros(3)})
+    batch = jnp.zeros((8, 1))
+    l0 = None
+    for i in range(20):
+        state, m = opt.step(state, batch)
+        if i == 0:
+            l0 = float(np.asarray(m["loss"]).mean())
+    lN = float(np.asarray(m["loss"]).mean())
+    assert lN < 0.2 * l0, (l0, lN)
+    w = np.asarray(state.params["w"])
+    assert np.abs(w - np.asarray(target)[None]).max() < 0.5
+    opt.free()

@@ -147,6 +147,25 @@ def test_two_process_launch_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_one_sided_windows_across_controllers():
+    """VERDICT-r2 #1: window gossip is truly one-sided across controllers.
+
+    Process 1 sleeps inside its step while process 0 completes win_put +
+    win_update in bounded time (phase A); then a push-sum run with
+    deliberately skewed controller speeds conserves total mass and p mass
+    after a final drain (phase B). See tests/_onesided_child.py.
+    """
+    env = _scrubbed_env()
+    procs, outs = _launch_pair("_onesided_child.py", env)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+        assert f"CHILD_OK {i}" in out
+    assert "PHASE_A_BOUNDED" in outs[0]
+    assert "PHASE_B_UNCOUPLED" in outs[0]
+    assert "PHASE_B_INVARIANT" in outs[0]
+
+
+@pytest.mark.slow
 def test_peer_crash_detected():
     """Fault injection: a controller dies silently; the survivor's heartbeat
     monitor reports it as a DEAD peer (bf.dead_controllers()) instead of a

@@ -105,3 +105,47 @@ def test_mutex_blocks_second_holder():
         assert acquired and acquired[0] - t0 >= 0.1
         c0.close()
         c1.close()
+
+
+def test_bulk_bytes_roundtrip_and_bounded_take():
+    """Bytes transport: append/take record framing, put/get slots, and the
+    bounded take reply (a >64 MiB backlog drains over multiple takes with
+    deposit order preserved)."""
+    with native.ControlPlaneServer(world=1, port=0) as srv:
+        cl = native.ControlPlaneClient("127.0.0.1", srv.port, rank=0)
+        cl.append_bytes("box", b"a")
+        cl.append_bytes("box", b"bb" * 500)
+        assert cl.take_bytes("box") == [b"a", b"bb" * 500]
+        assert cl.take_bytes("box") == []
+
+        cl.put_bytes("slot", b"\x07" * 4096)
+        assert cl.get_bytes("slot") == b"\x07" * 4096
+        assert cl.get_bytes("never") == b""
+
+        # 3 x 30 MiB > the 64 MiB per-reply cap: the first take returns a
+        # bounded prefix, later takes the rest, order intact
+        big = [bytes([i]) * (30 << 20) for i in range(3)]
+        for b in big:
+            cl.append_bytes("deep", b)
+        drained = []
+        takes = 0
+        while True:
+            recs = cl.take_bytes("deep")
+            if not recs:
+                break
+            takes += 1
+            drained.extend(recs)
+        assert takes >= 2, "oversized backlog must need multiple takes"
+        assert [r[:1] for r in drained] == [b"\x00", b"\x01", b"\x02"]
+        assert [len(r) for r in drained] == [30 << 20] * 3
+
+        # batched pipelined ops
+        cl.put_many(["k.0", "k.1", "k.2"], [10, 11, 12])
+        assert cl.get_many(["k.2", "k.0", "k.1"]) == [12, 10, 11]
+
+        # oversized payloads are rejected client-side, connection intact
+        import pytest as _pt
+        with _pt.raises(ValueError):
+            cl.append_bytes("box", b"\x00" * (1 << 30))
+        assert cl.get("k.0") == 10  # connection still healthy
+        cl.close()
