@@ -9,9 +9,15 @@ launcher's job collapses to:
 
   * single host: exec the script (devices = local chips), optionally
     simulating an N-device CPU mesh for development (--simulate N).
-  * multi host: export the JAX distributed env (coordinator, process id,
-    process count) and exec the script on this host; run the same command on
-    every host (or let the TPU pod runtime fan it out).
+  * multi host, one command (``-H host1:1,host2:1`` or ``--hostfile``): the
+    driver fans out every process itself — local slots as subprocesses, remote
+    slots over ssh — assigning ``--process-id`` and the coordinator address
+    automatically, aggregating exit codes, and killing the whole job on
+    Ctrl-C or first failure (the reference's one-shell launch UX,
+    run/run.py:96-280 + horovod_driver.py fan-out, without the NIC-discovery
+    machinery TPU pods don't need).
+  * multi host, manual: export the JAX distributed env (coordinator, process
+    id, process count) and exec the script on this host.
 
 Env parity: --timeline-filename exports BLUEFOG_TIMELINE and --verbose sets
 BLUEFOG_LOG_LEVEL=debug, like run.py:143-174.
@@ -21,7 +27,13 @@ from __future__ import annotations
 
 import argparse
 import os
+import shlex
+import signal
+import socket
+import subprocess
 import sys
+import time
+from typing import List, Tuple
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -37,6 +49,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(required when -np > 1)")
     p.add_argument("--process-id", type=int, default=None,
                    help="this host's process index (multi-host)")
+    p.add_argument("-H", "--hosts", type=str, default=None,
+                   help="comma-separated host:slots list (e.g. "
+                        "'host1:1,host2:1'); the driver launches every "
+                        "process itself (reference run.py -H)")
+    p.add_argument("--hostfile", type=str, default=None,
+                   help="file with one 'host slots=N' (or 'host:N' or bare "
+                        "'host') line per host (reference run.py --hostfile)")
+    p.add_argument("--ssh-port", type=int, default=22,
+                   help="ssh port for remote fan-out (reference --ssh-port)")
+    p.add_argument("--remote-python", type=str, default="python3",
+                   help="python executable to run on remote hosts")
     p.add_argument("--simulate", type=int, default=None, metavar="N",
                    help="simulate an N-device CPU mesh (development)")
     p.add_argument("--timeline-filename", type=str, default=None,
@@ -48,11 +71,199 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def parse_hosts(hosts: str = None, hostfile: str = None) -> List[Tuple[str, int]]:
+    """[(host, slots)] from -H 'h1:2,h2:2' or a hostfile.
+
+    Hostfile lines accept the reference's 'host slots=N' (run.py:96-196),
+    plus 'host:N' and bare 'host' (slots=1); '#' comments and blanks skipped.
+    """
+    entries: List[Tuple[str, int]] = []
+    if hosts:
+        for item in hosts.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            host, _, slots = item.partition(":")
+            entries.append((host, int(slots) if slots else 1))
+    elif hostfile:
+        with open(hostfile) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                host = parts[0]
+                slots = 1
+                for tok in parts[1:]:
+                    if tok.startswith("slots="):
+                        slots = int(tok[len("slots="):])
+                if ":" in host:
+                    host, _, s = host.partition(":")
+                    slots = int(s)
+                entries.append((host, slots))
+    for host, slots in entries:
+        if slots < 1:
+            raise ValueError(f"host {host}: slots must be >= 1, got {slots}")
+    return entries
+
+
+_LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def _is_local(host: str) -> bool:
+    return host in _LOCAL_NAMES or host in (
+        socket.gethostname(), socket.getfqdn())
+
+
+def _check_ssh(host: str, port: int) -> bool:
+    """The reference's pre-launch ssh reachability probe (run.py:205-226)."""
+    r = subprocess.run(
+        ["ssh", "-o", "BatchMode=yes", "-o", "ConnectTimeout=5",
+         "-p", str(port), host, "true"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    return r.returncode == 0
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# env the driver forwards to remote processes (local children inherit all)
+_FORWARD_ENV_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_")
+
+
+def _fanout(args) -> int:
+    """Drive the whole job from this one shell: launch every process, stream
+    its output, aggregate exit codes, kill-all on Ctrl-C or first failure."""
+    entries = parse_hosts(args.hosts, args.hostfile)
+    if not entries:
+        print("bfrun: empty host list", file=sys.stderr)
+        return 1
+    total = sum(s for _, s in entries)
+    if args.num_proc is not None and args.num_proc != total:
+        print(f"bfrun: -np {args.num_proc} does not match the {total} slots "
+              f"in the host list", file=sys.stderr)
+        return 1
+
+    remote_hosts = sorted({h for h, _ in entries if not _is_local(h)})
+    if remote_hosts:
+        # concurrent probes: a slow/down host costs one timeout, not one per
+        # host (the reference driver also probes in parallel)
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(32, len(remote_hosts))) as ex:
+            ok = list(ex.map(lambda h: _check_ssh(h, args.ssh_port),
+                             remote_hosts))
+        unreachable = [h for h, good in zip(remote_hosts, ok) if not good]
+        if unreachable:
+            print(f"bfrun: ssh unreachable host(s): {', '.join(unreachable)}",
+                  file=sys.stderr)
+            return 1
+
+    coordinator = args.coordinator
+    if coordinator is None:
+        first = entries[0][0]
+        if _is_local(first):
+            # remote children must be able to route to process 0: advertise
+            # a real hostname, loopback only for all-local jobs
+            chost = socket.getfqdn() if remote_hosts else "127.0.0.1"
+        else:
+            chost = first
+        # the port is probed free on THIS machine; when process 0 runs
+        # remotely that is only a likely-free ephemeral pick — pass an
+        # explicit --coordinator if the bind fails there
+        coordinator = f"{chost}:{_free_port()}"
+
+    def child_args(pid: int) -> List[str]:
+        out = ["-m", "bluefog_tpu.launcher", "-np", str(total),
+               "--coordinator", coordinator, "--process-id", str(pid)]
+        if args.simulate:
+            out += ["--simulate", str(args.simulate)]
+        if args.timeline_filename:
+            out += ["--timeline-filename", args.timeline_filename]
+        if args.verbose:
+            out += ["--verbose"]
+        return out + ["--"] + args.command
+
+    procs: List[subprocess.Popen] = []
+    pid = 0
+    try:
+        for host, slots in entries:
+            for _ in range(slots):
+                if _is_local(host):
+                    procs.append(subprocess.Popen(
+                        [sys.executable] + child_args(pid)))
+                else:
+                    exports = " ".join(
+                        f"{k}={shlex.quote(v)}"
+                        for k, v in os.environ.items()
+                        if k.startswith(_FORWARD_ENV_PREFIXES)
+                        or k == "PYTHONPATH")
+                    # '&&' so a missing remote workdir fails loudly instead
+                    # of becoming an opaque ModuleNotFoundError later
+                    remote = (f"cd {shlex.quote(os.getcwd())} && "
+                              f"env {exports} {args.remote_python} "
+                              + shlex.join(child_args(pid)))
+                    # -tt: a pty ties the remote process to the connection,
+                    # so kill-all on the ssh client actually kills the job
+                    # on the host (and forwards Ctrl-C)
+                    procs.append(subprocess.Popen(
+                        ["ssh", "-tt", "-o", "BatchMode=yes",
+                         "-p", str(args.ssh_port), host, remote]))
+                pid += 1
+
+        # first failure kills the job (mpirun semantics); otherwise wait all
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed or all(c is not None for c in codes):
+                break
+            time.sleep(0.1)
+        # codes at loop exit are authoritative: processes still running get
+        # terminated below, and their -SIGTERM must not mask the real failure
+        own_exit = [c for c in codes if c is not None]
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        deadline = time.time() + 5
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        return 130
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+    rc = 0
+    for c in own_exit:
+        if c != 0:
+            rc = c if c > 0 else 128 + abs(c)  # signal deaths, shell-style
+            break
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if not args.command:
         build_parser().print_usage()
         return 1
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+
+    # driver mode: a host list without an explicit --process-id means THIS
+    # invocation fans out the whole job (children re-enter below with ids)
+    if (args.hosts or args.hostfile) and args.process_id is None:
+        return _fanout(args)
 
     env = dict(os.environ)
     if args.timeline_filename:
@@ -76,8 +287,6 @@ def main(argv=None) -> int:
         env["JAX_PROCESS_ID"] = str(args.process_id)
 
     cmd = args.command
-    if cmd and cmd[0] == "--":
-        cmd = cmd[1:]
     os.execvpe(cmd[0], cmd, env)
 
 

@@ -11,6 +11,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
@@ -144,6 +145,62 @@ def test_two_process_launch_smoke(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
         assert f"CHILD_OK {i}" in out
+
+
+def test_parse_hosts_formats(tmp_path):
+    from bluefog_tpu.launcher import parse_hosts
+    assert parse_hosts("h1:2,h2:2") == [("h1", 2), ("h2", 2)]
+    assert parse_hosts("h1, h2:3") == [("h1", 1), ("h2", 3)]
+    hf = tmp_path / "hosts"
+    hf.write_text("# cluster\nh1 slots=4\nh2:2\nh3\n\n")
+    assert parse_hosts(hostfile=str(hf)) == [("h1", 4), ("h2", 2), ("h3", 1)]
+    with pytest.raises(ValueError):
+        parse_hosts("h1:0")
+
+
+@pytest.mark.slow
+def test_hostfile_fanout_two_processes():
+    """VERDICT-r2 #3: ONE bfrun command drives the whole 2-process job —
+    automatic process ids + coordinator, aggregated exit codes. Runs the
+    same full multi-controller child as the manual smoke."""
+    env = _scrubbed_env()
+    env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.3"
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher",
+         "-H", "localhost:2", "--simulate", "2",
+         "--", sys.executable, str(TESTS / "_launch_child.py")],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "CHILD_OK 0" in out.stdout and "CHILD_OK 1" in out.stdout
+
+
+@pytest.mark.slow
+def test_fanout_aggregates_failure():
+    """A failing process makes the driver kill the job and report nonzero."""
+    env = _scrubbed_env()
+    code = ("import os, sys, time; "
+            "sys.exit(7) if os.environ['JAX_PROCESS_ID'] == '1' "
+            "else time.sleep(60)")
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher",
+         "-H", "localhost:2", "--", sys.executable, "-c", code],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 7, (out.returncode, out.stdout + out.stderr)
+    # the survivor slept 60s; first-failure kill must not wait it out
+    assert time.monotonic() - t0 < 45
+
+
+def test_fanout_rejects_np_slot_mismatch():
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "-np", "3",
+         "-H", "localhost:2", "--", "true"],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 1
+    assert "does not match" in out.stderr
 
 
 @pytest.mark.slow
