@@ -11,7 +11,7 @@ MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=
 test:            ## full suite (slow: ~1 h on a shared-core CPU mesh)
 	$(PYTEST) tests/ -q
 
-test_fast:       ## quick subset (skips @slow)
+test_fast:       ## the pre-commit gate: quick subset (skips @slow)
 	$(PYTEST) tests/ -q -m "not slow"
 
 # per-area targets mirroring the reference's test_torch_ops / test_torch_win_ops / ...

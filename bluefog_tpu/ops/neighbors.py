@@ -18,6 +18,9 @@ Weight semantics follow the reference exactly:
 from __future__ import annotations
 
 import functools
+import hashlib
+import os
+import time
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -183,7 +186,68 @@ def _dynamic_weight_matrix(
         W[dst, dst] = sw_list[dst]
         for src, w in nw_per_rank[dst].items():
             W[src, dst] = w
+    if enable_topo_check:
+        cross_controller_topo_check(W)
     return W
+
+
+def cross_controller_topo_check(W: np.ndarray) -> None:
+    """Verify every controller computed the SAME dynamic combine matrix.
+
+    The reference's ``enable_topo_check`` allgathers the send/recv boolean
+    matrix across processes each dynamic step
+    (mpi_controller.cc:296-345). Multi-controller analog: each controller
+    publishes a hash of its step's W matrix under a per-hash rendezvous
+    counter on the control plane and waits until all ``world`` controllers
+    have checked in. Agreement = everyone increments the SAME hash key, so
+    equality needs no second exchange. Divergence = some controller waits on
+    a hash key its peers never touch, and the bounded wait raises instead of
+    letting different edge sets silently corrupt the ppermutes.
+
+    Each distinct W pays this once per process: agreed hashes are cached on
+    the runtime state (reset at init/set_topology), so warm steps of a
+    cyclic schedule cost nothing. Consequence of the cache, stated plainly:
+    if two controllers later pick DIFFERENT matrices that were each
+    individually agreed in the past (e.g. de-synchronized positions in the
+    same schedule), both cache-hit and the divergence is not re-detected —
+    the per-step reference check would catch it, this cached one trades
+    that for zero warm-step cost.
+    """
+    from ..runtime import control_plane as _cp
+
+    if not (_cp.active() and _cp.world() > 1):
+        return
+    st = _global_state()
+    h = hashlib.sha1(np.ascontiguousarray(W).tobytes()).hexdigest()[:24]
+    if h in st._topo_check_agreed:
+        return
+    cl = _cp.client()
+    world = _cp.world()
+    # Idempotent per-controller check-in (one key per controller, not a
+    # shared counter): a controller retrying after a failed rendezvous
+    # cannot inflate the count into false agreement. Key lifetime == the
+    # control-plane server == the job (the launcher's process 0 serves
+    # in-process), so no cross-job staleness in the standard deployment;
+    # an externally shared long-lived server must be restarted between jobs.
+    cl.put(f"tc.{h}.{st.process_index}", 1)
+    keys = [f"tc.{h}.{p}" for p in range(world)]
+    timeout = float(os.environ.get("BLUEFOG_TOPO_CHECK_TIMEOUT", "30"))
+    deadline = time.monotonic() + timeout
+    while True:
+        agreed = sum(1 for v in cl.get_many(keys) if v)
+        if agreed >= world:
+            st._topo_check_agreed.add(h)
+            return
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.02)
+    raise RuntimeError(
+        f"cross-controller topology check failed: controller "
+        f"{st.process_index} computed combine-matrix hash {h} but only "
+        f"{agreed}/{world} controllers agreed within {timeout:.0f}s — "
+        "controllers are dispatching DIFFERENT dynamic edge sets (check the "
+        "per-step send_neighbors/neighbor_weights derivation, or set "
+        "enable_topo_check=False to skip)")
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ work is done and the stall watchdog tracks handles that never complete
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from typing import Any, Dict, Optional, Tuple
@@ -68,19 +69,69 @@ def poll(handle: int) -> bool:
     )
 
 
-def synchronize(handle: int) -> Any:
-    """Block until the op completes and return its output pytree."""
+def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
+    """Block until the op completes and return its output pytree.
+
+    ``timeout`` (seconds; default from ``BLUEFOG_SYNC_TIMEOUT``, unset =
+    wait forever) bounds the wait: on expiry the handle stays valid for a
+    retry and a RuntimeError is raised carrying the failure detector's
+    diagnosis — in a multi-controller job a dead peer (heartbeat silence)
+    is named instead of the op hanging forever on the corpse. The reference
+    only *warns* about stalls (CheckForStalledTensors, operations.cc:
+    387-432); this makes the stall a first-class, attributable failure.
+    """
+    if timeout is None:
+        env = os.environ.get("BLUEFOG_SYNC_TIMEOUT")
+        timeout = float(env) if env else None
+    # atomic pop: concurrent synchronize calls on one handle keep the
+    # consume-once contract (exactly one wins; the other gets ValueError)
     with _lock:
         entry = _handle_map.pop(handle, None)
     if entry is None:
         raise ValueError(f"unknown or already-synchronized handle {handle}")
-    _, _, outputs = entry
-    return jax.block_until_ready(outputs)
+    name, t0, outputs = entry
+    if timeout is None:
+        return jax.block_until_ready(outputs)
+
+    deadline = time.monotonic() + timeout
+    leaves = jax.tree_util.tree_leaves(outputs)
+
+    def ready() -> bool:
+        return all(leaf.is_ready() if hasattr(leaf, "is_ready") else True
+                   for leaf in leaves)
+
+    while True:
+        # readiness check runs at least once and once more AFTER the
+        # deadline: an op finishing during the final sleep (or timeout=0,
+        # the "poll once" form) returns instead of raising spuriously
+        if ready():
+            return jax.block_until_ready(outputs)
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.01)
+
+    # timed out: re-register under the same id so the caller can retry
+    with _lock:
+        _handle_map[handle] = entry
+
+    from .heartbeat import dead_controllers
+    dead = dead_controllers()
+    diagnosis = (
+        f"controller process(es) {sorted(dead)} are DEAD (heartbeat "
+        "silence) — the collective can never complete; abandon the handle "
+        "and tear down" if dead else
+        "no peer is reported dead — the op may be slow, the job "
+        "overloaded, or a peer controller may not have dispatched the "
+        "same op (see enable_topo_check / the stall watchdog)")
+    raise RuntimeError(
+        f"synchronize('{name}', handle {handle}) exceeded the "
+        f"{timeout:.1f}s deadline after {time.monotonic() - t0:.1f}s in "
+        f"flight: {diagnosis}")
 
 
-def wait(handle: int) -> Any:
+def wait(handle: int, timeout: Optional[float] = None) -> Any:
     """Alias of synchronize (reference: mpi_ops.py:857-869)."""
-    return synchronize(handle)
+    return synchronize(handle, timeout)
 
 
 def outstanding() -> Dict[int, Tuple[str, float]]:

@@ -60,6 +60,9 @@ class BluefogTPUState:
         self.watchdog = None  # runtime.watchdog.StallWatchdog when enabled
         self.peer_monitor = None  # runtime.heartbeat.PeerMonitor (multi-ctrl)
         self._plan_cache: Dict[Any, Any] = {}  # compiled combine plans
+        # combine-matrix hashes every controller has agreed on
+        # (ops.neighbors.cross_controller_topo_check)
+        self._topo_check_agreed: set = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -201,6 +204,7 @@ def init(
     st.windows = {}
     st.win_ops_with_associated_p = False
     st._plan_cache = {}
+    st._topo_check_agreed = set()
     st.initialized = True
 
     if topology_fn is not None:
@@ -405,6 +409,7 @@ def set_topology(topology: Optional[nx.DiGraph] = None, is_weighted: bool = Fals
     st.topology = topology
     st.is_topo_weighted = is_weighted
     st._plan_cache.clear()  # new graph -> new combine plans / jit traces
+    st._topo_check_agreed.clear()
     return True
 
 

@@ -35,20 +35,47 @@ def main() -> None:
         # the fault: die silently — no announce_shutdown, no atexit hooks
         os._exit(17)
 
-    # survivor: poll the failure detector (never a collective — that would
-    # hang on the corpse, which is exactly what detection exists to avoid)
+    # survivor: poll the failure detector (never an unbounded collective —
+    # that would hang on the corpse, which detection exists to avoid)
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
         if bf.dead_controllers() == {1}:
             print("SURVIVOR_DETECTED 1", flush=True)
-            # skip graceful teardown: jax.distributed barriers would block
-            # on the dead peer; detection IS the deliverable here
-            os._exit(0)
+            break
         assert not bf.shutdown_requested(), \
             "crash must be detected as a DEAD peer, not a coordinated shutdown"
         time.sleep(0.1)
-    print("SURVIVOR_TIMEOUT", flush=True)
-    os._exit(3)
+    else:
+        print("SURVIVOR_TIMEOUT", flush=True)
+        os._exit(3)
+
+    # bounded-wait synchronize (VERDICT-r2 #8): dispatch a collective that
+    # can never complete (the peer is dead) in a side thread — some runtimes
+    # block in dispatch itself — and require the deadline to fire with the
+    # heartbeat's diagnosis instead of hanging forever
+    import threading
+    result = {}
+
+    def doomed():
+        try:
+            h = bf.allreduce_nonblocking(x)
+            bf.synchronize(h, timeout=5.0)
+            result["outcome"] = "completed?!"
+        except RuntimeError as e:
+            result["outcome"] = "raised"
+            result["msg"] = str(e)
+
+    t = threading.Thread(target=doomed, daemon=True)
+    t.start()
+    t.join(25.0)
+    if result.get("outcome") == "raised" and "DEAD" in result.get("msg", "") \
+            and "[1]" in result["msg"]:
+        print("SURVIVOR_SYNC_RAISED 1", flush=True)
+        # skip graceful teardown: jax.distributed barriers would block on
+        # the dead peer
+        os._exit(0)
+    print(f"SURVIVOR_SYNC_BAD {result}", flush=True)
+    os._exit(4)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,66 @@
+"""Child for the cross-controller dynamic topo-check test (2 processes).
+
+Reference parity: ``enable_topo_check`` allgathers the send/recv pattern
+across processes and fails on mismatch (mpi_controller.cc:296-345). Here the
+controllers first run one AGREED dynamic step (must pass, and its repeat must
+be a cached no-op), then deliberately compute DIVERGENT send_neighbors —
+every controller must raise instead of dispatching garbage ppermutes.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+
+import bluefog_tpu as bf
+
+os.environ["BLUEFOG_TOPO_CHECK_TIMEOUT"] = "3"
+
+
+def main() -> None:
+    bf.init()
+    pid = jax.process_index("cpu")
+    n = bf.size()
+    assert n == 4
+
+    x = np.arange(n, dtype=np.float32).reshape(n, 1)
+    sh = bf.rank_sharding(bf.mesh())
+    xg = jax.make_array_from_callback(x.shape, sh, lambda i: x[i])
+
+    # agreed dynamic step: ring shift by one, identical on both controllers
+    send = {r: [(r + 1) % n] for r in range(n)}
+    sw = {r: 0.5 for r in range(n)}
+    nw = {r: {(r - 1) % n: 0.5} for r in range(n)}
+    y = bf.neighbor_allreduce(xg, self_weight=sw, neighbor_weights=nw,
+                              send_neighbors=send, enable_topo_check=True)
+    for s in y.addressable_shards:
+        r = s.index[0].start or 0
+        want = 0.5 * x[r] + 0.5 * x[(r - 1) % n]
+        np.testing.assert_allclose(np.asarray(s.data)[0], want, atol=1e-6)
+    # warm repeat: cached agreement, no rendezvous cost, same result
+    bf.neighbor_allreduce(xg, self_weight=sw, neighbor_weights=nw,
+                          send_neighbors=send, enable_topo_check=True)
+    print(f"AGREED_OK {pid}", flush=True)
+    bf.barrier()
+
+    # divergent step: BOTH controllers move to edge sets that are new to the
+    # agreement cache (shift 3 vs shift 2) but different from each other —
+    # each waits on its own hash rendezvous, times out, and raises
+    shift = 3 if pid == 0 else 2
+    bad_send = {r: [(r + shift) % n] for r in range(n)}
+    bad_nw = {r: {(r - shift) % n: 0.5} for r in range(n)}
+    try:
+        bf.neighbor_allreduce(xg, self_weight=sw, neighbor_weights=bad_nw,
+                              send_neighbors=bad_send, enable_topo_check=True)
+        raise AssertionError("divergent edge sets were not detected")
+    except RuntimeError as e:
+        assert "DIFFERENT dynamic edge sets" in str(e), e
+    print(f"DIVERGENT_RAISED {pid}", flush=True)
+    bf.barrier()
+    bf.shutdown()
+    print(f"CHILD_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,12 +10,14 @@ import os
 import sys
 
 import jax
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import __graft_entry__ as graft  # noqa: E402
 
 
+@pytest.mark.slow  # ResNet-50 trace+lower is minutes-scale on 1 core
 def test_entry_traces():
     fn, args = graft.entry()
     # The driver compile-checks single-chip; tracing catches API breakage

@@ -70,6 +70,7 @@ def test_flash_compiles_on_real_tpu():
         atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.slow  # kernel-vs-dense VJP kept in the full suite
 def test_flash_gradient_matches_dense():
     """flash_attention differentiates: grads match the dense oracle (the
     backward is the VJP of the checkpointed blockwise twin)."""

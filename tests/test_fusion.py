@@ -86,6 +86,7 @@ def _run_winput_consensus(threshold, monkeypatch):
         bf.shutdown()
 
 
+@pytest.mark.slow  # window+compile heavy; fused_push_sum stays fast
 def test_fused_gossip_one_window_and_same_numerics(monkeypatch):
     """Default threshold: 12 leaves -> ONE window (one compiled put+update
     per step); numerics identical to the unfused per-leaf path."""
@@ -132,6 +133,7 @@ def test_fused_push_sum_consensus(monkeypatch):
         bf.shutdown()
 
 
+@pytest.mark.slow
 def test_many_small_nonblocking_ops_then_synchronize(bf8):
     """Port of the reference's fusion-under-load pattern
     (torch_ops_test.py:920): launch many small nonblocking ops, then

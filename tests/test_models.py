@@ -47,6 +47,7 @@ def test_odd_input_rejected_by_s2d():
         model.init(jax.random.PRNGKey(0), x, train=True)
 
 
+@pytest.mark.slow  # VGG compile is minutes-scale on 1 core
 def test_vgg_forward_bn_and_plain():
     x = jnp.zeros((2, 32, 32, 3), jnp.float32)
     bn = models.VGG11(num_classes=10)
@@ -64,10 +65,12 @@ def test_vgg_forward_bn_and_plain():
 
 
 def test_vgg16_config_matches_torchvision_layout():
-    # config D: 13 convs + 3 dense; conv widths per stage 2,2,3,3,3
+    # config D: 13 convs + 3 dense; conv widths per stage 2,2,3,3,3.
+    # Shape-only assertions: eval_shape skips the minutes-scale compile.
     x = jnp.zeros((1, 32, 32, 3), jnp.float32)
     model = models.VGG16(num_classes=5, dropout_rate=0.0)
-    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    v = jax.eval_shape(lambda k: model.init(k, x, train=False),
+                       jax.random.PRNGKey(0))
     convs = [k for k in v["params"] if k.startswith("conv_")]
     assert len(convs) == 13
     widths = [v["params"][k]["kernel"].shape[-1] for k in sorted(
@@ -81,6 +84,7 @@ def test_vgg16_config_matches_torchvision_layout():
     assert all("bias" in v["params"][k] for k in convs)
 
 
+@pytest.mark.slow
 def test_vgg_resolution_portability_via_7x7_pool():
     # 224-class resolutions (multiples of 7 post-conv) share classifier shapes
     model = models.VGG11(num_classes=3, dropout_rate=0.0, batch_norm=False)

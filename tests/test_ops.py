@@ -64,6 +64,15 @@ class TestAllreduce:
         with pytest.raises(ValueError):
             bf8.synchronize(handle)  # double-synchronize rejected
 
+    def test_synchronize_with_deadline_completes(self, bf8):
+        # bounded-wait path: a healthy op completes well inside the deadline
+        # and the handle is consumed exactly like the unbounded path
+        handle = bf8.allreduce_nonblocking(rank_tensor())
+        out = bf8.synchronize(handle, timeout=30.0)
+        np.testing.assert_allclose(np.asarray(out), 3.5, atol=1e-6)
+        with pytest.raises(ValueError):
+            bf8.synchronize(handle)
+
     def test_bf16_accumulation(self, bf8):
         x = rank_tensor(dtype=jnp.bfloat16)
         out = bf8.allreduce(x)

@@ -20,9 +20,19 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
 import optimization as opt  # noqa: E402
 
 
-@pytest.fixture()
-def problem(bf8):
-    """A ring-topology linear-regression instance plus its true optimum."""
+@pytest.fixture(scope="module")
+def problem():
+    """A ring-topology linear-regression instance plus its true optimum.
+
+    Module-scoped: one init + one 400-iteration centralized baseline shared
+    by every algorithm test (each test's own loop is read-only w.r.t. the
+    problem). Iteration budgets below are sized from measured convergence
+    (exact diffusion reaches 2e-5 by iteration 100 on this instance) —
+    dispatch-per-iteration on the single-core CI box is what makes these
+    the suite's hottest tests.
+    """
+    from conftest import cpu_devices
+    bf.init(devices=cpu_devices(8))
     size = bf.size()
     opt.set_example_topology("ring")
     X, y = opt.generate_data(
@@ -34,7 +44,8 @@ def problem(bf8):
     # sanity: the baseline itself is at a stationary point of the average loss
     g = bf.allreduce(grad_fn(w_opt), average=True)
     assert float(jnp.linalg.norm(g)) < 1e-4
-    return grad_fn, w_opt, size
+    yield grad_fn, w_opt, size
+    bf.shutdown()
 
 
 def _assert_converged(w, w_opt, mse, tol):
@@ -46,28 +57,30 @@ def _assert_converged(w, w_opt, mse, tol):
 
 def test_exact_diffusion_converges(problem):
     grad_fn, w_opt, size = problem
-    w, mse = opt.exact_diffusion(grad_fn, w_opt, size, 5, maxite=400,
+    w, mse = opt.exact_diffusion(grad_fn, w_opt, size, 5, maxite=100,
                                  alpha=0.1)
     _assert_converged(w, w_opt, mse, tol=1e-3)
 
 
 def test_gradient_tracking_converges(problem):
     grad_fn, w_opt, size = problem
-    w, mse = opt.gradient_tracking(grad_fn, w_opt, size, 5, maxite=400,
+    w, mse = opt.gradient_tracking(grad_fn, w_opt, size, 5, maxite=150,
                                    alpha=0.05)
     _assert_converged(w, w_opt, mse, tol=1e-3)
 
 
+@pytest.mark.slow  # win-op dispatch per iteration; push-sum mechanics are
+# fast-covered by test_hosted_windows + test_fusion's fused push-sum
 def test_push_diging_converges(problem):
     grad_fn, w_opt, size = problem
-    w, mse = opt.push_diging(grad_fn, w_opt, size, 5, maxite=300, alpha=0.05)
+    w, mse = opt.push_diging(grad_fn, w_opt, size, 5, maxite=150, alpha=0.05)
     _assert_converged(w, w_opt, mse, tol=1e-3)
 
 
 def test_plain_diffusion_is_biased_but_close(problem):
     """Diffusion converges to a neighborhood (not exactly) of the optimum."""
     grad_fn, w_opt, size = problem
-    w, mse = opt.diffusion(grad_fn, w_opt, size, 5, maxite=400, alpha=0.05)
+    w, mse = opt.diffusion(grad_fn, w_opt, size, 5, maxite=150, alpha=0.05)
     # with a constant step size diffusion has O(alpha) bias: near, not exact
     assert float(jnp.max(jnp.linalg.norm(w - w_opt, axis=(1, 2)))) < 0.5
 

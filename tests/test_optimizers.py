@@ -228,18 +228,21 @@ def test_mlp_trains_loss_decreases(bf8):
     opt = bf.DistributedNeighborAllreduceOptimizer(optax.sgd(0.5), loss_fn)
     state = opt.init(params)
     losses = []
-    for _ in range(20):
+    for _ in range(10):
         state, m = opt.step(state, (x, y))
         losses.append(float(np.mean(np.asarray(m["loss"]))))
     assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
 
 
 def test_resnet_forward_shape():
+    # shape-only contract: eval_shape skips the ResNet compile (the numeric
+    # forward is covered by the slow-marked model/interop oracles)
     model = bf.models.ResNet18(num_classes=10, dtype=jnp.float32)
     rng = jax.random.PRNGKey(0)
     x = jnp.zeros((2, 32, 32, 3))
-    variables = model.init(rng, x, train=False)
-    out = model.apply(variables, x, train=False)
+    variables = jax.eval_shape(lambda k: model.init(k, x, train=False), rng)
+    out = jax.eval_shape(
+        lambda v: model.apply(v, x, train=False), variables)
     assert out.shape == (2, 10)
     assert out.dtype == jnp.float32
 

@@ -20,7 +20,11 @@ def make_lm(layers=4, heads=2, d_model=16, d_ff=32, vocab=32, batch=4, seq=8):
     return model, params, tokens
 
 
-@pytest.mark.parametrize("n_stages,n_micro", [(4, 2), (2, 4), (8, 4)])
+@pytest.mark.parametrize("n_stages,n_micro", [
+    pytest.param(4, 2, marks=pytest.mark.slow),
+    (2, 4),
+    pytest.param(8, 4, marks=pytest.mark.slow),
+])
 def test_pp_matches_single_device(n_stages, n_micro):
     model, params, tokens = make_lm(layers=8, batch=4)
     oracle = model.apply({"params": params}, tokens)

@@ -27,6 +27,7 @@ def make_lm(heads=4, d_model=32, d_ff=64, vocab=64, layers=2):
     return model, params, tokens
 
 
+@pytest.mark.slow  # exactness kept in the full suite
 def test_tp_matches_single_device():
     model, params, tokens = make_lm()
     oracle = model.apply({"params": params}, tokens)

@@ -75,6 +75,7 @@ class TorchResNet18(tnn.Module):
         return self.fc(x)
 
 
+@pytest.mark.slow  # numeric oracle kept in the full suite
 def test_resnet18_forward_matches_torch_oracle():
     torch.manual_seed(0)
     tmodel = TorchResNet18(num_classes=10).eval()
@@ -93,6 +94,7 @@ def test_resnet18_forward_matches_torch_oracle():
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # ResNet-50 compile on 1 core
 def test_resnet50_mapping_covers_full_tree():
     """Bottleneck mapping: a synthetic torchvision-format state_dict built
     from the flax template round-trips to the exact same tree structure."""

@@ -37,6 +37,7 @@ def test_cp_apply_matches_dense(bf8, kind):
                                atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow  # grad-of-ring-scan compile is minutes-scale on 1 core
 def test_cp_loss_and_grads_match_dense(bf8):
     model = make_model()
     tokens = make_batch(1)
@@ -59,6 +60,7 @@ def test_cp_loss_and_grads_match_dense(bf8):
                                    atol=1e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_cp_training_step_decreases_loss(bf8):
     model = make_model()
     tokens = make_batch(3, B=2, S=64)
