@@ -39,9 +39,12 @@ from .lm import cp_apply, cp_loss_fn
 from .pipeline import (
     pp_apply,
     pp_forward_fn,
+    pp_loss_fn,
     pp_mesh,
     pp_place_params,
     pp_stack_params,
+    pp_train_init,
+    pp_train_step_fn,
 )
 from .tensor import (
     LM_TP_RULES,
@@ -72,6 +75,9 @@ __all__ = [
     "pp_place_params",
     "pp_mesh",
     "pp_stack_params",
+    "pp_loss_fn",
+    "pp_train_init",
+    "pp_train_step_fn",
     "SwitchFFN",
     "ep_apply",
     "ep_place_params",

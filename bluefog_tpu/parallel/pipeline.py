@@ -33,6 +33,7 @@ import functools
 from typing import Optional, Sequence
 
 import numpy as np
+import optax
 
 import jax
 import jax.numpy as jnp
@@ -74,7 +75,8 @@ def pp_stack_params(params, n_stages: int):
 
 
 @functools.lru_cache(maxsize=16)
-def _pp_fn(model, mesh: Mesh, n_stages: int, n_micro: int):
+def _pp_fwd(model, mesh: Mesh, n_stages: int, n_micro: int):
+    """Unjitted pipelined forward (the differentiable building block)."""
     # deferred: models.transformer imports parallel.context at package
     # import time, so a top-level import here would be circular
     from ..models.transformer import Block
@@ -90,6 +92,10 @@ def _pp_fn(model, mesh: Mesh, n_stages: int, n_micro: int):
         sp = jax.tree_util.tree_map(lambda x: x[0], stage_params)
 
         def apply_chunk(x):
+            # remat per layer: the backward recomputes each block instead of
+            # storing its internals for every tick of the schedule — the
+            # activation-memory discipline GPipe training needs
+            @jax.checkpoint
             def body(h, p):
                 return block.apply({"params": p}, h, positions), None
             out, _ = lax.scan(body, x, sp)
@@ -161,7 +167,12 @@ def _pp_fn(model, mesh: Mesh, n_stages: int, n_micro: int):
         logits = head_mod.apply({"params": rest["lm_head"]}, x)
         return logits.astype(jnp.float32)
 
-    return jax.jit(fwd)
+    return fwd
+
+
+@functools.lru_cache(maxsize=16)
+def _pp_fn(model, mesh: Mesh, n_stages: int, n_micro: int):
+    return jax.jit(_pp_fwd(model, mesh, n_stages, n_micro))
 
 
 def pp_forward_fn(model, mesh: Mesh, n_micro: int = 2):
@@ -177,6 +188,85 @@ def pp_forward_fn(model, mesh: Mesh, n_micro: int = 2):
 def pp_place_params(stacked, mesh: Mesh):
     """Put a stage-stacked block tree on the mesh, one stage per device."""
     return jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
+
+
+def pp_loss_fn(model, mesh: Mesh, n_micro: int = 2):
+    """Next-token cross-entropy through the pipelined forward.
+
+    ``loss(stacked_blocks, rest, (tokens, targets)) -> scalar``, fully
+    differentiable: autodiff through the GPipe scan runs the backward
+    pipeline in reverse tick order (gradient handoffs are the transposed
+    ppermutes), with per-layer rematerialization (``jax.checkpoint``) so
+    activation memory stays per-tick, not per-schedule.
+    """
+    fwd = _pp_fwd(model, mesh, mesh.shape["pipe"], n_micro)
+
+    def loss(stacked_blocks, rest, batch):
+        tokens, targets = batch
+        logits = fwd(stacked_blocks, rest, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    return loss
+
+
+def pp_train_step_fn(model, mesh: Mesh, optimizer, n_micro: int = 2):
+    """Compiled pipelined TRAINING step (net-new; SURVEY §2.6 PP row).
+
+    ``step(stacked_blocks, rest, opt_state, batch) -> (stacked, rest,
+    opt_state, loss)`` where ``batch = (tokens, targets)``; gradients flow
+    through the whole GPipe schedule (microbatch accumulation is implicit:
+    the loss averages over every microbatch, so its gradient IS the
+    accumulated per-microbatch gradient), the optax update runs on both the
+    stage-sharded block stack and the replicated prologue/epilogue params,
+    and state is donated. Init with :func:`pp_stack_params` +
+    :func:`pp_place_params`; numerics match the single-device step exactly
+    (tests/test_pipeline_parallel.py pins the loss curve).
+    """
+    loss = pp_loss_fn(model, mesh, n_micro)
+
+    def step(stacked_blocks, rest, opt_state, batch):
+        l, grads = jax.value_and_grad(
+            lambda s, r: loss(s, r, batch), argnums=(0, 1))(
+                stacked_blocks, rest)
+        updates, opt_state = optimizer.update(
+            grads, opt_state, (stacked_blocks, rest))
+        stacked_blocks, rest = optax.apply_updates(
+            (stacked_blocks, rest), updates)
+        return stacked_blocks, rest, opt_state, l
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def pp_train_init(model, mesh: Mesh, params, optimizer):
+    """(stacked_blocks placed on the pipe mesh, rest, opt_state) for
+    :func:`pp_train_step_fn` from a plain TransformerLM param dict.
+
+    ``rest`` and ``opt_state`` are explicitly placed mesh-replicated: the
+    train step's outputs come back with mesh shardings, so placing the
+    inputs the same way avoids a full second compile on step 2 — and since
+    the step donates its state, placement also COPIES ``rest`` so donation
+    can never invalidate the caller's original param arrays."""
+    stacked, rest = pp_stack_params(params, mesh.shape["pipe"])
+    stacked = pp_place_params(stacked, mesh)
+    rest = jax.device_put(rest, NamedSharding(mesh, P()))
+    # Optimizer state must enter the step with the SAME shardings the step
+    # outputs (stage-sharded moments for stacked params, replicated for the
+    # rest) or call 2 pays a full recompile. optax's init builds moments as
+    # shape-only constants, so sharding does not propagate from the params —
+    # place param-shaped state leaves like their params explicitly, and
+    # sweep the param-independent leaves (e.g. adam's count) to
+    # mesh-replicated (plain init would drop them on the default device,
+    # which may not even belong to the mesh).
+    opt_state = optimizer.init((stacked, rest))
+    opt_state = optax.tree_utils.tree_map_params(
+        optimizer, lambda s, p: jax.device_put(s, p.sharding), opt_state,
+        (stacked, rest))
+    rep = NamedSharding(mesh, P())
+    opt_state = jax.tree_util.tree_map(
+        lambda x: x if isinstance(getattr(x, "sharding", None), NamedSharding)
+        else jax.device_put(x, rep), opt_state)
+    return stacked, rest, opt_state
 
 
 def pp_apply(model, params, tokens, mesh: Mesh, n_micro: int = 2):

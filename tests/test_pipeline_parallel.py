@@ -81,3 +81,52 @@ def test_pp_forward_fn_reuses_placed_params():
     out2 = fwd(placed, rest, tokens)  # second step: no restack, same program
     np.testing.assert_allclose(np.asarray(out1), np.asarray(oracle), atol=1e-4)
     np.testing.assert_allclose(np.asarray(out2), np.asarray(out1), atol=0)
+
+
+def test_pp_training_matches_single_device_loss_curve():
+    """VERDICT-r2 #4: a 2-stage LM TRAINS through the pipeline — gradients
+    flow through the whole GPipe scan (remat'd blocks, ppermute handoffs)
+    and the loss curve tracks the single-device step step-for-step."""
+    import optax
+
+    model, params, tokens = make_lm(layers=2, batch=4, seq=8)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mesh = pp.pp_mesh(2, cpu_devices(2))
+    optimizer = optax.adam(1e-2)
+
+    # single-device oracle step over the SAME init
+    def dense_loss(p, batch):
+        toks, tgts = batch
+        logits = model.apply({"params": p}, toks)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, tgts[..., None], axis=-1).mean()
+
+    @jax.jit
+    def dense_step(p, opt_state, batch):
+        l, g = jax.value_and_grad(dense_loss)(p, batch)
+        updates, opt_state = optimizer.update(g, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, l
+
+    stacked, rest, pp_opt = pp.pp_train_init(model, mesh, params, optimizer)
+    pp_step = pp.pp_train_step_fn(model, mesh, optimizer, n_micro=2)
+
+    dense_p, dense_opt = params, optimizer.init(params)
+    batch = (tokens, targets)
+    pp_losses, dense_losses = [], []
+    for _ in range(8):
+        stacked, rest, pp_opt, lp = pp_step(stacked, rest, pp_opt, batch)
+        dense_p, dense_opt, ld = dense_step(dense_p, dense_opt, batch)
+        pp_losses.append(float(lp))
+        dense_losses.append(float(ld))
+    # training works...
+    assert pp_losses[-1] < pp_losses[0]
+    # ...and matches the single-device curve step for step (same function,
+    # same grads up to fp reassociation)
+    np.testing.assert_allclose(pp_losses, dense_losses, rtol=2e-4, atol=2e-4)
+    # the final pipelined params reproduce the dense model's forward
+    logits_pp = pp.pp_forward_fn(model, mesh, n_micro=2)(stacked, rest,
+                                                         tokens)
+    logits_dense = model.apply({"params": dense_p}, tokens)
+    np.testing.assert_allclose(np.asarray(logits_pp),
+                               np.asarray(logits_dense), atol=2e-3,
+                               rtol=2e-3)
