@@ -64,12 +64,15 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
     kernel (parallel.flash.flash_block) instead of XLA einsums: scores never
     reach HBM, which is what lets per-chip K/V blocks grow long. ``interpret``
     runs that kernel in interpreter mode (CPU test meshes). Both paths
-    differentiate through the same reverse-rotation ring backward
+    differentiate through the same reverse-rotation ring backward schedule
     (``_ring_backward``): one more K/V trip around the ring with gradient
-    blocks traveling alongside — residuals and carries are O(S/n) per chip,
-    with one [S/n, S/n] score block live per step (same per-step shape as
-    the einsum forward). Reverse-mode only: the custom VJP means
-    ``jax.jvp``/forward-over-reverse is unsupported on both ring paths.
+    blocks traveling alongside — residuals and carries are O(S/n) per chip.
+    The flash path's per-step block gradients run in the pallas backward
+    kernels (``flash.flash_block_bwd``, flash-attention-2 dq + dk/dv
+    passes), so probability tiles stay in VMEM in the backward too; the
+    einsum path materializes one [S/n, S/n] f32 block per step via XLA.
+    Reverse-mode only: the custom VJP means ``jax.jvp``/forward-over-reverse
+    is unsupported on both ring paths.
     """
     if use_flash:
         return _ring_flash_diff(q, k, v, axis_name, causal, interpret)
@@ -123,7 +126,8 @@ def _ring_einsum_partials(q, k, v, axis_name: str, causal: bool):
             jnp.moveaxis(m, 1, -1), jnp.moveaxis(l, 1, -1))
 
 
-def _ring_backward(axis_name: str, causal: bool, res, g):
+def _ring_backward(axis_name: str, causal: bool, res, g,
+                   use_flash: bool = False, interpret: bool = False):
     """Reverse-rotation ring-attention backward.
 
     One more K/V trip around the ring: per-block softmax probabilities are
@@ -132,6 +136,12 @@ def _ring_backward(axis_name: str, causal: bool, res, g):
     after n steps every gradient block is back on its home chip. Residuals
     and carries are all O(S/n) per chip; nothing quadratic, nothing
     sequence-global (the standard ring-attention backward schedule).
+
+    ``use_flash=True`` computes each step's (dq, dk, dv) partials with the
+    pallas backward kernels (``flash.flash_block_bwd``, flash-attention-2
+    dq + dk/dv passes) instead of XLA einsums — probability tiles live in
+    VMEM only, restoring the kernel forward's scores-never-reach-HBM
+    property for the backward as well.
     """
     q, k, v, out, m, l = res
     n = lax.psum(1, axis_name)
@@ -139,19 +149,18 @@ def _ring_backward(axis_name: str, causal: bool, res, g):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
-    qf = q.astype(jnp.float32) * scale
     gf = g.astype(jnp.float32)
     # D_i = sum_d g_i * out_i: the softmax-jacobian projection term
     d_term = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # [B, Sq, H]
-    m_b = jnp.moveaxis(m, -1, 1)          # [B, H, Sq]
-    inv_l = 1.0 / jnp.moveaxis(l, -1, 1)  # l > 0 for every valid row
-    d_b = jnp.moveaxis(d_term, -1, 1)
-    q_pos = me * Sq + jnp.arange(Sq)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    q_off = me * Sq
 
-    def body(carry, t):
-        dq, kc, vc, dkc, dvc = carry
-        blk = (me - t) % n
+    def block_grads_einsum(kc, vc, blk):
+        qf = q.astype(jnp.float32) * scale
+        m_b = jnp.moveaxis(m, -1, 1)          # [B, H, Sq]
+        inv_l = 1.0 / jnp.moveaxis(l, -1, 1)  # l > 0 for every valid row
+        d_b = jnp.moveaxis(d_term, -1, 1)
+        q_pos = me * Sq + jnp.arange(Sq)
         kcf = kc.astype(jnp.float32)
         vcf = vc.astype(jnp.float32)
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kcf)
@@ -164,9 +173,25 @@ def _ring_backward(axis_name: str, causal: bool, res, g):
             p = jnp.where(allowed[None, None], p, 0.0)
         dp = jnp.einsum("bqhd,bkhd->bhqk", gf, vcf)
         ds = p * (dp - d_b[..., None])
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kcf) * scale
-        dkc = dkc + jnp.einsum("bhqk,bqhd->bkhd", ds, qf)  # qf carries scale
-        dvc = dvc + jnp.einsum("bhqk,bqhd->bkhd", p, gf)
+        return (jnp.einsum("bhqk,bkhd->bqhd", ds, kcf) * scale,
+                jnp.einsum("bhqk,bqhd->bkhd", ds, qf),  # qf carries scale
+                jnp.einsum("bhqk,bqhd->bkhd", p, gf))
+
+    def block_grads_flash(kc, vc, blk):
+        from .flash import flash_block_bwd
+        return flash_block_bwd(q, kc, vc, gf, d_term, m, l,
+                               q_off, blk * Sk, causal=causal,
+                               interpret=interpret)
+
+    block_grads = block_grads_flash if use_flash else block_grads_einsum
+
+    def body(carry, t):
+        dq, kc, vc, dkc, dvc = carry
+        blk = (me - t) % n
+        dq_blk, dk_blk, dv_blk = block_grads(kc, vc, blk)
+        dq = dq + dq_blk
+        dkc = dkc + dk_blk
+        dvc = dvc + dv_blk
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         dkc = lax.ppermute(dkc, axis_name, perm)
@@ -251,9 +276,11 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
 
 
 def _ring_flash_bwd(axis_name, causal, interpret, res, g):
-    # same reverse-rotation backward as the einsum ring: the flash kernel's
-    # (m, l) partials are the identical softmax statistics
-    return _ring_backward(axis_name, causal, res, g)
+    # same reverse-rotation schedule as the einsum ring (the flash kernel's
+    # (m, l) partials are the identical softmax statistics), with the
+    # per-block math in the pallas backward kernels
+    return _ring_backward(axis_name, causal, res, g,
+                          use_flash=True, interpret=interpret)
 
 
 _ring_flash_diff.defvjp(_ring_flash_fwd, _ring_flash_bwd)

@@ -176,12 +176,210 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
     return sbhd(o), sbhd(m)[..., 0], sbhd(l)[..., 0]
 
 
+def _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref,
+               d_ref, causal: bool, scale: float):
+    """Shared backward-tile recompute: (q*scale, k, v, g, d, P, dS).
+
+    The probability tile P is rebuilt in VMEM from the saved GLOBAL (m, l)
+    row statistics with the same offset-based causal mask as the forward
+    kernel, and dS = P * (dP - D) is the softmax-jacobian product both
+    backward passes consume. One definition keeps the dq and dk/dv kernels
+    (and their masking) from drifting apart."""
+    tq = q_ref.shape[1]
+    tk = k_ref.shape[1]
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    m = m_ref[0][:, 0]
+    inv_l = 1.0 / l_ref[0][:, 0]
+    d = d_ref[0][:, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = offs_ref[0] + qi * tq + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, tk), 0)
+        k_pos = offs_ref[1] + kj * tk + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, tk), 1)
+        allowed = q_pos >= k_pos
+        s = jnp.where(allowed, s, _NEG)
+    p = jnp.exp(s - m[:, None]) * inv_l[:, None]
+    if causal:
+        p = jnp.where(allowed, p, 0.0)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - d[:, None])
+    return q, k, g, p, ds
+
+
+def _bwd_live(offs_ref, qi, kj, tq, tk):
+    """Causal block-skip shared by both backward passes (same predicate as
+    the forward): the tile pair is dead when the whole K tile lies in the
+    future of the last query row."""
+    return offs_ref[1] + kj * tk <= offs_ref[0] + qi * tq + tq - 1
+
+
+def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
+               dq_ref, *, causal: bool, scale: float):
+    """dQ pass (flash-attention-2 backward): for each query tile, iterate
+    K/V tiles innermost and accumulate dq += dS @ K * scale — scores and
+    probabilities never reach HBM, same as the forward."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+
+    def body():
+        _, k, _, _, ds = _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref,
+                                    g_ref, m_ref, l_ref, d_ref, causal,
+                                    scale)
+        dq_ref[0] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(_bwd_live(offs_ref, qi, kj, q_ref.shape[1],
+                          k_ref.shape[1]))(body)
+    else:
+        body()
+
+
+def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
+                dk_ref, dv_ref, *, causal: bool, scale: float):
+    """dK/dV pass: for each K/V tile, iterate query tiles innermost and
+    accumulate dv += P^T @ dO and dk += dS^T @ (Q * scale)."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    def body():
+        q, _, g, p, ds = _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref,
+                                    g_ref, m_ref, l_ref, d_ref, causal,
+                                    scale)
+        dv_ref[0] += jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_ref[0] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_bwd_live(offs_ref, qi, kj, q_ref.shape[1],
+                          k_ref.shape[1]))(body)
+    else:
+        body()
+
+
+def _lane8(x):  # [B, S, H] -> [B*H, S, 8] (TPU sublane x lane tiling)
+    B, S, H = x.shape
+    t = x.transpose(0, 2, 1).reshape(B * H, S)
+    return jnp.broadcast_to(t[:, :, None], (B * H, S, 8))
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "interpret"))
+def flash_block_bwd(q, k, v, g, d_term, m, l, q_off, k_off, *,
+                    causal: bool = True, interpret: bool = False):
+    """Gradients of q's attention against one K/V block (pallas kernels).
+
+    Inputs: q [B, Sq, H, D]; k, v [B, Sk, H, D]; g = dOut [B, Sq, H, D];
+    ``d_term = sum(dOut * Out, -1)`` and the saved GLOBAL softmax row stats
+    ``m`` (row max) and ``l`` (row sum), all [B, Sq, H] f32 — the same
+    quantities the XLA ring backward reconstructs per block
+    (context._ring_backward). Returns (dq_partial, dk, dv) in f32: the
+    caller sums dq partials over blocks and ships dk/dv home with the ring.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    tq = _q_tile(Sq)
+    tk = _k_tile(Sk)
+
+    def bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    offs = jnp.asarray([q_off, k_off], jnp.int32)
+    vmas = [getattr(jax.typeof(t), "vma", None) for t in (q, k, v, g)]
+    kw = {} if all(mm is None for mm in vmas) else {
+        "vma": frozenset().union(*(mm for mm in vmas if mm is not None))}
+    operands = (offs, bhsd(q), bhsd(k), bhsd(v), bhsd(g),
+                _lane8(m), _lane8(l), _lane8(d_term))
+    if not _HAVE_PLTPU:  # pragma: no cover - pltpu always importable here
+        raise RuntimeError("pallas TPU backend unavailable")
+
+    params = {} if interpret else {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))}
+
+    # pass 1: dq (K innermost, accumulates into the q tile's output)
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * H, Sq // tq, Sk // tk),
+        in_specs=[
+            pl.BlockSpec((1, tq, D), lambda bh, qi, kj, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tk, D), lambda bh, qi, kj, o: (bh, kj, 0)),
+            pl.BlockSpec((1, tk, D), lambda bh, qi, kj, o: (bh, kj, 0)),
+            pl.BlockSpec((1, tq, D), lambda bh, qi, kj, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tq, 8), lambda bh, qi, kj, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tq, 8), lambda bh, qi, kj, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tq, 8), lambda bh, qi, kj, o: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq, D), lambda bh, qi, kj, o: (bh, qi, 0)),
+        ],
+    )
+    (dq,) = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale),
+        grid_spec=dq_spec,
+        out_shape=(jax.ShapeDtypeStruct((B * H, Sq, D), jnp.float32, **kw),),
+        interpret=interpret, **params,
+    )(*operands)
+
+    # pass 2: dk/dv (Q innermost, accumulates into the k tile's outputs)
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B * H, Sk // tk, Sq // tq),
+        in_specs=[
+            pl.BlockSpec((1, tq, D), lambda bh, kj, qi, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tk, D), lambda bh, kj, qi, o: (bh, kj, 0)),
+            pl.BlockSpec((1, tk, D), lambda bh, kj, qi, o: (bh, kj, 0)),
+            pl.BlockSpec((1, tq, D), lambda bh, kj, qi, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tq, 8), lambda bh, kj, qi, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tq, 8), lambda bh, kj, qi, o: (bh, qi, 0)),
+            pl.BlockSpec((1, tq, 8), lambda bh, kj, qi, o: (bh, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tk, D), lambda bh, kj, qi, o: (bh, kj, 0)),
+            pl.BlockSpec((1, tk, D), lambda bh, kj, qi, o: (bh, kj, 0)),
+        ],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale),
+        grid_spec=dkv_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, Sk, D), jnp.float32, **kw),
+            jax.ShapeDtypeStruct((B * H, Sk, D), jnp.float32, **kw),
+        ),
+        interpret=interpret, **params,
+    )(*operands)
+
+    def sbhd(x, s):
+        return x.reshape((B, H, s, D)).transpose(0, 2, 1, 3)
+
+    return sbhd(dq, Sq), sbhd(dk, Sk), sbhd(dv, Sk)
+
+
 def _blockwise_attention(q, k, v, causal: bool, tk: int):
     """Pure-XLA blockwise attention: lax.scan over K blocks with online
     softmax, each step under jax.checkpoint. Numerically the same function
-    as the pallas kernel, O(S*tk) live memory — the autodiff twin used for
-    flash_attention's backward (its VJP recomputes per-block instead of
-    materializing the [S, S] score tensor)."""
+    as the pallas kernel, O(S*tk) live memory — kept as the independent
+    test oracle for the kernel's values (tests/test_flash.py); the
+    production backward is the pallas kernel pair (flash_block_bwd)."""
     B, S, H, D = q.shape
     Sk = k.shape[1]
     nk = Sk // tk
@@ -229,18 +427,21 @@ def _flash(q, k, v, causal, interpret):
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash(q, k, v, causal, interpret), (q, k, v)
+    o, m, l = flash_block(q, k, v, 0, 0, causal=causal, interpret=interpret)
+    out = (o / l[..., None]).astype(q.dtype)
+    return out, (q, k, v, out, m, l)
 
 
 def _flash_bwd(causal, interpret, res, g):
-    q, k, v = res
-    # small backward tile (same ladder as _q_tile): the recomputed
-    # [B, S, H, TK] probability tile is the live-memory high-water mark
-    tk = _q_tile(k.shape[1])
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _blockwise_attention(q_, k_, v_, causal, tk),
-        q, k, v)
-    return vjp(g)
+    # flash-attention-2 style kernel backward: dq pass + dk/dv pass, both
+    # recomputing probability tiles in VMEM from the saved (m, l) stats —
+    # no autodiff-through-recompute, no [S, S] tensor in either direction
+    q, k, v, out, m, l = res
+    gf = g.astype(jnp.float32)
+    d_term = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
+    dq, dk, dv = flash_block_bwd(q, k, v, gf, d_term, m, l, 0, 0,
+                                 causal=causal, interpret=interpret)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -250,10 +451,12 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     interpret: bool = False):
     """Single-device flash attention over [B, S, H, D] (normalized output).
 
-    Differentiable: the forward runs the pallas VMEM kernel; the backward is
-    the VJP of a checkpointed blockwise-scan twin (`_blockwise_attention`),
-    so neither direction materializes the [S, S] score tensor — long-context
-    training works on a single chip at sequence lengths where dense
-    attention is OOM-bound.
+    Differentiable: the forward runs the pallas VMEM kernel and the
+    backward runs the pallas flash-attention-2 kernel pair
+    (:func:`flash_block_bwd` — a dq pass and a dk/dv pass that rebuild
+    probability tiles in VMEM from the saved (m, l) stats), so neither
+    direction materializes the [S, S] score tensor — long-context training
+    works on a single chip at sequence lengths where dense attention is
+    OOM-bound.
     """
     return _flash(q, k, v, causal, interpret)

@@ -213,6 +213,10 @@ def pp_loss_fn(model, mesh: Mesh, n_micro: int = 2):
 def pp_train_step_fn(model, mesh: Mesh, optimizer, n_micro: int = 2):
     """Compiled pipelined TRAINING step (net-new; SURVEY §2.6 PP row).
 
+    Build ONCE and reuse across the training loop (like ``jax.jit``): each
+    call constructs a fresh jitted step, so calling this inside the loop
+    recompiles the whole GPipe schedule every iteration.
+
     ``step(stacked_blocks, rest, opt_state, batch) -> (stacked, rest,
     opt_state, loss)`` where ``batch = (tokens, targets)``; gradients flow
     through the whole GPipe schedule (microbatch accumulation is implicit:
@@ -249,7 +253,10 @@ def pp_train_init(model, mesh: Mesh, params, optimizer):
     can never invalidate the caller's original param arrays."""
     stacked, rest = pp_stack_params(params, mesh.shape["pipe"])
     stacked = pp_place_params(stacked, mesh)
-    rest = jax.device_put(rest, NamedSharding(mesh, P()))
+    # may_alias=False forces a real copy even when the input already has the
+    # target sharding — the donating train step must never be able to
+    # invalidate the caller's original param arrays
+    rest = jax.device_put(rest, NamedSharding(mesh, P()), may_alias=False)
     # Optimizer state must enter the step with the SAME shardings the step
     # outputs (stage-sharded moments for stacked params, replicated for the
     # rest) or call 2 pays a full recompile. optax's init builds moments as
