@@ -17,6 +17,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 from __future__ import annotations
 
+import argparse
+import itertools
 import json
 import time
 
@@ -28,6 +30,7 @@ import optax
 
 import bluefog_tpu as bf
 from bluefog_tpu.models import ResNet50
+from bluefog_tpu.utils import prefetch_to_device
 
 BATCH_PER_CHIP = 128
 IMAGE = 224
@@ -37,10 +40,12 @@ BATCHES_PER_ITER = 10
 BASELINE_IMG_SEC_PER_DEVICE = 4310.6 / 16  # reference 16xV100 result
 
 
-def setup(batch_per_chip: int = BATCH_PER_CHIP):
+def setup(batch_per_chip: int = BATCH_PER_CHIP, synthetic_batch: bool = True):
     """Build the benchmark step: (opt, state, batch, sync). Caller owns
     ``bf.shutdown()``. Shared with scripts/batch_sweep.py so batch-size
-    probes measure exactly the benchmarked step."""
+    probes measure exactly the benchmarked step. ``synthetic_batch=False``
+    skips building the device-resident batch (host-data mode feeds its own
+    — no point holding 77 MB/chip of unused HBM)."""
     n = len(jax.devices())
     topo = bf.topology_util.ExponentialTwoGraph(n) if n > 1 else \
         bf.topology_util.FullyConnectedGraph(1)
@@ -54,6 +59,10 @@ def setup(batch_per_chip: int = BATCH_PER_CHIP):
 
     def loss_fn(p, ms, batch):
         images, labels = batch
+        if images.dtype == jnp.uint8:
+            # host-fed path ships uint8 (4x fewer wire bytes than f32, the
+            # standard input-pipeline format); normalize on device
+            images = images.astype(jnp.float32) / 127.5 - 1.0
         logits, updates = model.apply(
             {"params": p, "batch_stats": ms}, images, train=True,
             mutable=["batch_stats"])
@@ -65,14 +74,16 @@ def setup(batch_per_chip: int = BATCH_PER_CHIP):
         optax.sgd(0.1, momentum=0.9), loss_fn, with_model_state=True)
     state = opt.init(params, model_state=batch_stats)
 
-    images = jax.device_put(
-        jax.random.normal(rng, (n, batch_per_chip, IMAGE, IMAGE, 3),
-                          jnp.float32),
-        bf.rank_sharding(bf.mesh()))
-    labels = jax.device_put(
-        jnp.zeros((n, batch_per_chip), jnp.int32),
-        bf.rank_sharding(bf.mesh()))
-    batch = (images, labels)
+    batch = None
+    if synthetic_batch:
+        images = jax.device_put(
+            jax.random.normal(rng, (n, batch_per_chip, IMAGE, IMAGE, 3),
+                              jnp.float32),
+            bf.rank_sharding(bf.mesh()))
+        labels = jax.device_put(
+            jnp.zeros((n, batch_per_chip), jnp.int32),
+            bf.rank_sharding(bf.mesh()))
+        batch = (images, labels)
 
     def sync(m):
         # A host transfer is the only reliable completion barrier over the
@@ -82,14 +93,43 @@ def setup(batch_per_chip: int = BATCH_PER_CHIP):
     return opt, state, batch, sync
 
 
-def main() -> None:
-    opt, state, batch, sync = setup()
+def host_batch_pool(n: int, batch_per_chip: int, pool: int = 4,
+                    image: int = IMAGE):
+    """Endless cycle over ``pool`` distinct HOST (numpy) uint8 batches —
+    the stand-in for a real data loader (the reference cycles a fake
+    torchvision dataset the same way, pytorch_benchmark.py)."""
+    rng = np.random.default_rng(7)
+    batches = [
+        (rng.integers(0, 256, (n, batch_per_chip, image, image, 3),
+                      dtype=np.uint8),
+         rng.integers(0, 1000, (n, batch_per_chip), dtype=np.int32))
+        for _ in range(pool)
+    ]
+    return itertools.cycle(batches)
+
+
+def main(host_data: bool = False, prefetch: int = 2,
+         steps_scale: float = 1.0) -> None:
+    opt, state, batch, sync = setup(synthetic_batch=not host_data)
+    iters = max(1, round(ITERS * steps_scale))
+
+    if host_data:
+        # real host->HBM traffic: uint8 batches from a host pool, device_put
+        # kept `prefetch` deep so the copy of batch t+1 overlaps step t
+        n = len(jax.devices())
+        feed = prefetch_to_device(
+            host_batch_pool(n, BATCH_PER_CHIP), size=prefetch,
+            sharding=bf.rank_sharding(bf.mesh()))
+        metric = "resnet50_train_img_per_sec_per_chip_hostfeed"
+    else:
+        feed = itertools.repeat(batch)
+        metric = "resnet50_train_img_per_sec_per_chip"
 
     for _ in range(WARMUP):
-        state, metrics = opt.step(state, batch)
+        state, metrics = opt.step(state, next(feed))
     sync(metrics)
 
-    # One timed window over all ITERS x BATCHES_PER_ITER steps, closed by a
+    # One timed window over all iters x BATCHES_PER_ITER steps, closed by a
     # single host sync. A per-iteration sync would charge ~64 ms of tunnel
     # round-trip latency to every 10 batches (~12% of the measurement) —
     # an artifact of the remote-device link, not the chip. The reference's
@@ -97,15 +137,15 @@ def main() -> None:
     # (pytorch_benchmark.py timeit over async launches); the single final
     # transfer here drains ALL device work, so the window is honest.
     t0 = time.perf_counter()
-    for _ in range(ITERS):
+    for _ in range(iters):
         for _ in range(BATCHES_PER_ITER):
-            state, metrics = opt.step(state, batch)
+            state, metrics = opt.step(state, next(feed))
     sync(metrics)
     dt = time.perf_counter() - t0
 
-    per_device = BATCH_PER_CHIP * BATCHES_PER_ITER * ITERS / dt
+    per_device = BATCH_PER_CHIP * BATCHES_PER_ITER * iters / dt
     print(json.dumps({
-        "metric": "resnet50_train_img_per_sec_per_chip",
+        "metric": metric,
         "value": round(per_device, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_device / BASELINE_IMG_SEC_PER_DEVICE, 3),
@@ -113,4 +153,20 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host-data", action="store_true",
+                   help="feed uint8 batches from host memory through the "
+                        "double-buffered prefetcher (real host->HBM traffic)")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="in-flight host transfers; note the timed window "
+                        "has no per-step sync, so async step dispatch "
+                        "already overlaps transfers with queued compute — "
+                        "1 vs 2 is a queue-depth knob here, not a clean "
+                        "overlap A/B (examples/resnet.py, which syncs per "
+                        "step, shows the prefetch effect directly)")
+    p.add_argument("--steps-scale", type=float, default=1.0,
+                   help="scale the timed iteration count (host-data runs on "
+                        "a slow dev tunnel may want fewer steps)")
+    a = p.parse_args()
+    main(host_data=a.host_data, prefetch=a.prefetch,
+         steps_scale=a.steps_scale)

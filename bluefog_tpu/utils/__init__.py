@@ -7,6 +7,7 @@ reference walks a torch ``state_dict``; here the arguments are rank-stacked
 pytrees and each helper is one collective over the mesh.
 """
 
+from .data import prefetch_to_device
 from .params import (
     allreduce_parameters,
     broadcast_optimizer_state,
@@ -19,4 +20,5 @@ __all__ = [
     "allreduce_parameters",
     "broadcast_optimizer_state",
     "resnet_from_torch",
+    "prefetch_to_device",
 ]

@@ -191,6 +191,11 @@ def train(args, devices=None):
     for epoch in range(start_epoch, args.epochs):
         t0 = time.perf_counter()
         losses = []
+        # double-buffered host->device feeding: the copy of batch s+1 is in
+        # flight while step s computes (bf.utils.prefetch_to_device)
+        feed = bf.utils.prefetch_to_device(
+            ((tr_images[s], tr_labels[s])
+             for s in range(args.steps_per_epoch)), size=2, sharding=sh)
         for s in range(args.steps_per_epoch):
             if dynamic:
                 sends = {r: next(g)[0] for r, g in enumerate(gens)}
@@ -204,9 +209,7 @@ def train(args, devices=None):
                 opt.neighbor_weights = {
                     r: {s_: 1.0 / (len(recv[r]) + 1) for s_ in recv[r]}
                     for r in range(n)}
-            batch = (jax.device_put(tr_images[s], sh),
-                     jax.device_put(tr_labels[s], sh))
-            state, metrics = opt.step(state, batch)
+            state, metrics = opt.step(state, next(feed))
             losses.append(float(np.asarray(metrics["loss"]).mean()))
         val_acc, _ = evaluate(model, state, va_images, va_labels)
         dt = time.perf_counter() - t0

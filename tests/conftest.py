@@ -14,7 +14,10 @@ os.environ["XLA_FLAGS"] = (
 )
 # The image's sitecustomize force-registers the axon TPU plugin; an empty
 # JAX_PLATFORMS lets both backends register so jax.devices('cpu') works.
-os.environ["JAX_PLATFORMS"] = ""
+# BLUEFOG_TESTS_CPU_ONLY=1 pins strictly to CPU — the escape hatch for when
+# the remote-TPU tunnel is down (its plugin init would hang EVERY test).
+os.environ["JAX_PLATFORMS"] = (
+    "cpu" if os.environ.get("BLUEFOG_TESTS_CPU_ONLY") == "1" else "")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
