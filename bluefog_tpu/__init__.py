@@ -125,6 +125,7 @@ from .utils import (
     allreduce_parameters,
     broadcast_optimizer_state,
     resnet_from_torch,
+    vgg_from_torch,
 )
 
 from . import checkpoint

@@ -13,7 +13,7 @@ from .params import (
     broadcast_optimizer_state,
     broadcast_parameters,
 )
-from .torch_interop import resnet_from_torch
+from .torch_interop import resnet_from_torch, vgg_from_torch
 
 __all__ = [
     "broadcast_parameters",
@@ -21,4 +21,5 @@ __all__ = [
     "broadcast_optimizer_state",
     "resnet_from_torch",
     "prefetch_to_device",
+    "vgg_from_torch",
 ]

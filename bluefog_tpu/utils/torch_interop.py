@@ -100,8 +100,7 @@ def _convert(state_dict, depth, stages, block_name, convs_per_block,
             stats[name] = bs
             idx += 1
 
-    params["head"] = {"kernel": _np(state_dict["fc.weight"]).T,
-                      "bias": _np(state_dict["fc.bias"])}
+    params["head"] = _dense(state_dict, "fc")
 
     # a deeper/shallower checkpoint than `depth` would convert "cleanly"
     # into semantically wrong weights — make the mismatch loud instead
@@ -120,3 +119,95 @@ def _consumed_layer_key(key: str, stages) -> bool:
     stage = int(parts[0][len("layer"):])
     block = int(parts[1])
     return stage <= len(stages) and block < stages[stage - 1]
+
+
+# torchvision VGG cfgs: the single source of truth lives next to the model
+# (models/vgg.py) so converter and model can never drift
+from ..models.vgg import _CFGS as _VGG_CFGS  # noqa: E402
+
+
+def _dense(sd: Mapping, prefix: str) -> Dict[str, np.ndarray]:
+    return {"kernel": _np(sd[f"{prefix}.weight"]).T,
+            "bias": _np(sd[f"{prefix}.bias"])}
+
+
+def vgg_from_torch(state_dict: Mapping, depth: int):
+    """torchvision-format VGG state_dict -> flax VGG variables.
+
+    ``depth`` is 11/16/19; the batch-norm variant is detected from the
+    checkpoint (presence of ``features.<i>.running_mean``). Returns
+    ``{"params": ...}`` (plain) or ``{"params", "batch_stats"}`` (BN)::
+
+        variables = vgg_from_torch(torch_model.state_dict(), 16)
+        logits = VGG16(num_classes=...).apply(variables, x, train=False)
+
+    A plain (non-BN) checkpoint has no "batch_stats"; construct the flax
+    model with ``batch_norm=False`` to match.
+
+    Key subtlety: torchvision flattens the 7x7x512 feature map in CHW
+    order before ``classifier.0`` while the flax model (NHWC) flattens in
+    HWC order — the first dense kernel's input axis is permuted
+    accordingly, so converted weights reproduce the torch forward exactly
+    (asserted against a torch oracle in tests/test_torch_interop.py).
+    The flax VGG keeps conv biases in the BN variant precisely because
+    torchvision does (models/vgg.py).
+    """
+    if depth not in _VGG_CFGS:
+        raise ValueError(
+            f"unsupported depth {depth}; choose {sorted(_VGG_CFGS)}")
+    cfg = _VGG_CFGS[depth]
+    batch_norm = any(k.endswith("running_mean") for k in state_dict
+                     if k.startswith("features."))
+
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    t_idx = 0  # index into torchvision's features Sequential
+    try:
+        for i, v in enumerate(cfg):
+            if v == "M":
+                t_idx += 1
+                continue
+            conv = f"features.{t_idx}"
+            params[f"conv_{i}"] = {
+                "kernel": _conv(state_dict[f"{conv}.weight"]),
+                "bias": _np(state_dict[f"{conv}.bias"]),
+            }
+            if params[f"conv_{i}"]["kernel"].shape[-1] != v:
+                raise ValueError(
+                    f"{conv}.weight has {params[f'conv_{i}']['kernel'].shape[-1]}"
+                    f" output channels, expected {v} — not a depth-{depth} "
+                    "checkpoint; pass the matching depth")
+            t_idx += 1
+            if batch_norm:
+                params[f"bn_{i}"], stats[f"bn_{i}"] = _bn(
+                    state_dict, f"features.{t_idx}")
+                t_idx += 1
+            t_idx += 1  # ReLU
+
+        # classifier.0 consumes torch's CHW flatten of [512, 7, 7]; the
+        # flax model flattens NHWC -> HWC, so permute the input axis
+        w0 = _np(state_dict["classifier.0.weight"])  # [4096, 512*7*7]
+        w0 = w0.reshape(4096, 512, 7, 7).transpose(2, 3, 1, 0)
+        params["fc_0"] = {"kernel": w0.reshape(7 * 7 * 512, 4096),
+                          "bias": _np(state_dict["classifier.0.bias"])}
+        params["fc_1"] = _dense(state_dict, "classifier.3")
+        params["head"] = _dense(state_dict, "classifier.6")
+    except KeyError as exc:
+        raise ValueError(
+            f"state_dict is missing {exc} — not a complete depth-{depth} "
+            "torchvision VGG checkpoint; pass the matching depth"
+        ) from None
+
+    leftover = [k for k in state_dict
+                if k.startswith("features.")
+                and "num_batches_tracked" not in k
+                and int(k.split(".")[1]) >= t_idx]
+    if leftover:
+        raise ValueError(
+            f"state_dict has feature layers beyond a depth-{depth} VGG "
+            f"(e.g. {leftover[0]}); pass the matching depth")
+
+    out: Dict[str, Any] = {"params": params}
+    if batch_norm:
+        out["batch_stats"] = stats
+    return out

@@ -179,3 +179,80 @@ def test_shallow_checkpoint_rejected_loudly():
     sd = dict(TorchResNet18(num_classes=10).state_dict())
     with pytest.raises(ValueError, match="matching depth"):
         resnet_from_torch(sd, 34)  # resnet34 expects layer1.2.* etc.
+
+
+def _make_torch_vgg(cfg, batch_norm, num_classes=7):
+    """torchvision.models.vgg.VGG reproduced name-for-name (features /
+    avgpool / classifier, make_layers module ordering)."""
+    layers = []
+    cin = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(tnn.MaxPool2d(2, 2))
+        else:
+            layers.append(tnn.Conv2d(cin, v, 3, padding=1))
+            if batch_norm:
+                layers.append(tnn.BatchNorm2d(v))
+            layers.append(tnn.ReLU(inplace=True))
+            cin = v
+
+    class TorchVGG(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = tnn.Sequential(*layers)
+            self.avgpool = tnn.AdaptiveAvgPool2d((7, 7))
+            self.classifier = tnn.Sequential(
+                tnn.Linear(512 * 7 * 7, 4096), tnn.ReLU(True), tnn.Dropout(),
+                tnn.Linear(4096, 4096), tnn.ReLU(True), tnn.Dropout(),
+                tnn.Linear(4096, num_classes))
+
+        def forward(self, x):
+            x = self.features(x)
+            x = self.avgpool(x)
+            x = torch.flatten(x, 1)
+            return self.classifier(x)
+
+    return TorchVGG()
+
+
+@pytest.mark.slow  # two VGG-11 forwards (torch + flax) at 224^2 on 1 core
+def test_vgg11_bn_forward_matches_torch_oracle():
+    from bluefog_tpu.utils.torch_interop import vgg_from_torch
+
+    cfg = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+    tm = _make_torch_vgg(cfg, batch_norm=True)
+    tm.eval()
+    # non-trivial running stats so the BN mapping can't pass by accident
+    with torch.no_grad():
+        for mod in tm.modules():
+            if isinstance(mod, tnn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.3, 0.3)
+                mod.running_var.uniform_(0.7, 1.4)
+
+    x = np.random.default_rng(0).standard_normal((1, 224, 224, 3),
+                                                 dtype=np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+
+    variables = vgg_from_torch(tm.state_dict(), 11)
+    model = models.VGG11(num_classes=7, dropout_rate=0.0,
+                         dtype=jnp.float32)
+    got = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_vgg_from_torch_plain_structure_and_errors():
+    from bluefog_tpu.utils.torch_interop import vgg_from_torch
+
+    cfg = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+    tm = _make_torch_vgg(cfg, batch_norm=False)
+    variables = vgg_from_torch(tm.state_dict(), 11)
+    assert "batch_stats" not in variables  # plain variant detected
+    convs = [k for k in variables["params"] if k.startswith("conv_")]
+    assert len(convs) == 8
+    assert variables["params"]["fc_0"]["kernel"].shape == (25088, 4096)
+    # depth mismatch is loud, not silently wrong
+    with pytest.raises(ValueError):
+        vgg_from_torch(tm.state_dict(), 16)
+    with pytest.raises(ValueError):
+        vgg_from_torch({}, 13)
