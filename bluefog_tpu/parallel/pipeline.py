@@ -253,10 +253,14 @@ def pp_train_init(model, mesh: Mesh, params, optimizer):
     can never invalidate the caller's original param arrays."""
     stacked, rest = pp_stack_params(params, mesh.shape["pipe"])
     stacked = pp_place_params(stacked, mesh)
-    # may_alias=False forces a real copy even when the input already has the
-    # target sharding — the donating train step must never be able to
-    # invalidate the caller's original param arrays
-    rest = jax.device_put(rest, NamedSharding(mesh, P()), may_alias=False)
+    rep = NamedSharding(mesh, P())
+    # jitted copy-with-placement: device_put may alias an already-placed
+    # input even with may_alias=False, and the donating train step must
+    # never be able to invalidate the caller's original param arrays — an
+    # XLA copy guarantees fresh buffers with the steady-state sharding
+    rest = jax.jit(
+        lambda t: jax.tree_util.tree_map(jnp.copy, t),
+        out_shardings=rep)(rest)
     # Optimizer state must enter the step with the SAME shardings the step
     # outputs (stage-sharded moments for stacked params, replicated for the
     # rest) or call 2 pays a full recompile. optax's init builds moments as
@@ -269,7 +273,6 @@ def pp_train_init(model, mesh: Mesh, params, optimizer):
     opt_state = optax.tree_utils.tree_map_params(
         optimizer, lambda s, p: jax.device_put(s, p.sharding), opt_state,
         (stacked, rest))
-    rep = NamedSharding(mesh, P())
     opt_state = jax.tree_util.tree_map(
         lambda x: x if isinstance(getattr(x, "sharding", None), NamedSharding)
         else jax.device_put(x, rep), opt_state)

@@ -197,6 +197,12 @@ def vgg_from_torch(state_dict: Mapping, depth: int):
             f"state_dict is missing {exc} — not a complete depth-{depth} "
             "torchvision VGG checkpoint; pass the matching depth"
         ) from None
+    except ValueError as exc:
+        # a mis-declared depth walks t_idx onto the wrong module kind (e.g.
+        # _conv transposing a 1-D BN weight) — keep the diagnosis loud
+        raise ValueError(
+            f"state_dict does not match a depth-{depth} torchvision VGG "
+            f"layout ({exc}); pass the matching depth") from None
 
     leftover = [k for k in state_dict
                 if k.startswith("features.")

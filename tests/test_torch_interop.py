@@ -219,8 +219,8 @@ def _make_torch_vgg(cfg, batch_norm, num_classes=7):
 def test_vgg11_bn_forward_matches_torch_oracle():
     from bluefog_tpu.utils.torch_interop import vgg_from_torch
 
-    cfg = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
-    tm = _make_torch_vgg(cfg, batch_norm=True)
+    from bluefog_tpu.models.vgg import _CFGS
+    tm = _make_torch_vgg(_CFGS[11], batch_norm=True)
     tm.eval()
     # non-trivial running stats so the BN mapping can't pass by accident
     with torch.no_grad():
@@ -244,8 +244,8 @@ def test_vgg11_bn_forward_matches_torch_oracle():
 def test_vgg_from_torch_plain_structure_and_errors():
     from bluefog_tpu.utils.torch_interop import vgg_from_torch
 
-    cfg = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
-    tm = _make_torch_vgg(cfg, batch_norm=False)
+    from bluefog_tpu.models.vgg import _CFGS
+    tm = _make_torch_vgg(_CFGS[11], batch_norm=False)
     variables = vgg_from_torch(tm.state_dict(), 11)
     assert "batch_stats" not in variables  # plain variant detected
     convs = [k for k in variables["params"] if k.startswith("conv_")]
