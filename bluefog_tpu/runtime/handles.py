@@ -23,6 +23,88 @@ _counter = itertools.count(1)
 _lock = threading.Lock()
 _handle_map: Dict[int, Tuple[str, float, Any]] = {}  # handle -> (name, t0, outputs)
 
+def _ready(outputs) -> bool:
+    """All device work backing this pytree has finished."""
+    return all(
+        leaf.is_ready() if hasattr(leaf, "is_ready") else True
+        for leaf in jax.tree_util.tree_leaves(outputs)
+    )
+
+
+# Per-op COMMUNICATE spans (reference phase attribution,
+# mpi_controller.cc:276-292): opened at dispatch, closed when the op's
+# outputs become ready — by poll/synchronize, or by the stall watchdog's
+# sweep for fire-and-forget handles nobody waits on.
+# handle -> (op name, tid lane). Lanes come from a free-list so concurrent
+# spans never share a tid (trace viewers pair E with the latest B on a
+# tid, so a collision would swap op durations); a lane is recycled only
+# after its span closes.
+_open_spans: Dict[int, Tuple[str, int]] = {}
+_free_lanes: list = []
+_lane_counter = itertools.count(1000)
+
+
+def _open_span(handle: int, name: str) -> None:
+    from .timeline import timeline_start_activity
+
+    with _lock:
+        tid = _free_lanes.pop() if _free_lanes else next(_lane_counter)
+        _open_spans[handle] = (name, tid)
+    if not timeline_start_activity(name, "COMMUNICATE", tid):
+        with _lock:  # timeline off: nothing to close later
+            _open_spans.pop(handle, None)
+            _free_lanes.append(tid)
+
+
+def _take_span(handle: int) -> Optional[Tuple[str, int]]:
+    """Claim the span (e.g. synchronize owns its completion event from here
+    on; the watchdog sweep can no longer touch it)."""
+    with _lock:
+        return _open_spans.pop(handle, None)
+
+
+def _restore_span(handle: int, span: Optional[Tuple[str, int]]) -> None:
+    if span is not None:
+        with _lock:
+            _open_spans[handle] = span
+
+
+def _emit_span_end(span: Optional[Tuple[str, int]]) -> None:
+    if span is None:
+        return
+    from .timeline import timeline_end_activity
+
+    name, tid = span
+    timeline_end_activity(name, tid)
+    with _lock:
+        _free_lanes.append(tid)
+
+
+def _close_span(handle: int) -> None:
+    _emit_span_end(_take_span(handle))
+
+
+def sweep_completed_spans() -> None:
+    """Close COMMUNICATE spans of finished handles nobody polled (called by
+    the stall watchdog's cycle). Spans claimed by an in-flight synchronize
+    are no longer in the table, so the sweep cannot cut them short."""
+    with _lock:
+        candidates = [(h, _handle_map.get(h)) for h in list(_open_spans)]
+    for h, entry in candidates:
+        if entry is None or _ready(entry[2]):
+            _close_span(h)
+
+
+def close_all_spans() -> None:
+    """Emit the closing edge of every open span (shutdown path — runs
+    BEFORE the timeline closes so the trace stays balanced)."""
+    with _lock:
+        spans = list(_open_spans.values())
+        _open_spans.clear()
+    for span in spans:
+        _emit_span_end(span)
+
+
 # Fire-and-forget callers (win_put in a long gossip loop) never synchronize
 # their handles; bound the table so completed entries don't pin device arrays
 # for the life of the process. Oldest *finished* entries are evicted first.
@@ -33,9 +115,7 @@ def _evict_completed_locked() -> None:
     if len(_handle_map) <= _MAX_RETAINED:
         return
     for handle in sorted(_handle_map):
-        _, _, outputs = _handle_map[handle]
-        leaves = jax.tree_util.tree_leaves(outputs)
-        if all(l.is_ready() if hasattr(l, "is_ready") else True for l in leaves):
+        if _ready(_handle_map[handle][2]):
             del _handle_map[handle]
             if len(_handle_map) <= _MAX_RETAINED:
                 return
@@ -47,11 +127,13 @@ def allocate(name: str, outputs: Any) -> int:
     with _lock:
         _evict_completed_locked()
         _handle_map[handle] = (name, time.monotonic(), outputs)
+    _open_span(handle, name)
     return handle
 
 
 def clear() -> None:
     """Drop all handles (called by shutdown)."""
+    close_all_spans()
     with _lock:
         _handle_map.clear()
 
@@ -62,11 +144,10 @@ def poll(handle: int) -> bool:
         entry = _handle_map.get(handle)
     if entry is None:
         raise ValueError(f"unknown or already-synchronized handle {handle}")
-    _, _, outputs = entry
-    leaves = jax.tree_util.tree_leaves(outputs)
-    return all(
-        leaf.is_ready() if hasattr(leaf, "is_ready") else True for leaf in leaves
-    )
+    done = _ready(entry[2])
+    if done:
+        _close_span(handle)
+    return done
 
 
 def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
@@ -90,29 +171,34 @@ def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
     if entry is None:
         raise ValueError(f"unknown or already-synchronized handle {handle}")
     name, t0, outputs = entry
+    # claim the COMMUNICATE span: this call owns its completion edge now,
+    # so the watchdog sweep (which treats a missing handle entry as done)
+    # cannot cut the span short while we block
+    span = _take_span(handle)
     if timeout is None:
-        return jax.block_until_ready(outputs)
+        out = jax.block_until_ready(outputs)
+        _emit_span_end(span)
+        return out
 
     deadline = time.monotonic() + timeout
-    leaves = jax.tree_util.tree_leaves(outputs)
-
-    def ready() -> bool:
-        return all(leaf.is_ready() if hasattr(leaf, "is_ready") else True
-                   for leaf in leaves)
 
     while True:
         # readiness check runs at least once and once more AFTER the
         # deadline: an op finishing during the final sleep (or timeout=0,
         # the "poll once" form) returns instead of raising spuriously
-        if ready():
-            return jax.block_until_ready(outputs)
+        if _ready(outputs):
+            out = jax.block_until_ready(outputs)
+            _emit_span_end(span)
+            return out
         if time.monotonic() >= deadline:
             break
         time.sleep(0.01)
 
-    # timed out: re-register under the same id so the caller can retry
+    # timed out: re-register under the same id (span included) so the
+    # caller can retry
     with _lock:
         _handle_map[handle] = entry
+    _restore_span(handle, span)
 
     from .heartbeat import dead_controllers
     dead = dead_controllers()
@@ -141,11 +227,6 @@ def outstanding() -> Dict[int, Tuple[str, float]]:
     with _lock:
         items = list(_handle_map.items())
     for handle, (name, t0, outputs) in items:
-        leaves = jax.tree_util.tree_leaves(outputs)
-        done = all(
-            leaf.is_ready() if hasattr(leaf, "is_ready") else True
-            for leaf in leaves
-        )
-        if not done:
+        if not _ready(outputs):
             out[handle] = (name, now - t0)
     return out

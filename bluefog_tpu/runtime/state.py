@@ -271,6 +271,9 @@ def shutdown(_announce: bool = True) -> None:
     if st.watchdog is not None:
         st.watchdog.stop()
         st.watchdog = None
+    # close open per-op spans BEFORE the timeline so the trace stays
+    # balanced (every B gets its E edge)
+    handles.close_all_spans()
     if st.timeline is not None:
         st.timeline.close()
         st.timeline = None

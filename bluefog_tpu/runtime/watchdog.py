@@ -43,6 +43,7 @@ class StallWatchdog:
     def _loop(self) -> None:
         while not self._stop.wait(self.cycle_sec):
             try:
+                handles.sweep_completed_spans()
                 pending = handles.outstanding()
             except Exception:  # never let observability kill the process
                 continue

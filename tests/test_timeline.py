@@ -68,6 +68,13 @@ def test_op_activities_land_in_file(tmp_path, use_native):
         assert activity in names, f"missing activity {activity}"
         assert any(e["name"] == activity and e["cat"] == tensor
                    for e in starts), f"{activity} not tagged {tensor}"
+    # per-op completion phase (reference NEGOTIATE/COMMUNICATE attribution,
+    # mpi_controller.cc:276-292): every dispatched op opens a COMMUNICATE
+    # span closed at completion (poll/synchronize/watchdog sweep) on a
+    # dedicated tid lane; balance is asserted by the loop below
+    assert "COMMUNICATE" in names
+    comm = [e for e in starts if e["name"] == "COMMUNICATE"]
+    assert all(e["tid"] >= 1000 for e in comm)
     # spans balance: every B has a matching E per (cat, tid) lane
     open_spans = {}
     for e in events:
