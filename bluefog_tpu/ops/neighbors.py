@@ -21,7 +21,7 @@ import functools
 import hashlib
 import os
 import time
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Union
 
 import numpy as np
 

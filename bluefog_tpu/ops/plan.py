@@ -32,7 +32,7 @@ zero per-step host work after warmup.
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence, Tuple
+from typing import Tuple
 
 import numpy as np
 

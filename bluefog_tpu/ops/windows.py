@@ -56,7 +56,7 @@ from ..runtime import control_plane as _cp
 from ..runtime import handles as _handles
 from ..runtime.state import _global_state
 from ..runtime.timeline import timeline_context
-from .neighbors import _auto_name, _check_rank_stacked, _per_rank
+from .neighbors import _check_rank_stacked, _per_rank
 
 Weights = Union[float, Dict[int, float], Dict[int, Dict[int, float]]]
 

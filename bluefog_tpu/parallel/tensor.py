@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import functools
 import re
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 

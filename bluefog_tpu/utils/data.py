@@ -11,7 +11,7 @@ with the previous step's compute. This is the standard flax
 from __future__ import annotations
 
 import collections
-from typing import Iterable, Iterator, Optional
+from typing import Iterable, Iterator
 
 import jax
 
