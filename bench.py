@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import itertools
 import json
+import os
 import time
 
 import numpy as np
@@ -46,6 +47,9 @@ def setup(batch_per_chip: int = BATCH_PER_CHIP, synthetic_batch: bool = True):
     probes measure exactly the benchmarked step. ``synthetic_batch=False``
     skips building the device-resident batch (host-data mode feeds its own
     — no point holding 77 MB/chip of unused HBM)."""
+    # fail fast on a dead backend BEFORE the first jax.devices() touch —
+    # covers every setup() caller (bench main, scripts/batch_sweep.py)
+    _require_live_backend()
     n = len(jax.devices())
     topo = bf.topology_util.ExponentialTwoGraph(n) if n > 1 else \
         bf.topology_util.FullyConnectedGraph(1)
@@ -106,6 +110,40 @@ def host_batch_pool(n: int, batch_per_chip: int, pool: int = 4,
         for _ in range(pool)
     ]
     return itertools.cycle(batches)
+
+
+def _require_live_backend(timeout_s: float = 180.0) -> None:
+    """Fail fast (exit 3, stderr diagnosis) when the accelerator backend
+    cannot initialize — on this dev box the chip sits behind a remote
+    tunnel whose outage otherwise turns the benchmark into an infinite
+    hang inside jax.devices(). The probe runs in a SUBPROCESS: the plugin's
+    C init blocks holding the GIL, so an in-process watchdog thread could
+    never fire."""
+    import subprocess
+    import sys
+
+    env = os.environ.get("BLUEFOG_BENCH_INIT_TIMEOUT")
+    if env:
+        try:
+            timeout_s = float(env)
+        except ValueError:
+            print(f"bench: ignoring malformed BLUEFOG_BENCH_INIT_TIMEOUT="
+                  f"{env!r} (want seconds as a number)", file=sys.stderr)
+    if timeout_s <= 0:  # explicit opt-out: skip the probe's init cost
+        return
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        if r.returncode == 0:
+            return
+        detail = r.stderr.decode(errors="replace")[-400:]
+    except subprocess.TimeoutExpired:
+        detail = f"probe did not finish within {timeout_s:.0f}s"
+    print("bench: accelerator backend failed to initialize (remote-TPU "
+          f"tunnel down?); aborting instead of hanging. {detail}",
+          file=sys.stderr)
+    raise SystemExit(3)
 
 
 def main(host_data: bool = False, prefetch: int = 2,
