@@ -107,3 +107,30 @@ def test_scaling_md_is_current(tmp_path):
     committed = (pathlib.Path(__file__).parent.parent /
                  "SCALING.md").read_text()
     assert out.read_text() == committed
+
+
+@pytest.mark.parametrize("n", NS)
+def test_hlo_measured_bytes_dynamic_onepeer_is_one_param_copy(n):
+    """The reference's 'one parameter-size transmit per step' claim, read
+    off the lowered program itself: the dynamic one-peer step hands exactly
+    one copy of the parameter leaf (64x64 f32 = 16384 B) to exactly one
+    collective-permute, at every mesh size."""
+    txt = scaling.lower_train_step("neighbor_dynamic_onepeer", n)
+    b = scaling.collective_bytes(txt)
+    assert b["collective_permute"] == 64 * 64 * 4
+    assert sum(v for k, v in b.items() if k != "collective_permute") == 0
+
+
+@pytest.mark.parametrize("n", NS)
+def test_hlo_measured_bytes_static_expo2_is_logn_copies(n):
+    txt = scaling.lower_train_step("neighbor_static_expo2", n)
+    b = scaling.collective_bytes(txt)
+    assert b["collective_permute"] == math.ceil(math.log2(n)) * 64 * 64 * 4
+
+
+def test_hlo_measured_bytes_scale_with_model_size():
+    small = scaling.collective_bytes(
+        scaling.lower_train_step("neighbor_dynamic_onepeer", 8, d=64))
+    big = scaling.collective_bytes(
+        scaling.lower_train_step("neighbor_dynamic_onepeer", 8, d=128))
+    assert big["collective_permute"] == 4 * small["collective_permute"]
