@@ -14,14 +14,16 @@ Embedding, final norm, and the LM head are replicated and run outside the
 pipelined block stack (they are a few percent of the FLOPs; the block stack
 is the memory that forces pipelining).
 
-Memory model, stated honestly: this schedule shards *parameters* (one stage
-chunk per device) but the microbatch activation buffer ``mb_acts`` and the
-recorded outputs are replicated across stages, and every stage computes its
-block chunk on whatever sits in its incoming slot during fill/drain ticks
-(garbage that is never recorded). Pipelining here buys parameter memory and
-exactness, not activation memory. The training path
-(:func:`pp_train_step_fn`) recovers activation memory with
-``jax.checkpoint`` over the scan instead.
+Memory model, stated honestly: the plain forward (:func:`_pp_fwd`,
+``pp_apply``) shards *parameters* (one stage chunk per device) but
+replicates the microbatch activation buffer and recorded outputs across
+stages — fine for exactness demos. The TRAINING path offers the real GPipe
+memory discipline via ``pp_train_step_fn(..., fused_loss=True)``
+(:func:`_pp_fused_loss`): stage 0 embeds its next microbatch inside each
+tick (only tiny int32 tokens are replicated), the last stage folds each
+drained microbatch straight into the cross-entropy scalar, and the scan
+carry is one [mb, seq, d] activation per stage — with per-layer
+``jax.checkpoint`` remat in both paths.
 
 Exact by construction: the pipeline computes the same composition of blocks
 as the dense model, so tests assert equality with the single-device oracle.
@@ -74,39 +76,59 @@ def pp_stack_params(params, n_stages: int):
     return stacked, rest
 
 
-@functools.lru_cache(maxsize=16)
-def _pp_fwd(model, mesh: Mesh, n_stages: int, n_micro: int):
-    """Unjitted pipelined forward (the differentiable building block)."""
+def _mirror_modules(model):
+    """(block, embed, final_norm, lm_head) mirroring TransformerLM's
+    submodules — the ONE place the prologue/epilogue coupling lives (the
+    pp-vs-oracle exactness tests pin it against TransformerLM.apply)."""
     # deferred: models.transformer imports parallel.context at package
     # import time, so a top-level import here would be circular
     from ..models.transformer import Block
+    import flax.linen as nn
 
     block = Block(
         model.num_heads, model.d_ff, model.dtype,
         model.attn_fn or functools.partial(reference_attention, causal=True))
+    emb = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype,
+                   param_dtype=jnp.float32)
+    norm = nn.RMSNorm(dtype=model.dtype, param_dtype=jnp.float32)
+    head = nn.Dense(model.vocab_size, dtype=model.dtype,
+                    param_dtype=jnp.float32, use_bias=False)
+    return block, emb, norm, head
+
+
+def _chunk_applier(block, stage_params):
+    """Per-layer-rematted scan over this stage's layer chunk: the backward
+    recomputes each block instead of storing its internals for every tick
+    of the schedule — the activation-memory discipline GPipe needs."""
+    sp = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+
+    def apply_chunk(x, positions):
+        @jax.checkpoint
+        def body(h, p):
+            return block.apply({"params": p}, h, positions), None
+        out, _ = lax.scan(body, x, sp)
+        return out
+
+    return apply_chunk
+
+
+@functools.lru_cache(maxsize=16)
+def _pp_fwd(model, mesh: Mesh, n_stages: int, n_micro: int):
+    """Unjitted pipelined forward (the differentiable building block)."""
+    block, emb_mod, norm_mod, head_mod = _mirror_modules(model)
 
     def per_stage(stage_params, mb_acts, positions):
         # stage_params: [1, per, ...] this stage's layer chunk
         # mb_acts:      [n_micro, mb, seq, d_model] (replicated)
         me = lax.axis_index("pipe")
-        sp = jax.tree_util.tree_map(lambda x: x[0], stage_params)
-
-        def apply_chunk(x):
-            # remat per layer: the backward recomputes each block instead of
-            # storing its internals for every tick of the schedule — the
-            # activation-memory discipline GPipe training needs
-            @jax.checkpoint
-            def body(h, p):
-                return block.apply({"params": p}, h, positions), None
-            out, _ = lax.scan(body, x, sp)
-            return out
+        apply_chunk = _chunk_applier(block, stage_params)
 
         zero = jnp.zeros_like(mb_acts[0])
         outputs = jnp.zeros_like(mb_acts)
 
         def tick(carry, t):
             x_cur, outputs = carry
-            y = apply_chunk(x_cur)
+            y = apply_chunk(x_cur, positions)
             # last stage records microbatch t-(S-1) when it has drained
             idx = t - (n_stages - 1)
             rec = lax.dynamic_update_index_in_dim(
@@ -141,17 +163,6 @@ def _pp_fwd(model, mesh: Mesh, n_stages: int, n_micro: int):
         in_specs=(spec_stage, P(), P()),
         out_specs=P(),
     )
-
-    # Mirrors TransformerLM.__call__'s prologue/epilogue (same modules, same
-    # param keys). The coupling is pinned loudly, not silently: every
-    # pp-vs-oracle exactness test compares against TransformerLM.apply, so a
-    # structural change there fails tests until this mirror is updated.
-    import flax.linen as nn
-    emb_mod = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype,
-                       param_dtype=jnp.float32)
-    norm_mod = nn.RMSNorm(dtype=model.dtype, param_dtype=jnp.float32)
-    head_mod = nn.Dense(model.vocab_size, dtype=model.dtype,
-                        param_dtype=jnp.float32, use_bias=False)
 
     def fwd(stacked_blocks, rest, tokens):
         b, seq = tokens.shape
@@ -190,6 +201,108 @@ def pp_place_params(stacked, mesh: Mesh):
     return jax.device_put(stacked, NamedSharding(mesh, P("pipe")))
 
 
+@functools.lru_cache(maxsize=16)
+def _pp_fused_loss(model, mesh: Mesh, n_stages: int, n_micro: int):
+    """Loss-fused, activation-light pipelined training loss.
+
+    The plain forward (:func:`_pp_fwd`) replicates the microbatch
+    activation buffer and the recorded outputs across stages — fine for
+    exactness demos, wrong memory model for training. This builder keeps
+    only O(mb · seq · d) live per stage:
+
+      * stage 0 EMBEDS its next microbatch inside each tick (tokens are
+        replicated int32 — a few KB — instead of a replicated activation
+        buffer; other stages compute the same cheap gather and discard it,
+        the standard SPMD select idiom);
+      * the LAST stage consumes each drained microbatch immediately —
+        final norm + lm_head + cross-entropy inside the tick — and
+        accumulates a scalar loss instead of recording logits;
+      * the scan carry is one [mb, seq, d] activation per stage, the true
+        GPipe boundary-activation footprint, with per-layer remat inside
+        the block chunk.
+
+    Gradients of the replicated prologue/epilogue params are psum'd by
+    shard_map's transpose automatically. Returns
+    ``loss(stacked_blocks, rest, (tokens, targets)) -> scalar``.
+    """
+    block, emb_mod, norm_mod, head_mod = _mirror_modules(model)
+
+    def per_stage(stage_params, rest, tokens_mb, targets_mb):
+        # stage_params [1, per, ...]; rest replicated; tokens/targets
+        # [n_micro, mb, seq] replicated int32 (tiny)
+        me = lax.axis_index("pipe")
+        apply_chunk = _chunk_applier(block, stage_params)
+
+        seq = tokens_mb.shape[2]
+        positions = jnp.arange(seq)
+
+        def embed(i):
+            toks = lax.dynamic_index_in_dim(tokens_mb, i, axis=0,
+                                            keepdims=False)
+            return emb_mod.apply({"params": rest["embed"]}, toks)
+
+        # rematted: without the checkpoint the scan backward would stash a
+        # per-tick fp32 [mb, seq, vocab] logits residual on every stage —
+        # larger than the buffers this schedule exists to avoid
+        @jax.checkpoint
+        def microbatch_loss(y, idx):
+            h = norm_mod.apply({"params": rest["final_norm"]}, y)
+            logits = head_mod.apply({"params": rest["lm_head"]},
+                                    h).astype(jnp.float32)
+            tgts = lax.dynamic_index_in_dim(targets_mb, idx, axis=0,
+                                            keepdims=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tgts).mean()
+
+        def tick(carry, t):
+            x_cur, loss_acc = carry
+            y = apply_chunk(x_cur, positions)
+            idx = t - (n_stages - 1)
+            # every stage computes the epilogue (RMSNorm + d x vocab head
+            # matmul + CE) and non-last stages discard it via the mask —
+            # the SPMD select idiom. Stated cost: the epilogue is paid
+            # S x (M+S-1)/M times vs once in the plain path; a per-device
+            # lax.cond would skip it but aborts XLA at runtime (collective
+            # -free branches notwithstanding), so uniformity wins here.
+            contrib = microbatch_loss(y, jnp.clip(idx, 0, n_micro - 1))
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(me == n_stages - 1, idx >= 0), contrib, 0.0)
+            nxt = lax.ppermute(
+                y, "pipe", [(s, (s + 1) % n_stages) for s in range(n_stages)])
+            ingest = embed(jnp.clip(t + 1, 0, n_micro - 1))
+            x_next = jnp.where(
+                me == 0,
+                jnp.where(t + 1 < n_micro, ingest, jnp.zeros_like(ingest)),
+                nxt)
+            return (x_next, loss_acc), None
+
+        x0 = jnp.where(me == 0, embed(0), jnp.zeros_like(embed(0)))
+        loss0 = _pvary(jnp.zeros((), jnp.float32), ("pipe",))
+        (_, loss_acc), _ = lax.scan(
+            tick, (x0, loss0), jnp.arange(n_micro + n_stages - 1))
+        # only the last stage accumulated; psum replicates the total
+        return lax.psum(loss_acc, "pipe")
+
+    mapped = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(P("pipe"), P(), P(), P()),
+        out_specs=P(),
+    )
+
+    def loss(stacked_blocks, rest, batch):
+        tokens, targets = batch
+        b, seq = tokens.shape
+        if b % n_micro:
+            raise ValueError(
+                f"batch {b} must divide into {n_micro} microbatches")
+        mb = b // n_micro
+        return mapped(stacked_blocks, rest,
+                      tokens.reshape(n_micro, mb, seq),
+                      targets.reshape(n_micro, mb, seq)) / n_micro
+
+    return loss
+
+
 def pp_loss_fn(model, mesh: Mesh, n_micro: int = 2):
     """Next-token cross-entropy through the pipelined forward.
 
@@ -210,12 +323,20 @@ def pp_loss_fn(model, mesh: Mesh, n_micro: int = 2):
     return loss
 
 
-def pp_train_step_fn(model, mesh: Mesh, optimizer, n_micro: int = 2):
+def pp_train_step_fn(model, mesh: Mesh, optimizer, n_micro: int = 2,
+                     fused_loss: bool = False):
     """Compiled pipelined TRAINING step (net-new; SURVEY §2.6 PP row).
 
     Build ONCE and reuse across the training loop (like ``jax.jit``): each
     call constructs a fresh jitted step, so calling this inside the loop
     recompiles the whole GPipe schedule every iteration.
+
+    ``fused_loss=True`` uses the activation-light schedule
+    (:func:`_pp_fused_loss`): stage 0 embeds its next microbatch inside
+    each tick and the last stage folds each drained microbatch straight
+    into the cross-entropy — per-stage live memory is O(mb·seq·d) instead
+    of the replicated full-batch activation buffers of the plain forward.
+    Same numerics (loss curves match to fp tolerance).
 
     ``step(stacked_blocks, rest, opt_state, batch) -> (stacked, rest,
     opt_state, loss)`` where ``batch = (tokens, targets)``; gradients flow
@@ -227,7 +348,10 @@ def pp_train_step_fn(model, mesh: Mesh, optimizer, n_micro: int = 2):
     :func:`pp_place_params`; numerics match the single-device step exactly
     (tests/test_pipeline_parallel.py pins the loss curve).
     """
-    loss = pp_loss_fn(model, mesh, n_micro)
+    if fused_loss:
+        loss = _pp_fused_loss(model, mesh, mesh.shape["pipe"], n_micro)
+    else:
+        loss = pp_loss_fn(model, mesh, n_micro)
 
     def step(stacked_blocks, rest, opt_state, batch):
         l, grads = jax.value_and_grad(
