@@ -41,3 +41,28 @@ def test_checkpoint_roundtrip(bf8, tmp_path):
     # training continues from the restored state
     restored2, _ = opt.step(restored, targets)
     jax.block_until_ready(restored2.params)
+
+
+def test_async_save_roundtrip(bf8, tmp_path):
+    """save_async keeps training unblocked; wait_pending commits; restore
+    sees the exact state. A second async save serializes behind the first."""
+    from bluefog_tpu import checkpoint as ck
+
+    x = bf.shard_rank_stacked(bf.mesh(),
+                              np.arange(16.0, dtype=np.float32).reshape(8, 2))
+    st0 = bf.TrainState(params={"w": x}, opt_state={"m": x * 2.0},
+                        model_state=None)
+    p1 = tmp_path / "a1"
+    ck.save_async(str(p1), st0, step=5)
+    # back-to-back async saves must serialize, not corrupt each other
+    p2 = tmp_path / "a2"
+    ck.save_async(str(p2), st0, step=6)
+    ck.wait_pending()
+
+    for p, step in ((p1, 5), (p2, 6)):
+        restored, got_step = ck.restore(str(p), template=st0)
+        assert got_step == step
+        np.testing.assert_allclose(np.asarray(restored.params["w"]),
+                                   np.asarray(x))
+        np.testing.assert_allclose(np.asarray(restored.opt_state["m"]),
+                                   2.0 * np.asarray(x))
