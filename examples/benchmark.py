@@ -43,7 +43,8 @@ def parse_args():
     p.add_argument("--dist-optimizer", default="neighbor_allreduce",
                    choices=["neighbor_allreduce", "allreduce",
                             "gradient_allreduce", "hierarchical_neighbor_allreduce",
-                            "win_put", "push_sum", "pull_get", "local"])
+                            "win_put", "push_sum", "pull_get",
+                            "sharded_allreduce", "local"])
     p.add_argument("--disable-dynamic-topology", action="store_true",
                    help="use the static topology instead of the one-peer "
                         "dynamic Expo-2 schedule")
@@ -107,6 +108,7 @@ def main():
         "neighbor_allreduce": bf.DistributedNeighborAllreduceOptimizer,
         "allreduce": bf.DistributedAllreduceOptimizer,
         "gradient_allreduce": bf.DistributedGradientAllreduceOptimizer,
+        "sharded_allreduce": bf.DistributedShardedAllreduceOptimizer,
         "hierarchical_neighbor_allreduce":
             bf.DistributedHierarchicalNeighborAllreduceOptimizer,
         "win_put": bf.DistributedWinPutOptimizer,
