@@ -174,3 +174,59 @@ def test_win_put_optimizer_over_hosted_plane(bf_hosted):
     w = np.asarray(state.params["w"])
     assert np.abs(w - np.asarray(target)[None]).max() < 0.5
     opt.free()
+
+
+def test_concurrent_accumulates_preserve_mass(bf_hosted):
+    """Mutex/state-lock correctness under real concurrency: worker threads
+    fire win_accumulate (require_mutex) while the main thread repeatedly
+    collects; every deposited unit of mass must end up in exactly one
+    place — total collected + final drain == everything deposited."""
+    import threading
+
+    n = 8
+    x = jnp.ones((n, 2))
+    assert bf.win_create(x, "h.stress", zero_init=True)
+    topo = bf.load_topology()
+    indeg = {r: len(bf.topology_util.in_neighbor_ranks(topo, r))
+             for r in range(n)}
+    per_op_mass = float(sum(indeg.values()) * 2)  # ones into every edge slot
+
+    ROUNDS = 6
+    done = threading.Barrier(3)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(ROUNDS):
+                bf.win_accumulate(x, "h.stress", require_mutex=True)
+        except Exception as e:  # noqa: BLE001 - surfaced by the assert below
+            errors.append(e)
+        finally:
+            done.wait(30)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    collected = 0.0
+    for _ in range(4):
+        out = bf.win_update(
+            "h.stress", self_weight=0.0,
+            neighbor_weights={r: {s: 1.0 for s in
+                                  bf.topology_util.in_neighbor_ranks(topo, r)}
+                              for r in range(n)},
+            reset=True, clone=True, require_mutex=True)
+        collected += float(np.asarray(out).sum())
+    done.wait(30)
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+    # final drain picks up whatever the last collects missed
+    out = bf.win_update(
+        "h.stress", self_weight=0.0,
+        neighbor_weights={r: {s: 1.0 for s in
+                              bf.topology_util.in_neighbor_ranks(topo, r)}
+                          for r in range(n)},
+        reset=True, clone=True, require_mutex=True)
+    collected += float(np.asarray(out).sum())
+    np.testing.assert_allclose(collected, 2 * ROUNDS * per_op_mass, rtol=1e-5)
+    bf.win_free("h.stress")
