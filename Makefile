@@ -6,9 +6,9 @@ PYTEST      = python -m pytest
 MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
-        test_launcher test_models bench dryrun native
+        test_launcher test_models bench dryrun native scaling lm_bench
 
-test:            ## full suite (slow: ~1 h on a shared-core CPU mesh)
+test:            ## full suite (~15 min on the single-core CI box)
 	$(PYTEST) tests/ -q
 
 test_fast:       ## the pre-commit gate: quick subset (skips @slow)
@@ -43,3 +43,9 @@ dryrun:          ## multi-chip sharding validation on the simulated mesh
 
 native:          ## build the native runtime extension
 	bash csrc/build.sh
+
+scaling:         ## regenerate SCALING.md (compile-time scaling evidence)
+	JAX_PLATFORMS=cpu python -m bluefog_tpu.scaling
+
+lm_bench:        ## transformer tokens/s + MFU headline (real chip)
+	python scripts/lm_bench.py
