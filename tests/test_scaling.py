@@ -134,3 +134,18 @@ def test_hlo_measured_bytes_scale_with_model_size():
     big = scaling.collective_bytes(
         scaling.lower_train_step("neighbor_dynamic_onepeer", 8, d=128))
     assert big["collective_permute"] == 4 * small["collective_permute"]
+
+
+@pytest.mark.parametrize("n", NS)
+def test_ring_attention_hlo_two_permutes_linear_block_shrink(n):
+    """Long-context axis: the ring forward's scan body holds exactly TWO
+    collective-permutes (K and V block hops, one-neighbor ICI traffic),
+    zero all-reduces, and the per-ring-step permute bytes shrink linearly
+    with the mesh (each hop carries one [B, S/n, H, D] bf16 block)."""
+    S, H, D = 1024, 8, 64
+    txt = scaling.lower_cp_forward(n, seq=S, heads=H, d_head=D)
+    c = scaling.collective_counts(txt)
+    assert c["collective_permute"] == 2
+    assert c["all_reduce"] == 0
+    b = scaling.collective_bytes(txt)
+    assert b["collective_permute"] == 2 * (S // n) * H * D * 2  # bf16
