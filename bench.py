@@ -122,13 +122,9 @@ def _require_live_backend(timeout_s: float = 180.0) -> None:
     import subprocess
     import sys
 
-    env = os.environ.get("BLUEFOG_BENCH_INIT_TIMEOUT")
-    if env:
-        try:
-            timeout_s = float(env)
-        except ValueError:
-            print(f"bench: ignoring malformed BLUEFOG_BENCH_INIT_TIMEOUT="
-                  f"{env!r} (want seconds as a number)", file=sys.stderr)
+    from bluefog_tpu.runtime.config import timeout_from_env
+
+    timeout_s = timeout_from_env("BLUEFOG_BENCH_INIT_TIMEOUT", timeout_s)
     if timeout_s <= 0:  # explicit opt-out: skip the probe's init cost
         return
     try:

@@ -89,6 +89,27 @@ class Config:
         return cfg
 
 
+def timeout_from_env(var: str, default: float) -> float:
+    """Seconds from env ``var``; warn (never raise) on a malformed value.
+
+    Shared by the driver-facing entry scripts (``bench.py``'s backend
+    probe, ``__graft_entry__``'s dryrun deadline) so their fail-fast knobs
+    parse identically. Callers interpret ``<= 0`` (the opt-out convention)
+    themselves.
+    """
+    import sys
+
+    raw = os.environ.get(var)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"{var}={raw!r} is not a number of seconds; "
+              f"using {default:g}", file=sys.stderr)
+        return default
+
+
 def example_devices(n: int = 8):
     """Device list for examples/scripts run OUTSIDE ``bfrun``.
 

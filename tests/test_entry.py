@@ -27,5 +27,7 @@ def test_entry_traces():
 
 
 def test_dryrun_multichip_8():
-    assert len(jax.devices("cpu")) >= 8
+    # No device precondition: the dryrun re-execs itself in a CPU-pinned
+    # subprocess that forces its own 8-device mesh, independent of this
+    # process's backend (the round-3 tunnel-hang fix).
     graft.dryrun_multichip(8)
