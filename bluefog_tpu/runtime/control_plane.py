@@ -38,6 +38,7 @@ _client: Optional[ControlPlaneClient] = None
 _server: Optional[ControlPlaneServer] = None
 _world: int = 1
 _tried = False
+_conn_params = None  # (host, port, rank, secret) of the live attachment
 
 
 def _env_port(default: Optional[int] = None) -> Optional[int]:
@@ -69,7 +70,7 @@ def attach() -> Optional[ControlPlaneClient]:
     Returns the process-global client, or None when the control plane is
     not configured / disabled / the native runtime is unavailable.
     """
-    global _client, _server, _world, _tried
+    global _client, _server, _world, _tried, _conn_params
     with _mu:
         if _client is not None or _tried:
             return _client
@@ -81,6 +82,11 @@ def attach() -> Optional[ControlPlaneClient]:
         port = _env_port()
         rank = int(os.environ.get("BLUEFOG_CP_RANK", "0"))
         world = int(os.environ.get("BLUEFOG_CP_WORLD", "0"))
+        # Shared-secret authentication (reference: HMAC-signed driver/task
+        # messages, run/horovodrun/common/util/network.py:69-86). The
+        # launcher generates one per job and distributes it via env; without
+        # it the server accepts any TCP connect (single-host dev only).
+        secret = os.environ.get("BLUEFOG_CP_SECRET", "")
 
         if host is None:
             # Automatic multi-controller wiring: prefer the launcher's env,
@@ -105,7 +111,11 @@ def attach() -> Optional[ControlPlaneClient]:
 
         if rank == 0 and os.environ.get("BLUEFOG_CP_SERVE", "1") != "0":
             try:
-                _server = ControlPlaneServer(world, port)
+                max_mb = float(os.environ.get(
+                    "BLUEFOG_CP_MAILBOX_MAX_MB", "256"))
+                _server = ControlPlaneServer(
+                    world, port, secret=secret,
+                    max_mailbox_bytes=int(max_mb * (1 << 20)))
             except (OSError, RuntimeError) as exc:
                 # Another actor (launcher, tests) may already serve this port.
                 logger.debug("control plane server not started here (%s)", exc)
@@ -116,7 +126,7 @@ def attach() -> Optional[ControlPlaneClient]:
         last: Optional[Exception] = None
         while time.monotonic() < deadline:
             try:
-                _client = ControlPlaneClient(host, port, rank)
+                _client = ControlPlaneClient(host, port, rank, secret=secret)
                 break
             except (OSError, RuntimeError) as exc:
                 last = exc
@@ -128,6 +138,7 @@ def attach() -> Optional[ControlPlaneClient]:
                 _server = None
             return None
         _world = world
+        _conn_params = (host, port, rank, secret)
         logger.info("control plane attached: %s:%d rank=%d world=%d",
                     host, port, rank, world)
         return _client
@@ -143,13 +154,28 @@ def client() -> ControlPlaneClient:
     return _client
 
 
+def extra_client() -> ControlPlaneClient:
+    """A NEW dedicated connection to the attached server (caller closes it).
+
+    The shared :func:`client` connection serializes calls and can be parked
+    for seconds inside a blocking server-side op (window mutex lock,
+    barrier). Subsystems that must stay live regardless — the heartbeat
+    above all, whose silence marks this controller DEAD — run their traffic
+    over their own connection instead.
+    """
+    if _conn_params is None:
+        raise RuntimeError("control plane is not attached")
+    host, port, rank, secret = _conn_params
+    return ControlPlaneClient(host, port, rank, secret=secret)
+
+
 def world() -> int:
     return _world
 
 
 def detach() -> None:
     """Close the client (and server, when owned). Safe to call repeatedly."""
-    global _client, _server, _tried, _world
+    global _client, _server, _tried, _world, _conn_params
     with _mu:
         if _client is not None:
             _client.close()
@@ -159,6 +185,7 @@ def detach() -> None:
             _server = None
         _tried = False
         _world = 1
+        _conn_params = None
 
 
 def reset_for_test() -> None:

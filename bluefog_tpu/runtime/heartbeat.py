@@ -61,12 +61,27 @@ class PeerMonitor:
         self._last_value: Dict[int, int] = {}
         self._last_change: Dict[int, float] = {}
         self._dead: set = set()
+        self._cl = None  # dedicated control-plane connection (see start())
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         if self._thread is not None or not _cp.active():
             return
+        # Dedicated connection: the SHARED client's mutex is held for the
+        # full round-trip of every call, and window ops park it inside
+        # blocking server-side locks (hosted win mutexes, barriers). A
+        # heartbeat riding that connection would go silent exactly when the
+        # job is busiest — and silence past BLUEFOG_HEARTBEAT_TIMEOUT makes
+        # live peers declare this controller dead. Own socket = the
+        # heartbeat's cadence depends on nothing but the server being up.
+        try:
+            self._cl = _cp.extra_client()
+        except (OSError, RuntimeError) as exc:
+            logger.warning(
+                "heartbeat: dedicated control-plane connection failed (%s); "
+                "falling back to the shared one", exc)
+            self._cl = None
         self._thread = threading.Thread(
             target=self._loop, name="bf-heartbeat", daemon=True)
         self._thread.start()
@@ -76,6 +91,9 @@ class PeerMonitor:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self._cl is not None:
+            self._cl.close()
+            self._cl = None
 
     # -- queries -----------------------------------------------------------
 
@@ -89,7 +107,7 @@ class PeerMonitor:
     # -- the loop ----------------------------------------------------------
 
     def _tick(self) -> None:
-        cl = _cp.client()
+        cl = self._cl if self._cl is not None else _cp.client()
         cl.put(f"bf.hb.{self.me}", int(time.monotonic_ns() & 0x7FFFFFFFFFFF))
         now = time.monotonic()
         for peer in range(self.world):

@@ -42,12 +42,18 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
 
     lib.bf_cp_serve.restype = ctypes.c_void_p
     lib.bf_cp_serve.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.bf_cp_serve_auth.restype = ctypes.c_void_p
+    lib.bf_cp_serve_auth.argtypes = [ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_char_p, ctypes.c_int64]
     lib.bf_cp_server_port.restype = ctypes.c_int
     lib.bf_cp_server_port.argtypes = [ctypes.c_void_p]
     lib.bf_cp_server_stop.restype = None
     lib.bf_cp_server_stop.argtypes = [ctypes.c_void_p]
     lib.bf_cp_connect.restype = ctypes.c_void_p
     lib.bf_cp_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.bf_cp_connect_auth.restype = ctypes.c_void_p
+    lib.bf_cp_connect_auth.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_char_p]
     for fname in ("bf_cp_barrier", "bf_cp_lock", "bf_cp_unlock", "bf_cp_get"):
         fn = getattr(lib, fname)
         fn.restype = ctypes.c_int64
@@ -107,14 +113,24 @@ def load() -> Optional[ctypes.CDLL]:
 
 
 class ControlPlaneServer:
-    """Coordinator side of the scalar control plane (one per job)."""
+    """Coordinator side of the scalar control plane (one per job).
 
-    def __init__(self, world: int, port: int = 0) -> None:
+    ``secret`` (non-empty) enables the mutual HMAC-SHA256 handshake: every
+    connection must prove knowledge of the job's shared secret before any
+    op is served — the analog of the reference's HMAC-signed driver/task
+    messages (run/horovodrun/common/util/network.py:69-86).
+    ``max_mailbox_bytes`` caps each deposit mailbox (0 = unlimited) so
+    depositors to a dead owner cannot grow server memory without bound.
+    """
+
+    def __init__(self, world: int, port: int = 0, secret: str = "",
+                 max_mailbox_bytes: int = 0) -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = lib
-        self._h = lib.bf_cp_serve(port, world)
+        self._h = lib.bf_cp_serve_auth(port, world, secret.encode(),
+                                       int(max_mailbox_bytes))
         if not self._h:
             raise OSError(f"control plane failed to bind port {port}")
         self.port = lib.bf_cp_server_port(self._h)
@@ -135,23 +151,35 @@ class ControlPlaneServer:
 class ControlPlaneClient:
     """Per-controller client: mutexes, counters, barriers, scalar KV."""
 
-    def __init__(self, host: str, port: int, rank: int) -> None:
+    def __init__(self, host: str, port: int, rank: int,
+                 secret: str = "") -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = lib
-        self._h = lib.bf_cp_connect(host.encode(), port, rank)
+        self._h = lib.bf_cp_connect_auth(host.encode(), port, rank,
+                                         secret.encode())
         if not self._h:
-            raise OSError(f"control plane connect to {host}:{port} failed")
+            raise OSError(
+                f"control plane connect to {host}:{port} failed"
+                + (" (authentication handshake rejected?)" if secret else ""))
 
     def barrier(self, name: str = "default") -> int:
-        return self._lib.bf_cp_barrier(self._h, name.encode())
+        r = self._lib.bf_cp_barrier(self._h, name.encode())
+        if r < 0:
+            raise OSError("control plane barrier failed (connection lost "
+                          "or not authenticated)")
+        return r
 
     def lock(self, name: str) -> None:
-        self._lib.bf_cp_lock(self._h, name.encode())
+        if self._lib.bf_cp_lock(self._h, name.encode()) < 0:
+            raise OSError("control plane lock failed (connection lost "
+                          "or not authenticated)")
 
     def unlock(self, name: str) -> None:
-        self._lib.bf_cp_unlock(self._h, name.encode())
+        if self._lib.bf_cp_unlock(self._h, name.encode()) < 0:
+            raise OSError("control plane unlock failed (connection lost "
+                          "or not authenticated)")
 
     def fetch_add(self, name: str, delta: int = 1) -> int:
         """Atomic fetch-then-add; returns the pre-add value
@@ -159,7 +187,9 @@ class ControlPlaneClient:
         return self._lib.bf_cp_fetch_add(self._h, name.encode(), delta)
 
     def put(self, name: str, value: int) -> None:
-        self._lib.bf_cp_put(self._h, name.encode(), value)
+        if self._lib.bf_cp_put(self._h, name.encode(), value) < 0:
+            raise OSError("control plane put failed (connection lost "
+                          "or not authenticated)")
 
     def get(self, name: str) -> int:
         return self._lib.bf_cp_get(self._h, name.encode())
@@ -190,6 +220,21 @@ class ControlPlaneClient:
                                  args, None, n) < 0:
             raise OSError("control plane put_many failed")
 
+    def fetch_add_many(self, names, deltas=None) -> list:
+        """Batched fetch_add (default delta 1 each): pre-add values, one
+        round-trip's latency — the hosted plane's version-bump hot path."""
+        names = list(names)
+        if not names:
+            return []
+        n = len(names)
+        args = (ctypes.c_int64 * n)(
+            *([1] * n if deltas is None else [int(d) for d in deltas]))
+        out = (ctypes.c_int64 * n)()
+        if self._lib.bf_cp_multi(self._h, 4, "\n".join(names).encode(),
+                                 args, out, n) < 0:
+            raise OSError("control plane fetch_add_many failed")
+        return list(out)
+
     # -- bulk bytes: the host tensor transport for one-sided windows --------
 
     # request framing overhead (header + key) must stay under the server's
@@ -210,6 +255,11 @@ class ControlPlaneClient:
         self._check_payload("append_bytes", data)
         r = self._lib.bf_cp_append_bytes(self._h, name.encode(), data,
                                          len(data))
+        if r == -2:
+            raise RuntimeError(
+                f"control plane mailbox '{name}' is full (server byte cap, "
+                "BLUEFOG_CP_MAILBOX_MAX_MB) — the owning controller has not "
+                "drained it; it may be dead (check bf.dead_controllers())")
         if r < 0:
             raise OSError("control plane append_bytes failed")
         return int(r)

@@ -63,6 +63,7 @@ class BluefogTPUState:
         # combine-matrix hashes every controller has agreed on
         # (ops.neighbors.cross_controller_topo_check)
         self._topo_check_agreed: set = set()
+        self._topo_check_calls: int = 0  # re-arm cadence counter
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -205,6 +206,7 @@ def init(
     st.win_ops_with_associated_p = False
     st._plan_cache = {}
     st._topo_check_agreed = set()
+    st._topo_check_calls = 0
     st.initialized = True
 
     if topology_fn is not None:
@@ -267,6 +269,16 @@ def shutdown(_announce: bool = True) -> None:
     if st.peer_monitor is not None:
         st.peer_monitor.stop()
         st.peer_monitor = None
+    # Release hosted-plane server state (published tensors, pending
+    # deposits) BEFORE detaching the client it needs. Best-effort and
+    # unaligned: peers may already be gone, so no close-time barriers —
+    # an externally shared control-plane server must not keep dead
+    # windows' bytes for its lifetime (ADVICE r3).
+    for win in list(st.windows.values()):
+        try:
+            win.close(aligned=False)
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
     _cp.detach()
     if st.watchdog is not None:
         st.watchdog.stop()
@@ -413,6 +425,7 @@ def set_topology(topology: Optional[nx.DiGraph] = None, is_weighted: bool = Fals
     st.is_topo_weighted = is_weighted
     st._plan_cache.clear()  # new graph -> new combine plans / jit traces
     st._topo_check_agreed.clear()
+    st._topo_check_calls = 0
     return True
 
 

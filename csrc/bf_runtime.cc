@@ -19,9 +19,11 @@
 // image). Build: csrc/build.sh (g++ -O2 -shared -fPIC).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -186,6 +188,150 @@ enum Op : uint8_t {
   kGetBytes = 11,
 };
 
+// -- SHA-256 / HMAC-SHA256 (self-contained; no OpenSSL in the image) --------
+//
+// Used only for the connection handshake below — the analog of the
+// reference's HMAC-signed driver/task messages
+// (run/horovodrun/common/util/network.py:69-86), which reject any peer
+// that does not hold the job's shared secret.
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buf_len = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t Rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void Block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+      uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void Update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    len += n;
+    while (n) {
+      size_t take = 64 - buf_len;
+      if (take > n) take = n;
+      std::memcpy(buf + buf_len, p, take);
+      buf_len += take;
+      p += take;
+      n -= take;
+      if (buf_len == 64) {
+        Block(buf);
+        buf_len = 0;
+      }
+    }
+  }
+
+  void Final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_len != 56) Update(&zero, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; ++i) lb[i] = uint8_t(bits >> (56 - 8 * i));
+    Update(lb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void HmacSha256(const std::string& key, const uint8_t* msg, size_t msg_len,
+                uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 kh;
+    kh.Update(key.data(), key.size());
+    kh.Final(k);
+  } else {
+    std::memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 hi;
+  hi.Update(ipad, 64);
+  hi.Update(msg, msg_len);
+  hi.Final(inner);
+  Sha256 ho;
+  ho.Update(opad, 64);
+  ho.Update(inner, 32);
+  ho.Final(out);
+}
+
+bool ConstTimeEq(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < n; ++i) acc |= a[i] ^ b[i];
+  return acc == 0;
+}
+
+void RandomBytes(uint8_t* out, size_t n) {
+  int fd = ::open("/dev/urandom", O_RDONLY);
+  size_t got = 0;
+  if (fd >= 0) {
+    while (got < n) {
+      ssize_t r = ::read(fd, out + got, n - got);
+      if (r <= 0) break;
+      got += static_cast<size_t>(r);
+    }
+    ::close(fd);
+  }
+  for (; got < n; ++got)  // degraded fallback; urandom exists on linux
+    out[got] = static_cast<uint8_t>(std::rand());
+}
+
 constexpr uint32_t kMaxMsg = 1u << 30;       // 1 GiB bulk-payload ceiling
 // Per-reply ceiling for kTakeBytes: a drain takes at most this many payload
 // bytes per call (plus one record, so a single oversized record still moves);
@@ -196,6 +342,8 @@ constexpr size_t kMaxTakeReply = 64u << 20;  // 64 MiB
 struct ControlServer {
   int listen_fd = -1;
   int world = 0;
+  std::string secret;          // empty = unauthenticated (single-host dev)
+  int64_t max_box_bytes = 0;   // per-mailbox byte cap; 0 = unlimited
   std::thread accept_thread;
   std::vector<std::thread> handlers;
   std::vector<int> handler_fds;
@@ -205,12 +353,46 @@ struct ControlServer {
   std::condition_variable cv;
   std::map<std::string, int64_t> kv;
   std::map<std::string, std::vector<std::string>> mailbox;  // append/take
+  std::map<std::string, int64_t> box_bytes;                 // payload bytes
   std::map<std::string, std::string> bytes_kv;              // put/get bytes
   std::map<std::string, int> lock_owner;           // key -> rank (or -1)
   std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
   std::map<std::string, int> barrier_count;
 
+  // Mutual challenge-response before any op is served: the server proves it
+  // holds the secret too (a client must not leak window tensors to a rogue
+  // listener), and an unauthenticated peer is disconnected before it can
+  // touch locks, counters, or mailboxes. A bounded SO_RCVTIMEO keeps a
+  // silent or legacy (no-handshake) client from parking the handler thread.
+  bool Handshake(int fd) {
+    if (secret.empty()) return true;
+    timeval tv{10, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    uint8_t nonce_s[32];
+    RandomBytes(nonce_s, 32);
+    if (!WriteAll(fd, nonce_s, 32)) return false;
+    uint8_t reply[64];  // client nonce || HMAC(secret, "c" || nonce_s)
+    if (!ReadAll(fd, reply, 64)) return false;
+    uint8_t expect[32], msg[33];
+    msg[0] = 'c';
+    std::memcpy(msg + 1, nonce_s, 32);
+    HmacSha256(secret, msg, 33, expect);
+    if (!ConstTimeEq(reply + 32, expect, 32)) return false;
+    uint8_t proof[32];
+    msg[0] = 's';
+    std::memcpy(msg + 1, reply, 32);
+    HmacSha256(secret, msg, 33, proof);
+    if (!WriteAll(fd, proof, 32)) return false;
+    timeval off{0, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+    return true;
+  }
+
   void Handle(int fd) {
+    if (!Handshake(fd)) {
+      ::close(fd);
+      return;
+    }
     for (;;) {
       uint32_t len;
       if (!ReadAll(fd, &len, 4)) break;
@@ -288,7 +470,19 @@ struct ControlServer {
         case kAppendBytes: {
           std::lock_guard<std::mutex> lk(mu);
           auto& box = mailbox[key];
+          int64_t& bytes = box_bytes[key];
+          // Cap each mailbox (kMaxTakeReply bounds only the drain reply):
+          // a crashed/stalled owner must not let depositors grow server
+          // memory without limit. -2 tells the client "mailbox full" so it
+          // can raise a targeted error instead of a wire failure.
+          if (max_box_bytes > 0 &&
+              bytes + static_cast<int64_t>(dlen) > max_box_bytes &&
+              !box.empty()) {
+            reply = -2;
+            break;
+          }
           box.emplace_back(data, dlen);
+          bytes += static_cast<int64_t>(dlen);
           reply = static_cast<int64_t>(box.size());
           break;
         }
@@ -311,10 +505,15 @@ struct ControlServer {
               if (i >= box.size()) {
                 records.swap(box);
                 mailbox.erase(it);
+                box_bytes.erase(key);
               } else {
                 records.assign(std::make_move_iterator(box.begin()),
                                std::make_move_iterator(box.begin() + i));
                 box.erase(box.begin(), box.begin() + i);
+                int64_t taken = 0;
+                for (const auto& r : records)
+                  taken += static_cast<int64_t>(r.size());
+                box_bytes[key] -= taken;
               }
             }
           }
@@ -420,6 +619,32 @@ struct ControlClient {
   int rank = 0;
   std::mutex mu;
 
+  // Client half of ControlServer::Handshake (mutual): prove we hold the
+  // secret, then verify the server's proof over OUR nonce so window bytes
+  // are never sent to a listener that merely accepted the TCP connect.
+  static bool Handshake(int fd, const std::string& secret) {
+    if (secret.empty()) return true;
+    timeval tv{10, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    uint8_t nonce_s[32];
+    if (!ControlServer::ReadAll(fd, nonce_s, 32)) return false;
+    uint8_t out[64], msg[33];
+    RandomBytes(out, 32);  // nonce_c
+    msg[0] = 'c';
+    std::memcpy(msg + 1, nonce_s, 32);
+    HmacSha256(secret, msg, 33, out + 32);
+    if (!ControlServer::WriteAll(fd, out, 64)) return false;
+    uint8_t proof[32], expect[32];
+    if (!ControlServer::ReadAll(fd, proof, 32)) return false;
+    msg[0] = 's';
+    std::memcpy(msg + 1, out, 32);
+    HmacSha256(secret, msg, 33, expect);
+    if (!ConstTimeEq(proof, expect, 32)) return false;
+    timeval off{0, 0};
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+    return true;
+  }
+
   void Encode(std::vector<char>* buf, uint8_t op, const std::string& key,
               int64_t arg, const void* data = nullptr, size_t dlen = 0) {
     uint16_t klen = static_cast<uint16_t>(key.size());
@@ -503,7 +728,8 @@ struct ControlClient {
 
 extern "C" {
 
-void* bf_cp_serve(int port, int world) {
+void* bf_cp_serve_auth(int port, int world, const char* secret,
+                       int64_t max_mailbox_bytes) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -520,8 +746,14 @@ void* bf_cp_serve(int port, int world) {
   auto* srv = new ControlServer();
   srv->listen_fd = fd;
   srv->world = world;
+  srv->secret = secret ? secret : "";
+  srv->max_box_bytes = max_mailbox_bytes;
   srv->accept_thread = std::thread([srv] { srv->AcceptLoop(); });
   return srv;
+}
+
+void* bf_cp_serve(int port, int world) {
+  return bf_cp_serve_auth(port, world, "", 0);
 }
 
 int bf_cp_server_port(void* handle) {
@@ -556,7 +788,8 @@ void bf_cp_server_stop(void* handle) {
   delete srv;
 }
 
-void* bf_cp_connect(const char* host, int port, int rank) {
+void* bf_cp_connect_auth(const char* host, int port, int rank,
+                         const char* secret) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   sockaddr_in addr{};
@@ -572,10 +805,18 @@ void* bf_cp_connect(const char* host, int port, int rank) {
   }
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (!ControlClient::Handshake(fd, secret ? secret : "")) {
+    ::close(fd);
+    return nullptr;
+  }
   auto* cl = new ControlClient();
   cl->fd = fd;
   cl->rank = rank;
   return cl;
+}
+
+void* bf_cp_connect(const char* host, int port, int rank) {
+  return bf_cp_connect_auth(host, port, rank, "");
 }
 
 int64_t bf_cp_barrier(void* h, const char* key) {

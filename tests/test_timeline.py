@@ -137,3 +137,44 @@ def test_start_stop_timeline_runtime_toggle(tmp_path):
         bf.allreduce(x, name="toggle.after")
     finally:
         bf.shutdown()
+
+
+def test_phase_subspans_land_in_file(tmp_path):
+    """Reference phase granularity (VERDICT r3 #8): dynamic plan
+    construction (PLAN_BUILD) and fusion-buffer copies (PACK/UNPACK — the
+    MEMCPY_IN/OUT_FUSION_BUFFER analog, common/timeline.cc usage in
+    mpi_controller.cc:276-292) must be visible as their own sub-spans."""
+    import optax
+
+    bf.init(devices=cpu_devices(8))
+    st = _global_state()
+    st.timeline = Timeline(str(tmp_path / "phase_"), use_native=False)
+    try:
+        x = bf.shard_rank_stacked(bf.mesh(), jnp.ones((8, 4)))
+        sends = {r: [(r + 1) % 8] for r in range(8)}
+        nw = {r: {(r - 1) % 8: 0.5} for r in range(8)}
+        # first dynamic call: builds (and caches) the plan -> PLAN_BUILD
+        bf.neighbor_allreduce(x, self_weight=0.5, neighbor_weights=nw,
+                              send_neighbors=sends, name="t.dyn")
+        # warm call: plan cache hit -> NO second PLAN_BUILD
+        bf.neighbor_allreduce(x, self_weight=0.5, neighbor_weights=nw,
+                              send_neighbors=sends, name="t.dyn2")
+        # a window-optimizer step exercises the fusion pack/unpack path
+        def zl(p, b):
+            return 0.0 * jnp.sum(p["w"])
+        opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1), zl,
+                                            window_prefix="tl.phase")
+        state = opt.init({"w": jnp.ones((4,), jnp.float32)})
+        opt.step(state, jnp.zeros((8, 1), jnp.float32))
+        opt.free()
+    finally:
+        path = st.timeline.path
+        bf.shutdown()
+    events = _events(path)
+    starts = [e for e in events if e.get("ph") == "B"]
+    plan_builds = [e for e in starts if e["name"] == "PLAN_BUILD"]
+    assert any(e["cat"] == "t.dyn" for e in plan_builds)
+    assert not any(e["cat"] == "t.dyn2" for e in plan_builds), \
+        "plan cache missed on an identical dynamic step"
+    names = {e["name"] for e in starts}
+    assert "PACK" in names and "UNPACK" in names
