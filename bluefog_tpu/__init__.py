@@ -39,6 +39,10 @@ from .runtime.state import (
     in_neighbor_ranks,
     out_neighbor_ranks,
     set_skip_negotiate_stage,
+    get_skip_negotiate_stage,
+    unified_mpi_window_model_supported,
+    mpi_threads_supported,
+    nccl_built,
 )
 
 # handles

@@ -10,6 +10,7 @@ with float32 parameters/batch-stats, channel counts that are multiples of
 """
 
 from .mlp import MLP, LeNet5
+from .fold import fold_batchnorm
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
 from .transformer import TransformerLM, apply_rope
 from .vgg import VGG, VGG11, VGG16, VGG19
@@ -22,6 +23,7 @@ __all__ = [
     "ResNet34",
     "ResNet50",
     "ResNet101",
+    "fold_batchnorm",
     "TransformerLM",
     "apply_rope",
     "VGG",

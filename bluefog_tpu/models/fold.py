@@ -1,0 +1,98 @@
+"""Batch-norm folding for inference: absorb BN into conv weights + bias.
+
+The classic serving transform (torch's ``fuse_conv_bn_eval``; the
+reference's deployment story inherits it from torchvision): at inference a
+BatchNorm is the affine ``y = (x - mean) * gamma / sqrt(var + eps) + beta``
+— fold the scale into the preceding conv's output channels and the shift
+into a bias, and the norm disappears from the graph entirely. Use with the
+``fold_bn=True`` model variant::
+
+    folded = fold_batchnorm(params, batch_stats)
+    model = ResNet50(dtype=jnp.bfloat16, fold_bn=True)
+    logits = model.apply({"params": folded}, x, train=False)
+
+Measured on the v5e chip this is a wash for THROUGHPUT — 11.71 ms/step
+unfolded vs 12.25 ms folded at B=128, because XLA already fuses the
+inference-BN affine into the conv epilogue (see PERF.md) — but it halves
+the inference param-collection count (no batch_stats to ship) and keeps
+the exported graph free of normalization ops, which is what serving
+runtimes want.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1e-5  # must match the model's BatchNorm epsilon
+
+
+def _fold_pair(kernel, scale, bias, mean, var,
+               eps: float) -> Tuple[Any, Any]:
+    """(W', b') for conv kernel [kh, kw, cin, cout] + BN stats over cout."""
+    g = np.asarray(scale, np.float64)
+    b = np.asarray(bias, np.float64)
+    mu = np.asarray(mean, np.float64)
+    v = np.asarray(var, np.float64)
+    inv = g / np.sqrt(v + eps)
+    w = np.asarray(kernel, np.float64) * inv  # broadcast over cout (last)
+    bnew = b - mu * inv
+    return (jnp.asarray(w, jnp.float32), jnp.asarray(bnew, jnp.float32))
+
+
+def _norm_to_conv_name(norm_name: str, siblings) -> str:
+    """Which conv a BN folds into, by the model zoo's naming contract."""
+    if norm_name.startswith("BatchNorm_"):
+        return "Conv_" + norm_name.split("_", 1)[1]
+    if norm_name == "norm_proj":
+        return "conv_proj"
+    if norm_name == "bn_init":
+        for cand in ("conv_init", "conv_init_s2d"):
+            if cand in siblings:
+                return cand
+    raise ValueError(f"no conv pairing rule for norm '{norm_name}'")
+
+
+def fold_batchnorm(params: Dict, batch_stats: Dict,
+                   eps: float = _EPS) -> Dict:
+    """Fold every BatchNorm in ``params`` into its preceding conv.
+
+    Returns a new param tree for the ``fold_bn=True`` model variant: BN
+    entries are gone, each paired conv gains a ``bias``. Pairing follows
+    the model zoo's naming (``BatchNorm_i`` -> ``Conv_i``, ``norm_proj`` ->
+    ``conv_proj``, ``bn_init`` -> the stem conv); unknown norm names raise
+    rather than silently passing through un-folded.
+    """
+    def walk(p: Dict, s: Dict) -> Dict:
+        out = {}
+        norm_shaped = [k for k, v in p.items()
+                       if isinstance(v, Mapping) and "scale" in v
+                       and "kernel" not in v]
+        missing = [k for k in norm_shaped if k not in s]
+        if missing:
+            raise ValueError(
+                f"fold_batchnorm: norm entries {missing} have no matching "
+                "batch_stats — pass the SAME model's stats collection")
+        norms = norm_shaped
+        folded_convs = {}
+        for nk in norms:
+            ck = _norm_to_conv_name(nk, p)
+            kernel = p[ck]["kernel"]
+            w, b = _fold_pair(kernel, p[nk]["scale"], p[nk]["bias"],
+                              s[nk]["mean"], s[nk]["var"], eps)
+            folded_convs[ck] = {"kernel": w, "bias": b}
+        for k, v in p.items():
+            if k in norms:
+                continue  # absorbed
+            if k in folded_convs:
+                out[k] = folded_convs[k]
+            elif isinstance(v, Mapping):
+                out[k] = walk(dict(v), dict(s.get(k, {})))
+            else:
+                out[k] = v
+        return out
+
+    return walk(dict(params), dict(batch_stats))

@@ -93,9 +93,18 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
     stem: str = "conv"  # "conv" (7x7/2, torchvision parity) | "space_to_depth"
+    # Inference-only variant consuming ``models.fold_batchnorm`` output:
+    # every BatchNorm collapses into the preceding conv's weights + a bias,
+    # so the apply carries no batch_stats collection at all. Training with
+    # fold_bn=True is meaningless (there is no norm to update) and rejected.
+    fold_bn: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.fold_bn and train:
+            raise ValueError("fold_bn=True is an inference-only variant; "
+                             "apply with train=False")
+
         def conv(features, kernel_size, strides=(1, 1), name=None):
             # explicit ((k-1)//2, k//2) padding: identical to SAME at
             # stride 1 for every kernel, and — symmetric for the odd
@@ -105,14 +114,18 @@ class ResNet(nn.Module):
             # torchvision weights loaded via utils/torch_interop.py.
             k = kernel_size[0]
             return nn.Conv(
-                features, kernel_size, strides, use_bias=False,
+                features, kernel_size, strides, use_bias=self.fold_bn,
                 dtype=self.dtype, param_dtype=jnp.float32,
                 padding=(((k - 1) // 2, k // 2), ((k - 1) // 2, k // 2)),
                 name=name)
-        norm = partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
-        )
+        if self.fold_bn:
+            def norm(name=None, **kw):  # noqa: ARG001 — absorbed into conv
+                return lambda x: x
+        else:
+            norm = partial(
+                nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
+            )
         act = nn.relu
 
         x = x.astype(self.dtype)

@@ -460,3 +460,29 @@ def set_skip_negotiate_stage(value: bool) -> None:
     """
     _state.check_initialized()
     _state.skip_negotiate = bool(value)
+
+
+def get_skip_negotiate_stage() -> bool:
+    """Whether eager cross-rank validation is skipped (basics.py:304-306)."""
+    _state.check_initialized()
+    return _state.skip_negotiate
+
+
+def unified_mpi_window_model_supported() -> bool:
+    """Always True: the mailbox window model has one coherent store per
+    rank by construction — the property the reference probes MPI for
+    (basics.py:119-128, MPI_WIN_UNIFIED) before allowing win ops."""
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    """Always True: op dispatch is plain thread-safe Python/XLA calls, the
+    guarantee the reference asks MPI_THREAD_MULTIPLE for (basics.py
+    :129-143). (The name keeps the reference's spelling; there is no MPI.)"""
+    return True
+
+
+def nccl_built() -> bool:
+    """Always False: there is no NCCL transport — collectives ride XLA over
+    ICI/DCN (basics.py:285-292's probe, answered honestly)."""
+    return False

@@ -1,0 +1,202 @@
+"""Child program for the 4-controller harness (VERDICT r3 #4).
+
+Launched 4x (2 forced CPU devices each -> a 4-controller, size-8 job) by
+tests/test_launcher.py, either as four explicit ``-np 4`` processes or
+through one ``bfrun -H localhost:4`` fan-out. The reference CI ran its
+whole suite at np=4 (reference Makefile:1); this child packs the
+equivalent multi-controller coverage the 2-process children cannot give:
+
+  A. hosted windows at 4 owners: exact put/accumulate/update values over a
+     ring, every controller folding deposits from two distinct peers;
+  B. window-mutex contention from 4 clients: concurrent require_mutex
+     accumulates (strict mode armed) conserve mass exactly;
+  C. skewed push-sum: one deliberately slow controller, three fast ones —
+     no rate coupling, global mass + p-mass invariants after final drain;
+  D. dynamic topo-check at world=4: agreement, then 4-way divergence
+     (every controller picks a different edge set) raises everywhere;
+  E. win_fence across 4 controllers: deposits issued before the fence are
+     visible in the very next update.
+"""
+
+import os
+import time
+
+import numpy as np
+
+import jax
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import windows as win_ops
+from bluefog_tpu.runtime import control_plane
+
+os.environ["BLUEFOG_TOPO_CHECK_TIMEOUT"] = "3"
+
+N = 8  # 4 controllers x 2 devices
+
+
+def owned_rows(arr, owned):
+    rows = {}
+    for s in arr.addressable_shards:
+        rows[s.index[0].start or 0] = np.asarray(s.data)[0]
+    return {r: rows[r] for r in owned}
+
+
+def main() -> None:
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert bf.size() == N, bf.size()
+    bf.set_topology(bf.topology_util.RingGraph(N))
+    assert control_plane.active() and control_plane.world() == 4
+    cl = control_plane.client()
+    owned = [2 * pid, 2 * pid + 1]
+
+    x_np = (np.arange(N, dtype=np.float32) + 1.0).reshape(N, 1)
+    topo = bf.load_topology()
+    in_nbrs = {r: bf.topology_util.in_neighbor_ranks(topo, r)
+               for r in range(N)}
+
+    # ---- Phase A: exact hosted values with 4 owners ---------------------
+    assert bf.win_create(x_np, "q.a", zero_init=True)
+    win = win_ops._get_window("q.a")
+    assert win.hosted and win.owned == owned, (win.owned, owned)
+    bf.win_put(x_np, "q.a")
+    bf.win_accumulate(x_np, "q.a")  # put then += : mail slot holds 2*x[src]
+    bf.barrier()  # all deposits on the server before anyone drains
+    got = owned_rows(bf.win_update("q.a"), owned)
+    for r in owned:
+        u = 1.0 / (len(in_nbrs[r]) + 1)
+        want = u * (x_np[r] + sum(2.0 * x_np[s] for s in in_nbrs[r]))
+        np.testing.assert_allclose(got[r], want, rtol=1e-6)
+    print(f"PHASE_A_OK {pid}", flush=True)
+    bf.barrier()
+    bf.win_free("q.a")
+
+    # ---- Phase B: 4-client mutex contention, strict mode armed ----------
+    os.environ["BLUEFOG_WIN_STRICT"] = "1"
+    assert bf.win_create(x_np, "q.mu", zero_init=True)
+    rounds = 6
+    for _ in range(rounds):
+        bf.win_accumulate(x_np, "q.mu", require_mutex=True)
+    # fence so every controller's deposits (bump-before-deposit under the
+    # rank mutexes) are folded before the accounting read below
+    bf.win_fence("q.mu")
+    collected = owned_rows(
+        bf.win_update_then_collect("q.mu"), owned)
+    part = sum(float((collected[r] - x_np[r])[0]) for r in owned)
+    control_plane.put_float(cl, f"q.mu.part.{pid}", part)
+    bf.barrier()
+    if pid == 0:
+        total = sum(control_plane.get_float(cl, f"q.mu.part.{i}")
+                    for i in range(4))
+        # every rank accumulated x[src] to both ring out-neighbors, rounds
+        # times: total neighbor mass = rounds * 2 * sum(x)  (36 = sum 1..8)
+        want = rounds * 2 * 36.0
+        assert abs(total - want) < 1e-3, (total, want)
+        print(f"PHASE_B_MASS {total:.1f}", flush=True)
+    os.environ.pop("BLUEFOG_WIN_STRICT")
+    bf.barrier()
+    bf.win_free("q.mu")
+
+    # ---- Phase C: skewed push-sum (controller 3 is slow) ----------------
+    bf.turn_on_win_ops_with_associated_p()
+    assert bf.win_create(x_np, "q.ps", zero_init=True)
+    outd = {r: len(bf.topology_util.out_neighbor_ranks(topo, r))
+            for r in range(N)}
+    sw = {r: 1.0 / (outd[r] + 1) for r in range(N)}
+    dw = {r: {d: 1.0 / (outd[r] + 1)
+              for d in bf.topology_util.out_neighbor_ranks(topo, r)}
+          for r in range(N)}
+    est = {r: float(x_np[r, 0]) for r in owned}
+    # generous margin for loaded CI hosts: the fast controllers' 20 rounds
+    # of contended server round-trips must comfortably beat the slow one's
+    # 8 x 1.0 s floor, or the uncoupling assert below flakes
+    rounds = 8 if pid == 3 else 20
+    for _ in range(rounds):
+        if pid == 3:
+            time.sleep(1.0)  # the slow controller
+        p_all = bf.win_associated_p_all("q.ps")
+        numer = np.zeros((N, 1), np.float32)
+        for r in owned:
+            numer[r, 0] = est[r] * p_all[r]
+        bf.win_accumulate(numer, "q.ps", self_weight=sw, dst_weights=dw,
+                          require_mutex=True)
+        coll = owned_rows(bf.win_update_then_collect("q.ps"), owned)
+        p_new = bf.win_associated_p_all("q.ps")
+        for r in owned:
+            est[r] = float(coll[r][0]) / p_new[r]
+    if pid == 0:
+        assert cl.get("q.ps.done3") == 0, \
+            "fast controllers were rate-limited by the slow one"
+        print("PHASE_C_UNCOUPLED", flush=True)
+    if pid == 3:
+        cl.put("q.ps.done3", 1)
+    bf.barrier()
+    coll = owned_rows(bf.win_update_then_collect("q.ps"), owned)
+    part = sum(float(coll[r][0]) for r in owned)
+    control_plane.put_float(cl, f"q.ps.part.{pid}", part)
+    bf.barrier()
+    if pid == 0:
+        total = sum(control_plane.get_float(cl, f"q.ps.part.{i}")
+                    for i in range(4))
+        p_final = bf.win_associated_p_all("q.ps")
+        assert abs(total - 36.0) < 1e-3, f"mass not conserved: {total}"
+        assert abs(p_final.sum() - 8.0) < 1e-9, f"p mass: {p_final}"
+        print(f"PHASE_C_INVARIANT {total:.4f}", flush=True)
+    bf.barrier()
+    bf.win_free("q.ps")
+    bf.turn_off_win_ops_with_associated_p()
+
+    # ---- Phase D: topo-check at world=4 ---------------------------------
+    sh = bf.rank_sharding(bf.mesh())
+    xg = jax.make_array_from_callback(x_np.shape, sh, lambda i: x_np[i])
+    send = {r: [(r + 1) % N] for r in range(N)}
+    swt = {r: 0.5 for r in range(N)}
+    nwt = {r: {(r - 1) % N: 0.5} for r in range(N)}
+    y = bf.neighbor_allreduce(xg, self_weight=swt, neighbor_weights=nwt,
+                              send_neighbors=send, enable_topo_check=True)
+    for s in y.addressable_shards:
+        r = s.index[0].start or 0
+        np.testing.assert_allclose(
+            np.asarray(s.data)[0], 0.5 * x_np[r] + 0.5 * x_np[(r - 1) % N],
+            atol=1e-6)
+    print(f"PHASE_D_AGREED {pid}", flush=True)
+    bf.barrier()
+    # 4-way divergence: each controller picks a DIFFERENT shift
+    shift = pid + 2
+    bad_send = {r: [(r + shift) % N] for r in range(N)}
+    bad_nw = {r: {(r - shift) % N: 0.5} for r in range(N)}
+    try:
+        bf.neighbor_allreduce(xg, self_weight=swt, neighbor_weights=bad_nw,
+                              send_neighbors=bad_send, enable_topo_check=True)
+        raise AssertionError("4-way divergent edge sets were not detected")
+    except RuntimeError as e:
+        assert "DIFFERENT dynamic edge sets" in str(e), e
+    print(f"PHASE_D_DIVERGENT_RAISED {pid}", flush=True)
+    bf.barrier()
+
+    # ---- Phase E: win_fence epoch visibility ----------------------------
+    assert bf.win_create(np.zeros((N, 1), np.float32), "q.f", zero_init=True)
+    if pid == 1:
+        bf.win_put(x_np, "q.f")  # only ONE controller writes this epoch
+    assert bf.win_fence("q.f")  # collective: everyone fences
+    got = owned_rows(bf.win_update("q.f", clone=True), owned)
+    for r in owned:
+        # fence folded controller 1's deposits: slots from sources 2 and 3
+        # (ranks owned by pid 1) carry x; others are zero. The put also
+        # replaced the origin's own stored rows (post-send self scaling,
+        # sw=1), so ranks 2 and 3 combine a self term on top.
+        u = 1.0 / (len(in_nbrs[r]) + 1)
+        self_term = float(x_np[r, 0]) if r in (2, 3) else 0.0
+        want = u * (self_term + sum(
+            float(x_np[s, 0]) for s in in_nbrs[r] if s in (2, 3)))
+        np.testing.assert_allclose(got[r][0], want, rtol=1e-6)
+    print(f"PHASE_E_FENCE_OK {pid}", flush=True)
+    bf.barrier()
+    bf.win_free("q.f")
+
+    bf.shutdown()
+    print(f"CHILD_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

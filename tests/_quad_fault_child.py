@@ -1,0 +1,87 @@
+"""Child for the 1-of-4 peer-crash test (VERDICT r3 #4).
+
+Four controllers, two devices each; controller 3 hard-crashes mid-job.
+EVERY survivor (not just a designated watcher) must detect the silent
+death via its heartbeat monitor, and a doomed collective must raise the
+bounded-wait diagnosis naming the corpse instead of hanging — the
+2-process `_fault_child` property at the reference CI's np=4 scale.
+"""
+
+import os
+import time
+
+import numpy as np
+
+import jax
+
+import bluefog_tpu as bf
+
+N = 8
+
+
+def main() -> None:
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert bf.size() == N, bf.size()
+
+    x = bf.shard_rank_stacked(bf.mesh(), np.ones((N, 2), np.float32))
+    y = bf.allreduce(x)
+    jax.block_until_ready(y)
+    print(f"HEALTHY {pid}", flush=True)
+
+    if pid == 3:
+        os._exit(17)  # silent: no announce, no atexit
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if bf.dead_controllers() == {3}:
+            print(f"SURVIVOR_DETECTED {pid}", flush=True)
+            break
+        assert not bf.shutdown_requested(), \
+            "crash must be a DEAD peer, not a coordinated shutdown"
+        time.sleep(0.1)
+    else:
+        print(f"SURVIVOR_TIMEOUT {pid}", flush=True)
+        os._exit(3)
+
+    import threading
+    result = {}
+
+    def doomed():
+        try:
+            h = bf.allreduce_nonblocking(x)
+            bf.synchronize(h, timeout=5.0)
+            result["outcome"] = "completed?!"
+        except RuntimeError as e:
+            result["outcome"] = "raised"
+            result["msg"] = str(e)
+
+    t = threading.Thread(target=doomed, daemon=True)
+    t.start()
+    t.join(25.0)
+    if not (result.get("outcome") == "raised"
+            and "DEAD" in result.get("msg", "") and "[3]" in result["msg"]):
+        print(f"SURVIVOR_SYNC_BAD {pid} {result}", flush=True)
+        os._exit(4)
+    print(f"SURVIVOR_SYNC_RAISED {pid}", flush=True)
+    # Survivor rendezvous over the control plane before exiting: process 0
+    # hosts BOTH the jax coordination service and the control-plane server,
+    # and its exit makes the coordination client hard-kill any survivor
+    # still mid-check ("leader task died"). Wait until all three survivors
+    # have finished their assertions, give readers a beat, then leave —
+    # skipping graceful teardown, whose barriers would block on the corpse.
+    from bluefog_tpu.runtime import control_plane
+    cl = control_plane.client()
+    cl.put(f"qf.done.{pid}", 1)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(cl.get(f"qf.done.{i}") for i in range(3)):
+            break
+        time.sleep(0.05)
+    if pid == 0:
+        time.sleep(2.0)  # the server host leaves last
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -97,3 +97,73 @@ def test_vgg_resolution_portability_via_7x7_pool():
     # params from one resolution apply at the other
     out = model.apply(v224, jnp.zeros((1, 448, 448, 3)), train=False)
     assert out.shape == (1, 3)
+
+
+def test_fold_batchnorm_exact_inference():
+    """models.fold_batchnorm: the fold_bn=True variant with folded params
+    reproduces the eval-mode forward of the unfolded model (the torch
+    fuse_conv_bn_eval contract) without any batch_stats collection."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.models import ResNet18, fold_batchnorm
+
+    # f32 end-to-end: the check is the algebraic identity of the fold, and
+    # bf16 would hide fold mistakes inside rounding noise
+    model = ResNet18(num_classes=10, dtype=jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    variables = model.init(rng, x, train=True)
+    # make the BN statistics non-trivial (fresh init is mean 0 var 1)
+    _, upd = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    stats = upd["batch_stats"]
+    ref = model.apply(
+        {"params": variables["params"], "batch_stats": stats},
+        x, train=False)
+
+    folded = fold_batchnorm(variables["params"], stats)
+    fmodel = ResNet18(num_classes=10, dtype=jnp.float32, fold_bn=True)
+    got = fmodel.apply({"params": folded}, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    # no BN params survive the fold; every conv gained a bias
+    flat = jax.tree_util.tree_leaves_with_path(folded)
+    names = {"/".join(str(k.key) for k in path) for path, _ in flat}
+    assert not any("BatchNorm" in n or "bn_init" in n or "norm_proj" in n
+                   for n in names), names
+    assert any(n.endswith("Conv_0/bias") for n in names)
+    # training with the folded variant is rejected
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="inference-only"):
+        fmodel.apply({"params": folded}, x, train=True)
+
+
+def test_fold_batchnorm_bottleneck_resnet50():
+    """Same identity on the BottleneckBlock path (ResNet50): pins the
+    BatchNorm_2->Conv_2 and bottleneck conv_proj/norm_proj pairing that
+    the PERF.md / fold.py ResNet50 usage depends on, and the stats-
+    mismatch guard."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest as _pytest
+    from bluefog_tpu.models import fold_batchnorm
+    from bluefog_tpu.models.resnet import ResNet50
+
+    model = ResNet50(num_classes=4, num_filters=8, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    _, upd = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    stats = upd["batch_stats"]
+    ref = model.apply(
+        {"params": variables["params"], "batch_stats": stats},
+        x, train=False)
+    folded = fold_batchnorm(variables["params"], stats)
+    fmodel = ResNet50(num_classes=4, num_filters=8, dtype=jnp.float32,
+                      fold_bn=True)
+    got = fmodel.apply({"params": folded}, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+    # mismatched stats raise at fold time, not as a flax apply error later
+    with _pytest.raises(ValueError, match="no matching batch_stats"):
+        fold_batchnorm(variables["params"], {})
