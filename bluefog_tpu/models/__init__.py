@@ -12,7 +12,7 @@ with float32 parameters/batch-stats, channel counts that are multiples of
 from .mlp import MLP, LeNet5
 from .fold import fold_batchnorm
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
-from .transformer import TransformerLM, apply_rope
+from .transformer import MoEBlock, MoETransformerLM, TransformerLM, apply_rope
 from .vgg import VGG, VGG11, VGG16, VGG19
 
 __all__ = [
@@ -24,6 +24,8 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "fold_batchnorm",
+    "MoEBlock",
+    "MoETransformerLM",
     "TransformerLM",
     "apply_rope",
     "VGG",

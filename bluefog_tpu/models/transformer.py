@@ -38,6 +38,25 @@ def apply_rope(x, positions, base: float = 10000.0):
     return out.astype(x.dtype)
 
 
+def _attention_sublayer(num_heads, dtype, attn_fn, dense, x, positions):
+    """Pre-norm attention residual shared by Block and MoEBlock — one
+    source of truth for the qkv/rope/attn/out sequence (submodules created
+    here attach to the CALLING module's scope with the same auto/explicit
+    names both block types had, so param trees are unchanged)."""
+    d_model = x.shape[-1]
+    head_dim = d_model // num_heads
+    h = nn.RMSNorm(dtype=dtype, param_dtype=jnp.float32)(x)
+    qkv = dense(3 * d_model, name="qkv")(h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = q.shape[:2] + (num_heads, head_dim)
+    q, k, v = (t.reshape(shape) for t in (q, k, v))
+    q = apply_rope(q, positions)
+    k = apply_rope(k, positions)
+    a = attn_fn(q, k, v)
+    a = a.reshape(a.shape[:2] + (d_model,))
+    return x + dense(d_model, name="out")(a)
+
+
 class Block(nn.Module):
     num_heads: int
     d_ff: int
@@ -47,19 +66,10 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions):
         d_model = x.shape[-1]
-        head_dim = d_model // self.num_heads
         dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
                         use_bias=False)
-        h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
-        qkv = dense(3 * d_model, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = q.shape[:2] + (self.num_heads, head_dim)
-        q, k, v = (t.reshape(shape) for t in (q, k, v))
-        q = apply_rope(q, positions)
-        k = apply_rope(k, positions)
-        a = self.attn_fn(q, k, v)
-        a = a.reshape(a.shape[:2] + (d_model,))
-        x = x + dense(d_model, name="out")(a)
+        x = _attention_sublayer(self.num_heads, self.dtype, self.attn_fn,
+                                dense, x, positions)
         h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
         h = dense(self.d_ff, name="up")(h)
         h = nn.gelu(h)
@@ -67,8 +77,48 @@ class Block(nn.Module):
         return x
 
 
+class MoEBlock(nn.Module):
+    """Transformer block whose FFN is a top-1 Switch mixture of experts.
+
+    Attention is identical to :class:`Block`; the dense up/gelu/down FFN is
+    replaced by :class:`bluefog_tpu.parallel.SwitchFFN`. With
+    ``expert_axis`` set the block must run inside a ``shard_map`` over that
+    mesh axis (one expert per device, ``ep_lm_loss_fn``); with ``None`` it
+    is the dense oracle that runs anywhere.
+    """
+
+    num_heads: int
+    d_ff: int
+    num_experts: int
+    dtype: Any
+    attn_fn: Callable
+    expert_axis: Optional[str] = None
+    capacity_factor: float = 2.0
+
+    @nn.compact
+    def __call__(self, x, positions):
+        from ..parallel.expert import SwitchFFN
+
+        dense = partial(nn.Dense, dtype=self.dtype, param_dtype=jnp.float32,
+                        use_bias=False)
+        x = _attention_sublayer(self.num_heads, self.dtype, self.attn_fn,
+                                dense, x, positions)
+        h = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = x + SwitchFFN(
+            num_experts=self.num_experts, d_ff=self.d_ff, dtype=self.dtype,
+            expert_axis=self.expert_axis,
+            capacity_factor=self.capacity_factor, name="moe")(h)
+        return x
+
+
 class TransformerLM(nn.Module):
-    """Causal LM. ``attn_fn(q, k, v) -> out`` defaults to dense attention."""
+    """Causal LM. ``attn_fn(q, k, v) -> out`` defaults to dense attention.
+
+    ``num_experts > 0`` turns every ``moe_every``-th block into a
+    :class:`MoEBlock` (Switch MoE FFN) — the sparse-expert LM family the
+    dense zoo lacked. ``expert_axis`` selects the sparse expert-parallel
+    execution mode (see :class:`MoEBlock`).
+    """
 
     vocab_size: int
     num_layers: int = 2
@@ -77,6 +127,10 @@ class TransformerLM(nn.Module):
     d_ff: int = 512
     dtype: Any = jnp.float32
     attn_fn: Optional[Callable] = None
+    num_experts: int = 0
+    moe_every: int = 2
+    expert_axis: Optional[str] = None
+    capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, tokens, positions=None):
@@ -86,11 +140,25 @@ class TransformerLM(nn.Module):
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                      param_dtype=jnp.float32, name="embed")(tokens)
         for i in range(self.num_layers):
-            x = Block(self.num_heads, self.d_ff, self.dtype, attn,
-                      name=f"block_{i}")(x, positions)
+            if self.num_experts and (i + 1) % self.moe_every == 0:
+                x = MoEBlock(self.num_heads, self.d_ff, self.num_experts,
+                             self.dtype, attn,
+                             expert_axis=self.expert_axis,
+                             capacity_factor=self.capacity_factor,
+                             name=f"block_{i}")(x, positions)
+            else:
+                x = Block(self.num_heads, self.d_ff, self.dtype, attn,
+                          name=f"block_{i}")(x, positions)
         x = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32,
                        name="final_norm")(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype,
                           param_dtype=jnp.float32, use_bias=False,
                           name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+def MoETransformerLM(vocab_size: int, num_experts: int, **kw):
+    """Convenience constructor: a TransformerLM with Switch-MoE FFN blocks
+    (Fedus et al. 2021). See :class:`TransformerLM` for the knobs."""
+    return TransformerLM(vocab_size=vocab_size, num_experts=num_experts,
+                         **kw)

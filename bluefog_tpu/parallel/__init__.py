@@ -30,9 +30,14 @@ from .context import (
 from .expert import (
     SwitchFFN,
     ep_apply,
+    ep_lm_apply,
+    ep_lm_init,
+    ep_lm_loss_fn,
     ep_mesh,
     ep_place_params,
     load_balance_loss,
+    moe_param_specs,
+    switch_dispatch,
 )
 from .flash import flash_attention, flash_block
 from .lm import cp_apply, cp_loss_fn
@@ -80,7 +85,12 @@ __all__ = [
     "pp_train_step_fn",
     "SwitchFFN",
     "ep_apply",
+    "ep_lm_apply",
+    "ep_lm_init",
+    "ep_lm_loss_fn",
     "ep_place_params",
     "ep_mesh",
     "load_balance_loss",
+    "moe_param_specs",
+    "switch_dispatch",
 ]

@@ -103,6 +103,34 @@ def main() -> None:
     assert losses[-1] < 0.5 * losses[0], "MoE training failed to converge"
     print("MOE OK")
 
+    # ---- part 2: the full MoE transformer LM (models.MoETransformerLM) --
+    # Switch-FFN blocks INSIDE the LM, expert-sharded up/down weights,
+    # next-token loss differentiated straight through the shard_map.
+    from bluefog_tpu.models import MoETransformerLM
+
+    lm = MoETransformerLM(
+        vocab_size=64, num_experts=E, num_layers=2, num_heads=2,
+        d_model=32, d_ff=d_ff, expert_axis="expert")
+    rng = jax.random.PRNGKey(7)
+    toks = jax.random.randint(rng, (E, 16), 0, 64)
+    batch = (toks, jnp.roll(toks, -1, axis=1))
+    lm_params = bfp.ep_lm_init(lm, jax.random.PRNGKey(8), toks)
+    lm_loss = bfp.ep_lm_loss_fn(lm, mesh, aux_weight=args.aux_weight)
+    lm_opt = optax.adam(3e-3)
+    lm_state = lm_opt.init(lm_params)
+    lm_grad = jax.jit(jax.value_and_grad(lm_loss))
+    lm_losses = []
+    for step in range(args.steps):
+        loss, grads = lm_grad(lm_params, batch)
+        updates, lm_state = lm_opt.update(grads, lm_state, lm_params)
+        lm_params = optax.apply_updates(lm_params, updates)
+        lm_losses.append(float(loss))
+        if step % 20 == 0:
+            print(f"lm step {step:3d}  loss {lm_losses[-1]:.4f}")
+    print(f"lm final loss: {lm_losses[-1]:.4f} (from {lm_losses[0]:.4f})")
+    assert lm_losses[-1] < 0.7 * lm_losses[0], "MoE LM failed to converge"
+    print("MOE_LM OK")
+
 
 if __name__ == "__main__":
     main()

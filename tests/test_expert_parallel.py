@@ -6,6 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from bluefog_tpu import parallel as bfp
 from bluefog_tpu.parallel import expert as ep
 
 from conftest import cpu_devices
@@ -110,3 +111,66 @@ def test_ep_training_converges():
         params = optax.apply_updates(params, updates)
         losses.append(float(loss))
     assert losses[-1] < 0.5 * losses[0], losses[::10]
+
+
+def test_moe_lm_ep_apply_matches_dense_oracle():
+    """The expert-parallel MoE TransformerLM (shard_map over the expert
+    axis, all_to_all dispatch inside every MoE block) computes exactly the
+    dense oracle's forward when capacity guarantees no token drops."""
+    import dataclasses
+
+    from bluefog_tpu.models import MoETransformerLM
+
+    E = 8
+    mesh = bfp.ep_mesh(E, cpu_devices(E))
+    model = MoETransformerLM(
+        vocab_size=64, num_experts=E, num_layers=2, num_heads=2,
+        d_model=32, d_ff=64, moe_every=2, expert_axis="expert",
+        capacity_factor=float(E))  # no drops -> exact parity
+    toks = jax.random.randint(jax.random.PRNGKey(3), (E, 12), 0, 64)
+    params = bfp.ep_lm_init(model, jax.random.PRNGKey(0), toks)
+    dense = dataclasses.replace(model, expert_axis=None)
+    want = dense.apply({"params": params}, toks)
+    got, aux = bfp.ep_lm_apply(model, params, toks, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    assert np.isfinite(float(aux)) and float(aux) > 0.0
+
+
+def test_moe_lm_ep_training_converges():
+    """jax.grad through the shard_mapped MoE loss: expert-sharded up/down
+    grads + replicated dense grads drive a real training loop downhill."""
+    import optax
+
+    from bluefog_tpu.models import MoETransformerLM
+
+    E = 4
+    mesh = bfp.ep_mesh(E, cpu_devices(4))
+    model = MoETransformerLM(
+        vocab_size=32, num_experts=E, num_layers=2, num_heads=2,
+        d_model=32, d_ff=64, moe_every=2, expert_axis="expert",
+        capacity_factor=float(E))
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 32, (4, 16)))
+    batch = (toks, jnp.roll(toks, -1, axis=1))
+    params = bfp.ep_lm_init(model, jax.random.PRNGKey(0), toks)
+    loss_fn = bfp.ep_lm_loss_fn(model, mesh)
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(loss_fn)(p, batch)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < 0.6 * losses[0], losses[::10]
+    # the expert grads really were per-expert: up/down shards differ
+    up = np.asarray(
+        params["block_1"]["moe"]["up"])
+    assert up.shape[0] == E
+    assert not np.allclose(up[0], up[1])
