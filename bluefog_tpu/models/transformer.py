@@ -132,28 +132,42 @@ class TransformerLM(nn.Module):
     expert_axis: Optional[str] = None
     capacity_factor: float = 2.0
 
-    @nn.compact
-    def __call__(self, tokens, positions=None):
+    def setup(self):
+        # setup (not compact) so ``hidden`` is separately applyable
+        # (``model.apply(vars, toks, method="hidden")`` — the chunked-CE
+        # training path projects to vocab per sequence chunk instead of
+        # materializing [S, V] logits). setattr keeps the original
+        # per-index submodule names, so param trees are unchanged.
         attn = self.attn_fn or partial(reference_attention, causal=True)
-        if positions is None:
-            positions = jnp.arange(tokens.shape[1])
-        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="embed")(tokens)
+        setattr(self, "embed", nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.dtype,
+            param_dtype=jnp.float32))
         for i in range(self.num_layers):
             if self.num_experts and (i + 1) % self.moe_every == 0:
-                x = MoEBlock(self.num_heads, self.d_ff, self.num_experts,
-                             self.dtype, attn,
-                             expert_axis=self.expert_axis,
-                             capacity_factor=self.capacity_factor,
-                             name=f"block_{i}")(x, positions)
+                blk = MoEBlock(self.num_heads, self.d_ff, self.num_experts,
+                               self.dtype, attn,
+                               expert_axis=self.expert_axis,
+                               capacity_factor=self.capacity_factor)
             else:
-                x = Block(self.num_heads, self.d_ff, self.dtype, attn,
-                          name=f"block_{i}")(x, positions)
-        x = nn.RMSNorm(dtype=self.dtype, param_dtype=jnp.float32,
-                       name="final_norm")(x)
-        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
-                          param_dtype=jnp.float32, use_bias=False,
-                          name="lm_head")(x)
+                blk = Block(self.num_heads, self.d_ff, self.dtype, attn)
+            setattr(self, f"block_{i}", blk)
+        setattr(self, "final_norm", nn.RMSNorm(
+            dtype=self.dtype, param_dtype=jnp.float32))
+        setattr(self, "lm_head", nn.Dense(
+            self.vocab_size, dtype=self.dtype, param_dtype=jnp.float32,
+            use_bias=False))
+
+    def hidden(self, tokens, positions=None):
+        """Backbone output [B, S, d_model] BEFORE the vocab projection."""
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        x = self.embed(tokens)
+        for i in range(self.num_layers):
+            x = getattr(self, f"block_{i}")(x, positions)
+        return self.final_norm(x)
+
+    def __call__(self, tokens, positions=None):
+        logits = self.lm_head(self.hidden(tokens, positions))
         return logits.astype(jnp.float32)
 
 

@@ -40,7 +40,7 @@ from .expert import (
     switch_dispatch,
 )
 from .flash import flash_attention, flash_block
-from .lm import cp_apply, cp_loss_fn
+from .lm import chunked_ce_loss, cp_apply, cp_loss_fn
 from .pipeline import (
     pp_apply,
     pp_forward_fn,
@@ -80,6 +80,7 @@ __all__ = [
     "pp_place_params",
     "pp_mesh",
     "pp_stack_params",
+    "chunked_ce_loss",
     "pp_loss_fn",
     "pp_train_init",
     "pp_train_step_fn",

@@ -71,6 +71,49 @@ def _cp_apply_fn(model, mesh: Mesh, axis: str, kind: str):
     return jax.jit(mapped)
 
 
+def chunked_ce_loss(model, params, tokens, targets, chunk: int = 1024,
+                    remat_backbone: bool = False):
+    """Next-token cross-entropy WITHOUT materializing the [S, V] logits.
+
+    At long S the logits tensor dominates HBM traffic: S=8192 x V=32768
+    f32 is 1 GB written by the forward, read by the softmax, and touched
+    twice more in the backward. This computes the backbone hidden states
+    once, then projects to the vocabulary one sequence chunk at a time
+    under ``jax.checkpoint`` inside a sequential ``lax.map`` — the
+    backward recomputes each chunk's [chunk, V] logits instead of reading
+    stored ones, so peak logits memory falls from [S, V] to [chunk, V].
+    Numerics are exact (a mean over disjoint chunk sums; matmul dtype is
+    the model's, softmax in f32 — identical to the full-logits path).
+    """
+    def backbone(p, toks):
+        return model.apply({"params": p}, toks, method="hidden")
+
+    if remat_backbone:
+        backbone = jax.checkpoint(backbone)
+    h = backbone(params, tokens)
+    # hoist the [d, V] kernel cast out of the chunk loop: inside the map
+    # body it would re-materialize per iteration (and per checkpointed
+    # backward recompute) — wasted HBM traffic on exactly the
+    # long-context path this function exists for
+    W = params["lm_head"]["kernel"].astype(h.dtype)
+    b, s, d = h.shape
+    t = b * s
+    if t % chunk:
+        raise ValueError(
+            f"CE chunk {chunk} must divide the token count {t}")
+    hc = h.reshape(t // chunk, chunk, d)
+    tc = targets.reshape(t // chunk, chunk)
+
+    def chunk_nll(args):
+        h_c, t_c = args
+        logits = (h_c @ W).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.take_along_axis(logp, t_c[..., None], axis=-1))
+
+    totals = lax.map(jax.checkpoint(chunk_nll), (hc, tc))
+    return totals.sum() / t
+
+
 def cp_loss_fn(model, mesh: Optional[Mesh] = None, axis: str = "rank",
                kind: str = "ring"):
     """``loss_fn(params, (tokens, targets)) -> loss`` with CP attention.

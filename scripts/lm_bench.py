@@ -50,7 +50,8 @@ def matmul_param_count(params) -> int:
 
 
 def run(seq_len: int, d_model: int, num_layers: int, num_heads: int,
-        batch: int, vocab: int, steps: int, warmup: int, remat: bool):
+        batch: int, vocab: int, steps: int, warmup: int, remat: bool,
+        chunked_ce: bool = False, ce_chunk: int = 1024):
     model = TransformerLM(
         vocab_size=vocab, num_layers=num_layers, num_heads=num_heads,
         d_model=d_model, d_ff=4 * d_model, dtype=jnp.bfloat16,
@@ -65,6 +66,12 @@ def run(seq_len: int, d_model: int, num_layers: int, num_heads: int,
 
     def loss_fn(p, batch_):
         toks, tgts = batch_
+        if chunked_ce:
+            # exact CE without materializing the [S, V] logits (1 GB at
+            # the headline config) — see parallel.chunked_ce_loss
+            from bluefog_tpu.parallel import chunked_ce_loss
+            return chunked_ce_loss(model, p, toks, tgts, chunk=ce_chunk,
+                                   remat_backbone=remat)
         apply = model.apply
         if remat:
             apply = jax.checkpoint(model.apply)
@@ -122,9 +129,12 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--remat", action="store_true",
                    help="checkpoint the whole forward (longer S fits)")
+    p.add_argument("--chunked-ce", action="store_true",
+                   help="chunked vocab projection + CE (no [S, V] logits)")
+    p.add_argument("--ce-chunk", type=int, default=1024)
     a = p.parse_args()
     run(a.seq_len, a.d_model, a.num_layers, a.num_heads, a.batch, a.vocab,
-        a.steps, a.warmup, a.remat)
+        a.steps, a.warmup, a.remat, a.chunked_ce, a.ce_chunk)
 
 
 if __name__ == "__main__":

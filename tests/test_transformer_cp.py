@@ -81,3 +81,37 @@ def test_cp_training_step_decreases_loss(bf8):
         params, opt_state, l = step(params, opt_state, (tokens, targets))
         losses.append(float(l))
     assert losses[-1] < losses[0]
+
+
+def test_chunked_ce_loss_exact_and_grad_matches():
+    """parallel.chunked_ce_loss computes the same loss AND gradients as
+    the full-logits cross-entropy (it's a re-association of the same
+    sums), while never materializing [S, V] logits."""
+    import optax
+
+    from bluefog_tpu import parallel as bfp
+    from bluefog_tpu.models import TransformerLM
+
+    model = TransformerLM(vocab_size=64, num_layers=2, num_heads=2,
+                          d_model=32, d_ff=64)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 64)
+    tgts = jnp.roll(toks, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(1), toks)["params"]
+
+    def full_loss(p):
+        logits = model.apply({"params": p}, toks)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgts).mean()
+
+    def chunked(p):
+        return bfp.chunked_ce_loss(model, p, toks, tgts, chunk=16)
+
+    lf, gf = jax.value_and_grad(full_loss)(params)
+    lc, gc = jax.value_and_grad(chunked)(params)
+    np.testing.assert_allclose(float(lf), float(lc), rtol=1e-6)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(gf),
+            jax.tree_util.tree_leaves_with_path(gc)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-4,
+                                   err_msg=jax.tree_util.keystr(pa))
