@@ -32,8 +32,59 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import List, Tuple
+
+# Remote shells print this after turning pty echo off; the launcher holds the
+# job secret until it arrives (see the ssh fan-out below).
+_SECRET_READY = "BF_SECRET_READY"
+
+
+def _send_secret_when_ready(p: "subprocess.Popen", secret: str,
+                            host: str) -> None:
+    """Write the job secret to ssh stdin only after the remote's
+    ``stty -echo`` has run, then pump the rest of its output through.
+
+    The pty allocated by ``ssh -tt`` starts with ECHO on; a secret written
+    at Popen time races the remote ``stty -echo`` and can be echoed into
+    this process's output. The remote prints ``BF_SECRET_READY`` *after*
+    echo is off, so waiting for that marker closes the race.
+    """
+    buf = b""
+    marker = _SECRET_READY.encode()
+    try:
+        while marker not in buf:
+            chunk = p.stdout.read(1)
+            if not chunk:  # ssh died before the marker — nothing to send
+                sys.stdout.buffer.write(buf)
+                sys.stdout.buffer.flush()
+                return
+            buf += chunk
+        p.stdin.write((secret + "\n").encode())
+        p.stdin.flush()
+        # forward everything after the marker line to our stdout; if OUR
+        # stdout goes away (e.g. `bfrun ... | head`), keep DRAINING the ssh
+        # pipe — stopping would fill it and wedge the remote job
+        sink_broken = False
+
+        def forward(chunk: bytes) -> None:
+            nonlocal sink_broken
+            if sink_broken:
+                return
+            try:
+                sys.stdout.buffer.write(chunk)
+                sys.stdout.buffer.flush()
+            except (OSError, ValueError):
+                sink_broken = True
+
+        rest = buf.split(marker, 1)[1].lstrip(b"\r\n")
+        if rest:
+            forward(rest)
+        for chunk in iter(lambda: p.stdout.read(4096), b""):
+            forward(chunk)
+    except (OSError, ValueError):
+        pass  # ssh pipe broke at teardown — the exit-code path reports it
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -222,8 +273,14 @@ def _fanout(args) -> int:
                         and k != "BLUEFOG_CP_SECRET")
                     secret = os.environ.get("BLUEFOG_CP_SECRET", "")
                     # '&&' so a missing remote workdir fails loudly instead
-                    # of becoming an opaque ModuleNotFoundError later
+                    # of becoming an opaque ModuleNotFoundError later.
+                    # The ready marker closes a race: until the remote stty
+                    # runs, the pty's ECHO flag is still on, so a secret
+                    # written at Popen time could be echoed back into the
+                    # launcher's captured output. Write it only after the
+                    # remote confirms echo is off.
                     remote = ("stty -echo 2>/dev/null; "
+                              f"printf '{_SECRET_READY}\\n'; "
                               "IFS= read -r BLUEFOG_CP_SECRET; "
                               "export BLUEFOG_CP_SECRET; "
                               f"cd {shlex.quote(os.getcwd())} && "
@@ -235,9 +292,10 @@ def _fanout(args) -> int:
                     p = subprocess.Popen(
                         ["ssh", "-tt", "-o", "BatchMode=yes",
                          "-p", str(args.ssh_port), host, remote],
-                        stdin=subprocess.PIPE)
-                    p.stdin.write((secret + "\n").encode())
-                    p.stdin.flush()
+                        stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+                    threading.Thread(
+                        target=_send_secret_when_ready,
+                        args=(p, secret, host), daemon=True).start()
                     procs.append(p)
                 pid += 1
 

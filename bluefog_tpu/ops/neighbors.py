@@ -216,12 +216,18 @@ def cross_controller_topo_check(W: Optional[np.ndarray],
     matrices that were each individually agreed in the past and would both
     cache-hit forever (VERDICT r3 weak #4). Closed by a periodic re-arm:
     every ``BLUEFOG_TOPO_CHECK_REARM`` (default 50, 0 disables) topo-checked
-    calls, the rendezvous runs again with the CALL INDEX folded into the
-    key. In-step controllers agree on (index, hash) and pay one pipelined
-    round-trip per K steps; de-synced ones hold different hashes at the
-    same index, wait on keys nobody else touches, and the bounded wait
-    raises — the reference's per-step CheckNeighborSendRecvPattern
-    guarantee at 1/K amortized cost.
+    calls, the rendezvous runs again. Re-arm rounds pair up by a
+    server-side ticket counter (``round = fetch_add // world``), NOT the
+    local call count, so agreement never assumes identical call counts
+    across controllers; check-ins reuse ONE fixed key per controller with
+    (round, hash-prefix) packed into the value, so re-arms add zero keys
+    over the job's lifetime. In-step controllers meet at the same round
+    with the same hash and pay one pipelined round-trip per K steps;
+    de-synced ones collide at the same round with different hashes and
+    raise — the reference's per-step CheckNeighborSendRecvPattern
+    guarantee at 1/K amortized cost. ``BLUEFOG_TOPO_CHECK_REARM`` must be
+    set identically on every controller (a mismatch skews the ticket
+    counter and surfaces as a rendezvous timeout, not silent corruption).
     """
     from ..runtime import control_plane as _cp
 
@@ -232,37 +238,91 @@ def cross_controller_topo_check(W: Optional[np.ndarray],
     st._topo_check_calls += 1
     rearm_every = int(os.environ.get("BLUEFOG_TOPO_CHECK_REARM", "50"))
     rearm = rearm_every > 0 and st._topo_check_calls % rearm_every == 0
-    if h in st._topo_check_agreed and not rearm:
-        return
+    timeout = float(os.environ.get("BLUEFOG_TOPO_CHECK_TIMEOUT", "30"))
+    if h not in st._topo_check_agreed:
+        cl = _cp.client()
+        world = _cp.world()
+        # First-time agreement on a NEW matrix: idempotent per-controller
+        # check-in (one key per controller, not a shared counter), so a
+        # controller retrying after a failed rendezvous cannot inflate the
+        # count into false agreement. One key set per DISTINCT matrix —
+        # bounded by the schedule's period, not the step count. Key
+        # lifetime == the control-plane server == the job (the launcher's
+        # process 0 serves in-process); an externally shared long-lived
+        # server must be restarted between jobs.
+        tag = f"tc.{h}"
+        cl.put(f"{tag}.{st.process_index}", 1)
+        keys = [f"{tag}.{p}" for p in range(world)]
+        deadline = time.monotonic() + timeout
+        while True:
+            agreed = sum(1 for v in cl.get_many(keys) if v)
+            if agreed >= world:
+                st._topo_check_agreed.add(h)
+                break
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"cross-controller topology check failed: controller "
+                    f"{st.process_index} computed combine-matrix hash {h} "
+                    f"but only {agreed}/{world} controllers agreed within "
+                    f"{timeout:.0f}s — controllers are dispatching "
+                    "DIFFERENT dynamic edge sets (check the per-step "
+                    "send_neighbors/neighbor_weights derivation, or set "
+                    "enable_topo_check=False to skip)")
+            time.sleep(0.02)
+    if rearm:
+        _rearm_rendezvous(h, timeout)
+
+
+_H40_MASK = (1 << 40) - 1
+
+
+def _rearm_rendezvous(h: str, timeout: float) -> None:
+    """Periodic re-agreement that catches phase-shifted cyclic schedules.
+
+    Every controller posts (round+1, 40-bit hash prefix) packed into its own
+    fixed key ``tc.rearm.<rank>`` (the +1 keeps 0 = "never checked in") and
+    waits until every peer's value is either the same round with the SAME
+    hash, or a LATER round (a peer can only advance past round r after
+    everyone — including us — checked in at r with a matching hash). Same
+    round + different hash = controllers dispatching different steps of the
+    schedule: raise. The round number comes from a shared fetch_add ticket
+    (``ticket // world``), so pairing is by global arrival order and never
+    assumes controllers counted the same number of local topo-check calls.
+    """
+    from ..runtime import control_plane as _cp
+
+    st = _global_state()
     cl = _cp.client()
     world = _cp.world()
-    # Idempotent per-controller check-in (one key per controller, not a
-    # shared counter): a controller retrying after a failed rendezvous
-    # cannot inflate the count into false agreement. Key lifetime == the
-    # control-plane server == the job (the launcher's process 0 serves
-    # in-process), so no cross-job staleness in the standard deployment;
-    # an externally shared long-lived server must be restarted between jobs.
-    tag = f"tc.{st._topo_check_calls}.{h}" if rearm else f"tc.{h}"
-    cl.put(f"{tag}.{st.process_index}", 1)
-    keys = [f"{tag}.{p}" for p in range(world)]
-    timeout = float(os.environ.get("BLUEFOG_TOPO_CHECK_TIMEOUT", "30"))
+    rnd = cl.fetch_add("tc.rearm.tickets", 1) // world
+    h40 = int(h[:10], 16) & _H40_MASK
+    cl.put(f"tc.rearm.{st.process_index}", ((rnd + 1) << 40) | h40)
+    keys = [f"tc.rearm.{p}" for p in range(world)]
     deadline = time.monotonic() + timeout
     while True:
-        agreed = sum(1 for v in cl.get_many(keys) if v)
+        agreed = 0
+        for p, v in zip(range(world), cl.get_many(keys)):
+            peer_rnd, peer_h40 = (v >> 40) - 1, v & _H40_MASK
+            if v and peer_rnd == rnd and peer_h40 != h40:
+                raise RuntimeError(
+                    f"cross-controller topology re-check failed: at re-arm "
+                    f"round {rnd} controller {st.process_index} holds "
+                    f"combine-matrix hash {h} but controller {p} checked in "
+                    "a DIFFERENT matrix — controllers are de-synced inside "
+                    "the dynamic schedule (phase-shifted cyclic edge sets), "
+                    "or BLUEFOG_TOPO_CHECK_REARM differs across controllers")
+            if v and peer_rnd >= rnd:
+                agreed += 1
         if agreed >= world:
-            st._topo_check_agreed.add(h)
             return
         if time.monotonic() >= deadline:
-            break
+            raise RuntimeError(
+                f"cross-controller topology re-check failed: controller "
+                f"{st.process_index} waited {timeout:.0f}s at re-arm round "
+                f"{rnd} (hash {h}) with only {agreed}/{world} controllers "
+                "checked in — a peer is stalled, crashed, or running with a "
+                "different BLUEFOG_TOPO_CHECK_REARM cadence")
         time.sleep(0.02)
-    raise RuntimeError(
-        f"cross-controller topology check failed: controller "
-        f"{st.process_index} computed combine-matrix hash {h} at "
-        f"topo-check call {st._topo_check_calls} but only {agreed}/{world} "
-        f"controllers agreed within {timeout:.0f}s — controllers are "
-        "dispatching DIFFERENT dynamic edge sets (check the per-step "
-        "send_neighbors/neighbor_weights derivation, or set "
-        "enable_topo_check=False to skip)")
 
 
 # ---------------------------------------------------------------------------
@@ -333,7 +393,12 @@ def neighbor_allreduce_nonblocking(
                 )
                 plan = CombinePlan(W)
             if len(st._plan_cache) > 4096:  # unbounded schedules: keep sane
-                st._plan_cache.clear()
+                # Evict only the dynamic-schedule entries: static plans (and
+                # their jit-traced CombinePlans) are few, hot, and expensive
+                # to rebuild — churning them because a dynamic schedule
+                # overflowed the cache re-pays unrelated compilations.
+                for k in [k for k in st._plan_cache if k[0] == "dyn_nar"]:
+                    del st._plan_cache[k]
             st._plan_cache[key] = (plan, _w_hash(W))
         else:
             plan, h = cached

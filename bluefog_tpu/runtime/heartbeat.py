@@ -88,9 +88,20 @@ class PeerMonitor:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2.0)
+            if thread.is_alive():
+                # The tick is wedged in a control-plane call (hung server —
+                # the very scenario this monitor exists to detect). Closing
+                # the native client now would free the C++ ControlClient out
+                # from under the thread; leave the daemon thread's connection
+                # to be reclaimed at process exit instead.
+                logger.warning(
+                    "heartbeat thread did not exit within 2 s (control plane "
+                    "unresponsive?); leaving its connection open")
+                self._cl = None
+                return
         if self._cl is not None:
             self._cl.close()
             self._cl = None

@@ -81,6 +81,17 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int,
     ]
+    lib.bf_cp_bytes_multi_out.restype = ctypes.c_int64
+    lib.bf_cp_bytes_multi_out.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+    ]
+    lib.bf_cp_bytes_multi_in.restype = ctypes.c_int64
+    lib.bf_cp_bytes_multi_in.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+    ]
     lib.bf_cp_disconnect.restype = None
     lib.bf_cp_disconnect.argtypes = [ctypes.c_void_p]
     return lib
@@ -106,6 +117,20 @@ def load() -> Optional[ctypes.CDLL]:
                 return None
         try:
             _lib = _configure(ctypes.CDLL(_SO))
+        except AttributeError:
+            # A stale cached build predates a symbol _configure now needs
+            # (the .so is gitignored; load() only builds when it's missing).
+            # Rebuild once from the current sources and retry.
+            logger.info("native runtime is stale (missing symbol); "
+                        "rebuilding from csrc")
+            try:
+                subprocess.run(["sh", os.path.join(_CSRC, "build.sh")],
+                               check=True, capture_output=True, timeout=120)
+                _lib = _configure(ctypes.CDLL(_SO))
+            except (subprocess.SubprocessError, OSError,
+                    AttributeError) as exc:
+                logger.info("native runtime rebuild failed (%s)", exc)
+                _lib = None
         except OSError as exc:
             logger.info("native runtime load failed (%s)", exc)
             _lib = None
@@ -287,6 +312,90 @@ class ControlPlaneClient:
             records.append(payload[off:off + rl])
             off += rl
         return records
+
+    # op codes for the pipelined bytes batches (csrc/bf_runtime.cc enum Op)
+    _OP_APPEND_BYTES = 8
+    _OP_TAKE_BYTES = 9
+    _OP_PUT_BYTES = 10
+    _OP_GET_BYTES = 11
+
+    def _bytes_multi_out(self, op: int, names, blobs) -> list:
+        names = list(names)
+        blobs = list(blobs)  # may be a generator; it's iterated twice below
+        if not names:
+            return []
+        n = len(names)
+        payload = b"".join(blobs)
+        for what, b in zip(names, blobs):
+            self._check_payload(f"bytes batch '{what}'", b)
+        lens = (ctypes.c_int64 * n)(*[len(b) for b in blobs])
+        out = (ctypes.c_int64 * n)()
+        if self._lib.bf_cp_bytes_multi_out(
+                self._h, op, "\n".join(names).encode(), payload, lens,
+                out, n) < 0:
+            raise OSError("control plane bytes batch failed (connection "
+                          "lost or not authenticated)")
+        return list(out)
+
+    def _bytes_multi_in(self, op: int, names) -> list:
+        names = list(names)
+        if not names:
+            return []
+        n = len(names)
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        if self._lib.bf_cp_bytes_multi_in(
+                self._h, op, "\n".join(names).encode(), n,
+                ctypes.byref(out), ctypes.byref(out_len)) < 0:
+            raise OSError("control plane bytes batch failed (connection "
+                          "lost or not authenticated)")
+        try:
+            payload = ctypes.string_at(out.value, out_len.value) \
+                if out_len.value else b""
+        finally:
+            self._lib.bf_cp_free(out)
+        blobs = []
+        off = 0
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<Q", payload, off)
+            off += 8
+            blobs.append(payload[off:off + ln])
+            off += ln
+        return blobs
+
+    def append_bytes_many(self, names, blobs) -> list:
+        """Pipelined multi-append: n deposit records, one round-trip's
+        latency (the hosted window data plane's wire discipline — the
+        analog of the reference's chunked MPI_Put stream,
+        mpi_controller.cc:932-1034). Returns per-record post-append counts;
+        -2 entries mean that mailbox hit the server byte cap."""
+        return self._bytes_multi_out(self._OP_APPEND_BYTES, names, blobs)
+
+    def put_bytes_many(self, names, blobs) -> None:
+        """Pipelined multi-put of bytes slots (batched self publishes)."""
+        for r in self._bytes_multi_out(self._OP_PUT_BYTES, names, blobs):
+            if r < 0:
+                raise OSError("control plane put_bytes_many failed")
+
+    def take_bytes_many(self, names) -> list:
+        """Pipelined multi-drain: per-key record lists, one round-trip's
+        latency. Each key's drain is individually atomic and bounded by the
+        server's per-reply cap, exactly like take_bytes."""
+        out = []
+        for payload in self._bytes_multi_in(self._OP_TAKE_BYTES, names):
+            records = []
+            off = 0
+            while off < len(payload):
+                (rl,) = struct.unpack_from("<I", payload, off)
+                off += 4
+                records.append(payload[off:off + rl])
+                off += rl
+            out.append(records)
+        return out
+
+    def get_bytes_many(self, names) -> list:
+        """Pipelined multi-read of bytes slots (batched win_get pulls)."""
+        return self._bytes_multi_in(self._OP_GET_BYTES, names)
 
     def put_bytes(self, name: str, data: bytes) -> None:
         """Overwrite the named bytes slot (the 'exposed window' copy)."""

@@ -317,19 +317,21 @@ bool ConstTimeEq(const uint8_t* a, const uint8_t* b, size_t n) {
   return acc == 0;
 }
 
-void RandomBytes(uint8_t* out, size_t n) {
+// Returns false when /dev/urandom can't supply n bytes. Callers must treat
+// that as fatal for the handshake: a predictable nonce (e.g. from rand())
+// would let a recorded HMAC response be replayed to authenticate without
+// the secret, so there is deliberately NO degraded fallback.
+bool RandomBytes(uint8_t* out, size_t n) {
   int fd = ::open("/dev/urandom", O_RDONLY);
+  if (fd < 0) return false;
   size_t got = 0;
-  if (fd >= 0) {
-    while (got < n) {
-      ssize_t r = ::read(fd, out + got, n - got);
-      if (r <= 0) break;
-      got += static_cast<size_t>(r);
-    }
-    ::close(fd);
+  while (got < n) {
+    ssize_t r = ::read(fd, out + got, n - got);
+    if (r <= 0) break;
+    got += static_cast<size_t>(r);
   }
-  for (; got < n; ++got)  // degraded fallback; urandom exists on linux
-    out[got] = static_cast<uint8_t>(std::rand());
+  ::close(fd);
+  return got == n;
 }
 
 constexpr uint32_t kMaxMsg = 1u << 30;       // 1 GiB bulk-payload ceiling
@@ -369,7 +371,7 @@ struct ControlServer {
     timeval tv{10, 0};
     ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     uint8_t nonce_s[32];
-    RandomBytes(nonce_s, 32);
+    if (!RandomBytes(nonce_s, 32)) return false;  // fail closed, never rand()
     if (!WriteAll(fd, nonce_s, 32)) return false;
     uint8_t reply[64];  // client nonce || HMAC(secret, "c" || nonce_s)
     if (!ReadAll(fd, reply, 64)) return false;
@@ -629,7 +631,7 @@ struct ControlClient {
     uint8_t nonce_s[32];
     if (!ControlServer::ReadAll(fd, nonce_s, 32)) return false;
     uint8_t out[64], msg[33];
-    RandomBytes(out, 32);  // nonce_c
+    if (!RandomBytes(out, 32)) return false;  // nonce_c; fail closed
     msg[0] = 'c';
     std::memcpy(msg + 1, nonce_s, 32);
     HmacSha256(secret, msg, 33, out + 32);
@@ -698,6 +700,102 @@ struct ControlClient {
     *out = payload;
     *out_len = rlen;
     return rlen;
+  }
+
+  // Pipelined payload-carrying batch (kAppendBytes / kPutBytes): frame all
+  // n requests, write them back-to-back, then drain the n int replies. One
+  // round-trip's latency for a whole window op's deposits, and large
+  // payloads stream straight from the caller's buffer (no second copy).
+  int64_t CallBytesMultiOut(uint8_t op, const char* keys_nl, const char* blob,
+                            const int64_t* lens, int64_t* out, int n) {
+    std::lock_guard<std::mutex> lk(mu);
+    const char* p = keys_nl;
+    const char* d = blob;
+    // Small records coalesce into one send buffer (fewer syscalls); large
+    // ones are written directly from the source to skip the memcpy.
+    constexpr size_t kCoalesce = 4u << 20;
+    std::vector<char> buf;
+    for (int i = 0; i < n; ++i) {
+      const char* e = std::strchr(p, '\n');
+      std::string key = e ? std::string(p, e - p) : std::string(p);
+      size_t dlen = static_cast<size_t>(lens[i]);
+      if (dlen <= kCoalesce) {
+        Encode(&buf, op, key, lens[i], d, dlen);
+      } else {
+        Encode(&buf, op, key, lens[i]);  // header only, then stream payload
+        // fix the frame length to include the payload we stream below
+        uint32_t flen;
+        size_t hdr = 4 + 1 + 4 + 2 + key.size() + 8;
+        std::memcpy(&flen, buf.data() + buf.size() - hdr, 4);
+        flen += static_cast<uint32_t>(dlen);
+        std::memcpy(buf.data() + buf.size() - hdr, &flen, 4);
+        if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+        buf.clear();
+        if (!ControlServer::WriteAll(fd, d, dlen)) return -1;
+      }
+      d += dlen;
+      p = e ? e + 1 : p + key.size();
+    }
+    if (!buf.empty() &&
+        !ControlServer::WriteAll(fd, buf.data(), buf.size()))
+      return -1;
+    for (int i = 0; i < n; ++i) {
+      int64_t reply;
+      if (!ReadReply(&reply)) return -1;
+      if (out) out[i] = reply;
+    }
+    return n;
+  }
+
+  // Pipelined bulk-reply batch (kTakeBytes / kGetBytes): one round-trip for
+  // n keys; replies are concatenated as (u64 len | payload)* in a single
+  // malloc'd buffer the caller frees with bf_cp_free.
+  int64_t CallBytesMultiIn(uint8_t op, const char* keys_nl, int n, void** out,
+                           int64_t* out_len) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<char> buf;
+    const char* p = keys_nl;
+    for (int i = 0; i < n; ++i) {
+      const char* e = std::strchr(p, '\n');
+      std::string key = e ? std::string(p, e - p) : std::string(p);
+      Encode(&buf, op, key, 0);
+      p = e ? e + 1 : p + key.size();
+    }
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+    // Grow the result with realloc doubling and read replies straight into
+    // it: no shadow buffer, so a 100 MB drain holds 100-ish MB once, not
+    // twice (this is the bulk data plane being optimized).
+    size_t cap = 1 << 16, used = 0;
+    char* payload = static_cast<char*>(std::malloc(cap));
+    if (!payload) return -1;
+    for (int i = 0; i < n; ++i) {
+      uint32_t rlen;
+      if (!ControlServer::ReadAll(fd, &rlen, 4) || rlen > kMaxMsg) {
+        std::free(payload);
+        return -1;
+      }
+      size_t need = used + 8 + rlen;
+      if (need > cap) {
+        while (cap < need) cap *= 2;
+        char* grown = static_cast<char*>(std::realloc(payload, cap));
+        if (!grown) {
+          std::free(payload);
+          return -1;
+        }
+        payload = grown;
+      }
+      uint64_t rl64 = rlen;
+      std::memcpy(payload + used, &rl64, 8);
+      used += 8;
+      if (rlen && !ControlServer::ReadAll(fd, payload + used, rlen)) {
+        std::free(payload);
+        return -1;
+      }
+      used += rlen;
+    }
+    *out = payload;
+    *out_len = static_cast<int64_t>(used);
+    return n;
   }
 
   // Pipelined batch: send every request, then drain every reply. The server
@@ -858,6 +956,23 @@ int64_t bf_cp_get_bytes(void* h, const char* key, void** out,
                                                    out_len);
 }
 void bf_cp_free(void* p) { std::free(p); }
+// Pipelined batch of n payload-carrying ops (kAppendBytes=8 / kPutBytes=10):
+// keys newline-separated, payloads concatenated in `blob` with per-record
+// lengths in `lens`; per-op int replies land in `out`.
+int64_t bf_cp_bytes_multi_out(void* h, int op, const char* keys_nl,
+                              const void* blob, const int64_t* lens,
+                              int64_t* out, int n) {
+  return static_cast<ControlClient*>(h)->CallBytesMultiOut(
+      static_cast<uint8_t>(op), keys_nl, static_cast<const char*>(blob),
+      lens, out, n);
+}
+// Pipelined batch of n bulk-reply ops (kTakeBytes=9 / kGetBytes=11): one
+// malloc'd (u64 len | payload)* buffer, freed with bf_cp_free.
+int64_t bf_cp_bytes_multi_in(void* h, int op, const char* keys_nl, int n,
+                             void** out, int64_t* out_len) {
+  return static_cast<ControlClient*>(h)->CallBytesMultiIn(
+      static_cast<uint8_t>(op), keys_nl, n, out, out_len);
+}
 // Pipelined batch of n same-op requests (newline-separated keys): one
 // latency round-trip for n key operations. args/out may be null.
 int64_t bf_cp_multi(void* h, int op, const char* keys_nl, const int64_t* args,
