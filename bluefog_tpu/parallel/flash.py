@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,15 +43,23 @@ def _tile(s: int, candidates) -> int:
     return next(t for t in candidates if s % t == 0)
 
 
+def _env_tile(name: str, s: int, default: int) -> int:
+    """Tile override knob (perf sweeps): honored only when it divides s."""
+    v = int(os.environ.get(name, "0"))
+    return v if v > 0 and s % v == 0 else default
+
+
 def _q_tile(sq: int) -> int:
-    return _tile(sq, (256, 128, 64, 32, 16, 8, 4, 2, 1))
+    return _env_tile("BLUEFOG_FLASH_TQ", sq,
+                     _tile(sq, (256, 128, 64, 32, 16, 8, 4, 2, 1)))
 
 
 def _k_tile(sk: int) -> int:
     # bound the [TQ, TK] f32 score tile (+ K/V tiles) well inside VMEM:
     # holding the whole K/V block per kernel invocation overflows the 16 MB
     # scoped limit past S~4k
-    return _tile(sk, (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1))
+    return _env_tile("BLUEFOG_FLASH_TK", sk,
+                     _tile(sk, (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)))
 
 
 def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,

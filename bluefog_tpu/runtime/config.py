@@ -31,9 +31,10 @@ Knobs kept:
 
 Knobs with no TPU meaning (accepted, ignored, logged once at init):
   BLUEFOG_*_BY_MPI routing, BLUEFOG_OPS_ON_CPU, BLUEFOG_WIN_ON_GPU,
-  BLUEFOG_MAX_WIN_SENT_LENGTH, BLUEFOG_NUM_FINALIZER_THREADS,
-  BLUEFOG_SLEEP_USEC_FOR_WIN_PASSIVE, BLUEFOG_MPI_THREAD_LEVEL — all are
-  MPI/NCCL/CUDA transport details; XLA owns transport on TPU.
+  BLUEFOG_NUM_FINALIZER_THREADS, BLUEFOG_SLEEP_USEC_FOR_WIN_PASSIVE,
+  BLUEFOG_MPI_THREAD_LEVEL — all are MPI/NCCL/CUDA transport details; XLA
+  owns transport on TPU. (BLUEFOG_MAX_WIN_SENT_LENGTH is LIVE since r5: it
+  sizes hosted-window deposit chunks, ops/windows.py.)
 """
 
 from __future__ import annotations
@@ -51,7 +52,6 @@ _IGNORED_KNOBS = (
     "BLUEFOG_WIN_OPS_BY_MPI",
     "BLUEFOG_OPS_ON_CPU",
     "BLUEFOG_WIN_ON_GPU",
-    "BLUEFOG_MAX_WIN_SENT_LENGTH",
     "BLUEFOG_NUM_FINALIZER_THREADS",
     "BLUEFOG_SLEEP_USEC_FOR_WIN_PASSIVE",
     "BLUEFOG_MPI_THREAD_LEVEL",

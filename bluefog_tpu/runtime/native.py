@@ -81,11 +81,11 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ctypes.c_int,
     ]
-    lib.bf_cp_bytes_multi_out.restype = ctypes.c_int64
-    lib.bf_cp_bytes_multi_out.argtypes = [
-        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
-        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-        ctypes.c_int,
+    lib.bf_cp_bytes_multi_outv.restype = ctypes.c_int64
+    lib.bf_cp_bytes_multi_outv.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
     ]
     lib.bf_cp_bytes_multi_in.restype = ctypes.c_int64
     lib.bf_cp_bytes_multi_in.argtypes = [
@@ -320,18 +320,48 @@ class ControlPlaneClient:
     _OP_GET_BYTES = 11
 
     def _bytes_multi_out(self, op: int, names, blobs) -> list:
+        """Records may be ``bytes`` or any C-contiguous buffer (numpy
+        views): payloads are passed by POINTER to the native scatter-gather
+        write, so a 100 MB deposit costs zero Python-side copies."""
         names = list(names)
         blobs = list(blobs)  # may be a generator; it's iterated twice below
         if not names:
             return []
         n = len(names)
-        payload = b"".join(blobs)
-        for what, b in zip(names, blobs):
-            self._check_payload(f"bytes batch '{what}'", b)
-        lens = (ctypes.c_int64 * n)(*[len(b) for b in blobs])
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_int64 * n)()
+        keep = []  # keeps the buffers' owners alive across the call
+        for i, b in enumerate(blobs):
+            if isinstance(b, (bytes, bytearray)):
+                self._check_payload(f"bytes batch '{names[i]}'", b)
+                cb = ctypes.c_char_p(bytes(b))
+                keep.append(cb)
+                ptrs[i] = ctypes.cast(cb, ctypes.c_void_p).value
+                lens[i] = len(b)
+            else:  # buffer protocol (numpy array/view)
+                mv = memoryview(b)
+                if not mv.c_contiguous:
+                    raise ValueError("bytes batch payloads must be "
+                                     "C-contiguous")
+                nbytes = mv.nbytes
+                if nbytes > self._MAX_PAYLOAD:
+                    raise ValueError(
+                        f"bytes batch '{names[i]}': payload of {nbytes} "
+                        f"bytes exceeds the {self._MAX_PAYLOAD}-byte "
+                        "per-message ceiling")
+                if mv.readonly:  # rare: fall back to one copy
+                    cb = ctypes.c_char_p(mv.tobytes())
+                    keep.append(cb)
+                    ptrs[i] = ctypes.cast(cb, ctypes.c_void_p).value
+                else:
+                    flat = mv.cast("B") if nbytes else mv
+                    keep.append(flat)
+                    ptrs[i] = ctypes.addressof(
+                        ctypes.c_char.from_buffer(flat)) if nbytes else 0
+                lens[i] = nbytes
         out = (ctypes.c_int64 * n)()
-        if self._lib.bf_cp_bytes_multi_out(
-                self._h, op, "\n".join(names).encode(), payload, lens,
+        if self._lib.bf_cp_bytes_multi_outv(
+                self._h, op, "\n".join(names).encode(), ptrs, lens,
                 out, n) < 0:
             raise OSError("control plane bytes batch failed (connection "
                           "lost or not authenticated)")
@@ -396,6 +426,21 @@ class ControlPlaneClient:
     def get_bytes_many(self, names) -> list:
         """Pipelined multi-read of bytes slots (batched win_get pulls)."""
         return self._bytes_multi_in(self._OP_GET_BYTES, names)
+
+    def box_bytes_many(self, names) -> list:
+        """Pipelined read of pending payload bytes per mailbox — the
+        origin-side pre-check that keeps a multi-record deposit from being
+        torn by the server byte cap (safe: each deposit mailbox has exactly
+        one writer, and the owner's drain only shrinks it)."""
+        names = list(names)
+        if not names:
+            return []
+        n = len(names)
+        out = (ctypes.c_int64 * n)()
+        if self._lib.bf_cp_multi(self._h, 12, "\n".join(names).encode(),
+                                 None, out, n) < 0:
+            raise OSError("control plane box_bytes_many failed")
+        return list(out)
 
     def put_bytes(self, name: str, data: bytes) -> None:
         """Overwrite the named bytes slot (the 'exposed window' copy)."""

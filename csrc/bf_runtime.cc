@@ -185,7 +185,7 @@ namespace {
 enum Op : uint8_t {
   kBarrier = 1, kLock = 2, kUnlock = 3, kFetchAdd = 4, kPut = 5, kGet = 6,
   kShutdown = 7, kAppendBytes = 8, kTakeBytes = 9, kPutBytes = 10,
-  kGetBytes = 11,
+  kGetBytes = 11, kBoxBytes = 12,
 };
 
 // -- SHA-256 / HMAC-SHA256 (self-contained; no OpenSSL in the image) --------
@@ -546,6 +546,17 @@ struct ControlServer {
           replied = true;
           break;
         }
+        case kBoxBytes: {
+          // Current payload bytes pending in the named mailbox. Lets a
+          // single-writer origin pre-check the byte cap per DEPOSIT so a
+          // multi-record deposit is never torn by a mid-sequence -2 (the
+          // drain only shrinks the box, so the check is race-free for the
+          // key's one writer).
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = box_bytes.find(key);
+          reply = it == box_bytes.end() ? 0 : it->second;
+          break;
+        }
         case kShutdown:
           quit = true;
           reply = 1;
@@ -705,12 +716,14 @@ struct ControlClient {
   // Pipelined payload-carrying batch (kAppendBytes / kPutBytes): frame all
   // n requests, write them back-to-back, then drain the n int replies. One
   // round-trip's latency for a whole window op's deposits, and large
-  // payloads stream straight from the caller's buffer (no second copy).
-  int64_t CallBytesMultiOut(uint8_t op, const char* keys_nl, const char* blob,
-                            const int64_t* lens, int64_t* out, int n) {
+  // payloads stream straight from the caller's buffers (no client-side
+  // copy at all — `datas[i]` may point anywhere, e.g. into a live numpy
+  // array, so a 100 MB deposit costs zero Python-side memcpys).
+  int64_t CallBytesMultiOutV(uint8_t op, const char* keys_nl,
+                             const void* const* datas, const int64_t* lens,
+                             int64_t* out, int n) {
     std::lock_guard<std::mutex> lk(mu);
     const char* p = keys_nl;
-    const char* d = blob;
     // Small records coalesce into one send buffer (fewer syscalls); large
     // ones are written directly from the source to skip the memcpy.
     constexpr size_t kCoalesce = 4u << 20;
@@ -720,7 +733,7 @@ struct ControlClient {
       std::string key = e ? std::string(p, e - p) : std::string(p);
       size_t dlen = static_cast<size_t>(lens[i]);
       if (dlen <= kCoalesce) {
-        Encode(&buf, op, key, lens[i], d, dlen);
+        Encode(&buf, op, key, lens[i], datas[i], dlen);
       } else {
         Encode(&buf, op, key, lens[i]);  // header only, then stream payload
         // fix the frame length to include the payload we stream below
@@ -731,9 +744,8 @@ struct ControlClient {
         std::memcpy(buf.data() + buf.size() - hdr, &flen, 4);
         if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
         buf.clear();
-        if (!ControlServer::WriteAll(fd, d, dlen)) return -1;
+        if (!ControlServer::WriteAll(fd, datas[i], dlen)) return -1;
       }
-      d += dlen;
       p = e ? e + 1 : p + key.size();
     }
     if (!buf.empty() &&
@@ -959,12 +971,13 @@ void bf_cp_free(void* p) { std::free(p); }
 // Pipelined batch of n payload-carrying ops (kAppendBytes=8 / kPutBytes=10):
 // keys newline-separated, payloads concatenated in `blob` with per-record
 // lengths in `lens`; per-op int replies land in `out`.
-int64_t bf_cp_bytes_multi_out(void* h, int op, const char* keys_nl,
-                              const void* blob, const int64_t* lens,
-                              int64_t* out, int n) {
-  return static_cast<ControlClient*>(h)->CallBytesMultiOut(
-      static_cast<uint8_t>(op), keys_nl, static_cast<const char*>(blob),
-      lens, out, n);
+// Scatter-gather batch: per-record payload POINTERS (no concatenation) —
+// the zero-copy path for numpy-backed window deposits.
+int64_t bf_cp_bytes_multi_outv(void* h, int op, const char* keys_nl,
+                               const void* const* datas, const int64_t* lens,
+                               int64_t* out, int n) {
+  return static_cast<ControlClient*>(h)->CallBytesMultiOutV(
+      static_cast<uint8_t>(op), keys_nl, datas, lens, out, n);
 }
 // Pipelined batch of n bulk-reply ops (kTakeBytes=9 / kGetBytes=11): one
 // malloc'd (u64 len | payload)* buffer, freed with bf_cp_free.

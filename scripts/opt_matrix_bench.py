@@ -1,0 +1,94 @@
+"""Benchmark the full distributed-optimizer matrix (VERDICT r4 #3).
+
+Runs examples/benchmark.py across every ``--dist-optimizer`` mode on the
+8-device CPU-simulated mesh (relative step cost, same tiny MLP model), the
+comparison the reference published as its own benchmark harness
+(examples/pytorch_benchmark.py:52-60). Results go to stdout as one JSON
+line per mode; PERF.md records the table.
+
+Usage:  python scripts/opt_matrix_bench.py [--chip]
+  --chip: additionally run the single-chip-meaningful modes on the real
+          TPU (resnet50, batch 64) — at n=1 collectives are degenerate, so
+          this isolates per-mode dispatch overhead on the real device.
+"""
+
+import argparse
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+MODES = [
+    "neighbor_allreduce", "allreduce", "gradient_allreduce",
+    "hierarchical_neighbor_allreduce", "sharded_allreduce",
+    "win_put", "push_sum", "pull_get", "local",
+]
+# window modes drive the hosted plane through a control plane even in one
+# process; at n=1-chip they still exercise the full op path
+CHIP_MODES = ["gradient_allreduce", "neighbor_allreduce", "win_put"]
+
+RATE_RE = re.compile(r"Total img/sec on \d+ chip\(s\): ([0-9.]+) \+-([0-9.]+)")
+
+
+def run_mode(mode: str, simulate: int, extra=()) -> dict:
+    cmd = [sys.executable, "-m", "bluefog_tpu.launcher"]
+    if simulate:
+        cmd += ["--simulate", str(simulate)]
+    cmd += ["--", sys.executable, str(REPO / "examples" / "benchmark.py"),
+            "--model", "mlp", "--batch-size", "8",
+            "--num-warmup-batches", "3", "--num-batches-per-iter", "5",
+            "--num-iters", "3", "--dist-optimizer", mode, *extra]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
+                       cwd=REPO)
+    m = RATE_RE.search(r.stdout)
+    if r.returncode != 0 or not m:
+        return {"mode": mode, "error": (r.stdout + r.stderr)[-500:]}
+    return {"mode": mode, "img_per_sec": float(m.group(1)),
+            "ci": float(m.group(2))}
+
+
+def run_chip_mode(mode: str) -> dict:
+    cmd = [sys.executable, str(REPO / "examples" / "benchmark.py"),
+           "--model", "resnet50", "--batch-size", "64",
+           "--num-warmup-batches", "5", "--num-batches-per-iter", "5",
+           "--num-iters", "3", "--dist-optimizer", mode]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=1800,
+                       cwd=REPO)
+    m = RATE_RE.search(r.stdout)
+    if r.returncode != 0 or not m:
+        return {"mode": mode, "error": (r.stdout + r.stderr)[-500:]}
+    return {"mode": mode, "img_per_sec": float(m.group(1)),
+            "ci": float(m.group(2))}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chip", action="store_true")
+    ap.add_argument("--modes", nargs="*", default=None)
+    args = ap.parse_args()
+    rc = 0
+    if args.chip:
+        for mode in (args.modes or CHIP_MODES):
+            res = run_chip_mode(mode)
+            res["where"] = "tpu-1chip-resnet50-b64"
+            print(json.dumps(res), flush=True)
+            rc = rc or ("error" in res)
+    else:
+        for mode in (args.modes or MODES):
+            extra = ()
+            if mode != "neighbor_allreduce":
+                # dynamic Expo-2 applies only to neighbor_allreduce; keep
+                # the others on their natural static path
+                extra = ("--disable-dynamic-topology",)
+            res = run_mode(mode, simulate=8, extra=extra)
+            res["where"] = "cpu-mesh-8dev-mlp-b8"
+            print(json.dumps(res), flush=True)
+            rc = rc or ("error" in res)
+    return int(rc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

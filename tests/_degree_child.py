@@ -1,0 +1,157 @@
+"""Child program for the high-degree window harness (VERDICT r4 #5).
+
+Launched N_CTL times by tests/test_launcher.py (8 controllers x 1 simulated
+device). The quad harness covers the ring (d=2); this child stretches the
+hosted window plane where the reference's window tests lived
+(torch_win_ops_test.py:268-845): high-degree and RAGGED in-degrees, the
+chunked deposit wire, and the server mailbox byte cap under real
+cross-controller contention.
+
+  A. expo2 window (d_max=3 at n=8): exact put -> update values;
+  B. star window (center in-degree n-1, leaves 1): ragged mailbox layout,
+     put + accumulate -> exact update at every rank;
+  C. chunked deposits: BLUEFOG_MAX_WIN_SENT_LENGTH=64Ki with a 160 KB row
+     -> every cross-controller deposit ships as 3 wire records and
+     reassembles exactly;
+  D. mailbox byte cap: leaves flood the center's slots without a drain
+     until the server cap rejects with the targeted "mailbox full" error;
+     the successfully-deposited mass is then collected exactly once.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import windows as win_ops
+from bluefog_tpu.runtime import control_plane
+
+
+def owned_rows(arr, owned):
+    rows = {}
+    for s in arr.addressable_shards:
+        rows[s.index[0].start or 0] = np.asarray(s.data)[0]
+    return {r: rows[r] for r in owned}
+
+
+def main() -> None:
+    bf.init()
+    pid = jax.process_index("cpu")
+    n = bf.size()
+    cl = control_plane.client()
+    n_ctl = control_plane.world()
+    per = n // n_ctl
+    owned = list(range(per * pid, per * (pid + 1)))
+    x_np = (np.arange(n, dtype=np.float32) + 1.0).reshape(n, 1)
+
+    # ---- Phase A: expo2, d_max = log2-degree ----------------------------
+    bf.set_topology(bf.topology_util.ExponentialTwoGraph(n))
+    topo = bf.load_topology()
+    in_nbrs = {r: bf.topology_util.in_neighbor_ranks(topo, r)
+               for r in range(n)}
+    assert bf.win_create(x_np, "d.a", zero_init=True)
+    win = win_ops._get_window("d.a")
+    assert win.hosted and win.layout.d_max == len(in_nbrs[0]), (
+        win.layout.d_max, in_nbrs[0])
+    bf.win_put(x_np, "d.a")
+    bf.barrier()
+    got = owned_rows(bf.win_update("d.a"), owned)
+    for r in owned:
+        u = 1.0 / (len(in_nbrs[r]) + 1)
+        want = u * (x_np[r] + sum(x_np[s] for s in in_nbrs[r]))
+        np.testing.assert_allclose(got[r], want, rtol=1e-6)
+    print(f"PHASE_A_OK {pid}", flush=True)
+    bf.barrier()
+    bf.win_free("d.a")
+
+    # ---- Phase B: star — ragged in-degrees (center n-1, leaves 1) -------
+    bf.set_topology(bf.topology_util.StarGraph(n))
+    topo = bf.load_topology()
+    in_nbrs = {r: bf.topology_util.in_neighbor_ranks(topo, r)
+               for r in range(n)}
+    assert bf.win_create(x_np, "d.b", zero_init=True)
+    win = win_ops._get_window("d.b")
+    assert win.layout.d_max == n - 1, win.layout.d_max
+    bf.win_put(x_np, "d.b")
+    bf.win_accumulate(x_np, "d.b")  # slot value = 2*x[src]
+    bf.barrier()
+    got = owned_rows(bf.win_update("d.b"), owned)
+    for r in owned:
+        u = 1.0 / (len(in_nbrs[r]) + 1)
+        want = u * (x_np[r] + sum(2.0 * x_np[s] for s in in_nbrs[r]))
+        np.testing.assert_allclose(got[r], want, rtol=1e-6)
+    print(f"PHASE_B_OK {pid}", flush=True)
+    bf.barrier()
+    bf.win_free("d.b")
+
+    # ---- Phase C: chunked deposits over the ring ------------------------
+    os.environ["BLUEFOG_MAX_WIN_SENT_LENGTH"] = str(1 << 16)
+    try:
+        bf.set_topology(bf.topology_util.RingGraph(n))
+        topo = bf.load_topology()
+        in_nbrs = {r: bf.topology_util.in_neighbor_ranks(topo, r)
+                   for r in range(n)}
+        elems = 40_000  # 160 KB row -> 3 chunks of <= 64 KiB
+        big = np.arange(n, dtype=np.float32)[:, None] + np.linspace(
+            0.0, 1.0, elems, dtype=np.float32)[None, :]
+        assert bf.win_create(big, "d.c", zero_init=True)
+        bf.win_put(big, "d.c")
+        bf.barrier()
+        got = owned_rows(bf.win_update("d.c"), owned)
+        for r in owned:
+            u = 1.0 / (len(in_nbrs[r]) + 1)
+            want = u * (big[r] + sum(big[s] for s in in_nbrs[r]))
+            np.testing.assert_allclose(got[r], want, rtol=1e-5)
+        print(f"PHASE_C_OK {pid}", flush=True)
+        bf.barrier()
+        bf.win_free("d.c")
+    finally:
+        os.environ.pop("BLUEFOG_MAX_WIN_SENT_LENGTH", None)
+
+    # ---- Phase D: mailbox byte cap under contention ---------------------
+    # Parent set BLUEFOG_CP_MAILBOX_MAX_MB=1. Each leaf floods its center
+    # slot with 256 KB accumulates and NO owner drain: the 4th-ish op hits
+    # the server cap and raises the targeted error. Center rank = 0.
+    bf.set_topology(bf.topology_util.StarGraph(n))
+    elems = 65_536  # 256 KB per deposit
+    flood = np.full((n, elems), 1.0, np.float32) * (
+        np.arange(n, dtype=np.float32)[:, None] + 1.0)
+    assert bf.win_create(flood, "d.d", zero_init=True)
+    landed = 0
+    hit_cap = False
+    if 0 not in owned:
+        for _ in range(64):
+            try:
+                bf.win_accumulate(flood, "d.d")
+                landed += 1
+            except RuntimeError as e:
+                assert "mailbox full" in str(e), e
+                hit_cap = True
+                break
+        assert hit_cap, "server byte cap never engaged"
+        # landed mass from MY owned leaves: each op deposits x[src] to the
+        # center for every owned src (weight 1)
+        mass = sum(landed * float(flood[src, 0]) for src in owned)
+        control_plane.put_float(cl, f"d.d.mass.{pid}", mass * float(elems))
+        print(f"PHASE_D_CAP {pid} landed={landed}", flush=True)
+    bf.barrier()
+    if 0 in owned:
+        got = owned_rows(bf.win_update_then_collect("d.d"), owned)
+        total = float(got[0].astype(np.float64).sum()) \
+            - float(flood[0].astype(np.float64).sum())
+        want = sum(
+            control_plane.get_float(cl, f"d.d.mass.{p}")
+            for p in range(n_ctl) if p != pid)
+        assert abs(total - want) / max(want, 1.0) < 1e-5, (total, want)
+        print(f"PHASE_D_MASS_OK {total:.0f}", flush=True)
+    bf.barrier()
+    bf.win_free("d.d")
+
+    bf.shutdown()
+    print(f"CHILD_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -9,9 +9,15 @@ executed by the CPU backend. Must configure the env BEFORE jax is imported.
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+# 16 forced devices: suites mostly slice 8 of them, but the odd/non-power-
+# of-2 world-size sweep (test_odd_world_sizes.py) also needs 12 — the
+# reference ran at arbitrary np (its Makefile used np=2/np=4), so neighbor
+# math must not silently assume power-of-2 sizes. Any caller-provided
+# force flag (e.g. the Makefile's =8) is stripped so 16 actually wins.
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=16"])
 # The image's sitecustomize force-registers the axon TPU plugin; an empty
 # JAX_PLATFORMS lets both backends register so jax.devices('cpu') works.
 # BLUEFOG_TESTS_CPU_ONLY=1 pins strictly to CPU — the escape hatch for when

@@ -246,7 +246,7 @@ def test_win_fence_folds_pending_deposits(bf_hosted):
     cl.fetch_add(f"w.h.fence.v.{dst}.{k}", 1)
     contrib = np.full((2,), 7.0, np.float32)
     import struct as _st
-    rec = _st.pack("<BBd", 1, 0, 0.0) + contrib.tobytes()
+    rec = _st.pack("<BBdI", 1, 0, 0.0, 1) + contrib.tobytes()
     cl.append_bytes(f"w.h.fence.dep.{dst}.{k}", rec)
     assert bf.win_fence("h.fence")
     # deposit is now IN the owner's mailbox row, server box empty
@@ -271,7 +271,7 @@ def test_strict_update_rejects_version0_deposit(bf_hosted, monkeypatch):
     k = win.layout.slot_of[dst][src]
     cl = cp.client()
     import struct as _st
-    rec = _st.pack("<BBd", 1, 0, 0.0) + np.ones((2,), np.float32).tobytes()
+    rec = _st.pack("<BBdI", 1, 0, 0.0, 1) + np.ones((2,), np.float32).tobytes()
     # no version bump: the origin "forgot" require_mutex's protocol
     cl.append_bytes(f"w.h.strict.dep.{dst}.{k}", rec)
     with pytest.raises(RuntimeError, match="version 0"):
@@ -324,3 +324,91 @@ def test_strict_mode_survives_concurrent_put_update(bf_hosted):
                   for r in range(8))
     assert abs(deposited - 15 * n_edges) < 1e-3, (deposited, 15 * n_edges)
     bf.win_free("h.hammer")
+
+
+# ---------------------------------------------------------------------------
+# wire format (r5): dtype-true payloads + chunked deposits
+# ---------------------------------------------------------------------------
+
+def test_wire_dtype_rule():
+    """Floating windows ship deposits in their OWN dtype (bf16 wire bytes
+    halved vs the r4 acc-dtype format); integer windows keep the f32 acc
+    dtype so fractional edge weights keep their accumulate semantics."""
+    import ml_dtypes
+
+    assert win_ops._win_wire_dtype(np.float32) == np.float32
+    assert win_ops._win_wire_dtype(np.float64) == np.float64
+    assert win_ops._win_wire_dtype(ml_dtypes.bfloat16) == ml_dtypes.bfloat16
+    assert win_ops._win_wire_dtype(np.float16) == np.float16
+    assert win_ops._win_wire_dtype(np.int32) == np.float32
+
+
+def test_pack_deposit_chunking(monkeypatch):
+    """_pack_deposit splits payloads at BLUEFOG_MAX_WIN_SENT_LENGTH (the
+    reference's chunked-put knob, mpi_controller.cc:41-46) into one header
+    record plus raw continuations that reassemble exactly."""
+    monkeypatch.setenv("BLUEFOG_MAX_WIN_SENT_LENGTH", str(1 << 16))
+    payload = np.arange(50_000, dtype=np.float32)  # 200 KB
+    recs = win_ops._pack_deposit(win_ops._DEP_ACC, 1, 2.5, payload)
+    assert len(recs) == 5  # header record + ceil(200e3 / 64Ki) chunks
+    import struct as _st
+    mode, has_p, pc, nchunks = _st.unpack_from("<BBdI", recs[0])
+    assert (mode, has_p, pc, nchunks) == (win_ops._DEP_ACC, 1, 2.5, 4)
+    # payload chunks are ZERO-COPY views into the source buffer
+    assert all(isinstance(c, memoryview) for c in recs[1:])
+    assert b"".join(recs[1:]) == payload.tobytes()
+    small = win_ops._pack_deposit(win_ops._DEP_PUT, 0, 0.0, b"abc")
+    assert len(small) == 2 and bytes(small[1]) == b"abc"
+
+
+def test_chunked_deposit_drain_reassembles(bf_hosted, monkeypatch):
+    """A multi-chunk deposit appended to the server mailbox (as a remote
+    origin would) is reassembled by the win_update drain and folded once,
+    exactly."""
+    monkeypatch.setenv("BLUEFOG_MAX_WIN_SENT_LENGTH", str(1 << 16))
+    elems = 40_000  # 160 KB of f32 -> 3 chunks
+    x = jnp.zeros((8, elems), jnp.float32)
+    assert bf.win_create(x, "h.chunk", zero_init=True)
+    win = win_ops._get_window("h.chunk")
+    dst, src = 0, sorted(win.in_neighbors[0])[0]
+    k = win.layout.slot_of[dst][src]
+    contrib = np.arange(elems, dtype=np.float32)
+    cl = cp.client()
+    cl.fetch_add(f"w.h.chunk.v.{dst}.{k}", 1)
+    recs = win_ops._pack_deposit(win_ops._DEP_ACC, 0, 0.0, contrib)
+    assert len(recs) == 4  # header + 3 chunks
+    cl.append_bytes_many([f"w.h.chunk.dep.{dst}.{k}"] * len(recs), recs)
+    bf.win_update("h.chunk", self_weight=1.0,
+                  neighbor_weights={r: {s: 1.0 for s in win.in_neighbors[r]}
+                                    for r in range(8)},
+                  reset=True)
+    np.testing.assert_allclose(win._mail_rows[dst][k], 0.0)  # reset by update
+    # fold happened BEFORE the combine: rank 0's row gained the contribution
+    np.testing.assert_allclose(
+        np.asarray(win.self_value)[0], contrib, rtol=1e-6)
+    bf.win_free("h.chunk")
+
+
+def test_bf16_deposit_wire_roundtrip(bf_hosted):
+    """bf16 windows: a deposit packed in the bf16 wire dtype folds into the
+    mailbox with f32 accumulation (the compiled plane's cast discipline)."""
+    import ml_dtypes
+
+    x = jnp.ones((8, 4), jnp.bfloat16)
+    assert bf.win_create(x, "h.bf16", zero_init=True)
+    win = win_ops._get_window("h.bf16")
+    assert win_ops._win_wire_dtype(win.mail_dtype) == ml_dtypes.bfloat16
+    dst, src = 0, sorted(win.in_neighbors[0])[0]
+    k = win.layout.slot_of[dst][src]
+    contrib = np.asarray([1.5, 2.5, 3.5, 4.5], ml_dtypes.bfloat16)
+    cl = cp.client()
+    cl.fetch_add(f"w.h.bf16.v.{dst}.{k}", 1)
+    recs = win_ops._pack_deposit(win_ops._DEP_PUT, 0, 0.0, contrib)
+    # 8 payload bytes on the wire, not 16 (the r4 f32 format)
+    assert len(recs) == 2 and memoryview(recs[1]).nbytes == 8
+    cl.append_bytes_many([f"w.h.bf16.dep.{dst}.{k}"] * 2, recs)
+    win._drain_deposits()
+    np.testing.assert_allclose(
+        np.asarray(win._mail_rows[dst][k], np.float32),
+        np.asarray(contrib, np.float32))
+    bf.win_free("h.bf16")

@@ -101,8 +101,9 @@ def test_simulate_16_ranks():
     assert "RANKS16_OK" in out.stdout
 
 
-def _launch_n(child_script: str, env, nproc: int, timeout: int = 300):
-    """Run an nproc-process bfrun job of ``child_script`` (2 simulated
+def _launch_n(child_script: str, env, nproc: int, timeout: int = 300,
+              simulate: int = 2):
+    """Run an nproc-process bfrun job of ``child_script`` (``simulate``
     devices each); return (procs, outs)."""
     port = _free_port()
 
@@ -110,7 +111,7 @@ def _launch_n(child_script: str, env, nproc: int, timeout: int = 300):
         return [sys.executable, "-m", "bluefog_tpu.launcher",
                 "-np", str(nproc),
                 "--coordinator", f"127.0.0.1:{port}", "--process-id", str(i),
-                "--simulate", "2",
+                "--simulate", str(simulate),
                 "--", sys.executable, str(TESTS / child_script)]
 
     procs = [subprocess.Popen(cmd(i), env=env, stdout=subprocess.PIPE,
@@ -291,6 +292,27 @@ def test_four_controllers_windows_mutex_pushsum_topocheck():
     procs, outs = _launch_n("_quad_child.py", _scrubbed_env(), 4,
                             timeout=420)
     _assert_quad_outputs(procs, outs)
+
+
+@pytest.mark.slow
+def test_eight_controller_high_degree_windows():
+    """8 controllers x 1 device: hosted windows at high/ragged degrees
+    (expo2 d=3, star d=7), chunked cross-controller deposits
+    (BLUEFOG_MAX_WIN_SENT_LENGTH=64Ki), and the server mailbox byte cap
+    engaging under real contention with exact mass accounting afterwards.
+    See tests/_degree_child.py (VERDICT r4 #5)."""
+    env = _scrubbed_env()
+    env["BLUEFOG_CP_MAILBOX_MAX_MB"] = "1"  # phase D: cap engages fast
+    procs, outs = _launch_n("_degree_child.py", env, 8, timeout=600,
+                            simulate=1)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+        for marker in ("PHASE_A_OK", "PHASE_B_OK", "PHASE_C_OK",
+                       "CHILD_OK"):
+            assert f"{marker} {i}" in out, f"missing {marker} {i}:\n{out}"
+        if i != 0:
+            assert f"PHASE_D_CAP {i}" in out, out
+    assert "PHASE_D_MASS_OK" in outs[0]
 
 
 @pytest.mark.slow

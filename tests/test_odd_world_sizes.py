@@ -1,0 +1,219 @@
+"""Non-power-of-2 world sizes: n ∈ {3, 5, 6, 7, 12} (VERDICT r4 #2).
+
+Every other suite runs at n = 8 (and one file at 4), so the circulant shift
+decomposition, the expo graphs' ``_is_power_of`` row patterns, the dynamic
+iterators' modular arithmetic, hierarchical machine splits, and the window
+mailbox ``d_max`` layouts were never exercised off the power-of-2 lattice.
+The reference ran its whole suite at arbitrary ``np`` (its CI used np=2 and
+np=4, reference Makefile:1); a silent wrong-neighbor bug at odd n would have
+passed our suite while failing the reference's. This file is the sweep that
+closes that hole: every static graph's neighbor average is checked against
+the independently computed ``W.T @ x`` oracle, the dynamic iterators against
+a global send/recv consistency audit, and windows against ragged in-degrees
+(star: center d=n-1, leaves d=1).
+"""
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as topology_util
+
+from conftest import cpu_devices
+
+SIZES = [3, 5, 6, 7, 12]
+
+GRAPHS = {
+    "expo2": topology_util.ExponentialTwoGraph,
+    "expo3": lambda n: topology_util.ExponentialGraph(n, base=3),
+    "symexpo": topology_util.SymmetricExponentialGraph,
+    "mesh2d": topology_util.MeshGrid2DGraph,
+    "star": topology_util.StarGraph,
+    "ring": topology_util.RingGraph,
+    "full": topology_util.FullyConnectedGraph,
+}
+
+
+@pytest.fixture(params=SIZES)
+def bfn(request):
+    n = request.param
+    bf.init(devices=cpu_devices(n))
+    yield bf, n
+    bf.shutdown()
+
+
+def rank_x(n, width=3):
+    # distinct per-rank values, not symmetric around anything
+    return np.arange(n, dtype=np.float32)[:, None] * np.ones(
+        (1, width), np.float32) + 0.25
+
+
+@pytest.mark.parametrize("gname", sorted(GRAPHS))
+def test_static_graph_neighbor_allreduce_exact(bfn, gname):
+    """All 7 graph families at every odd/composite n: the compiled circulant
+    plan must reproduce W.T @ x exactly (weighted topology path)."""
+    b, n = bfn
+    b.set_topology(GRAPHS[gname](n), is_weighted=True)
+    W = topology_util.weight_matrix(b.load_topology())
+    # sanity on the family itself: weights into each rank sum to 1
+    np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+    x = rank_x(n)
+    out = np.asarray(b.neighbor_allreduce(x))
+    np.testing.assert_allclose(out, W.T @ x, atol=1e-5)
+
+
+@pytest.mark.parametrize("gname", sorted(GRAPHS))
+def test_static_graph_uniform_weights_exact(bfn, gname):
+    """The unweighted path (uniform 1/(d+1) averaging) at odd n."""
+    b, n = bfn
+    b.set_topology(GRAPHS[gname](n), is_weighted=False)
+    topo = b.load_topology()
+    x = rank_x(n)
+    out = np.asarray(b.neighbor_allreduce(x))
+    for r in range(n):
+        nbrs = topology_util.in_neighbor_ranks(topo, r)
+        want = (x[r] + sum(x[s] for s in nbrs)) / (len(nbrs) + 1)
+        np.testing.assert_allclose(out[r], want, atol=1e-5)
+
+
+def test_dynamic_one_peer_exact(bfn):
+    """GetDynamicSendRecvRanks at odd n: per-step send/recv consistency
+    across ALL ranks plus exact neighbor_allreduce values each step."""
+    b, n = bfn[0], bfn[1]
+    topo = topology_util.ExponentialTwoGraph(n)
+    gens = [topology_util.GetDynamicSendRecvRanks(topo, r) for r in range(n)]
+    x = rank_x(n)
+    for _ in range(2 * n + 1):  # cover the full schedule cycle at odd n
+        steps = [next(g) for g in gens]
+        sends = {r: steps[r][0] for r in range(n)}
+        recvs = {r: steps[r][1] for r in range(n)}
+        # global consistency audit: r sends to s <=> s receives from r
+        for r in range(n):
+            for s in sends[r]:
+                assert r in recvs[s], (r, s, sends, recvs)
+            for s in recvs[r]:
+                assert r in sends[s], (r, s, sends, recvs)
+        nw = {r: {src: 0.5 for src in recvs[r]} for r in range(n)}
+        sw = {r: 1.0 - 0.5 * len(recvs[r]) for r in range(n)}
+        got = np.asarray(b.neighbor_allreduce(
+            x, self_weight=sw, neighbor_weights=nw, send_neighbors=sends,
+            enable_topo_check=False))
+        want = np.stack([
+            sw[r] * x[r] + sum(0.5 * x[s] for s in recvs[r])
+            for r in range(n)])
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        x = want
+
+
+@pytest.mark.parametrize("world,local", [(6, 3), (12, 3), (12, 4)])
+def test_inner_outer_iterators_consistency(world, local):
+    """The machine-granularity iterators at non-power-of-2 local/machine
+    counts: the log2(local_size-2) / log2(num_machines-1) arithmetic
+    (topology.py:433-434) must still yield a globally consistent one-peer
+    schedule — every send has a matching recv at every step."""
+    iters = {
+        "ring": [topology_util.GetInnerOuterRingDynamicSendRecvRanks(
+            world, local, r) for r in range(world)],
+        "expo2": [topology_util.GetInnerOuterExpo2DynamicSendRecvRanks(
+            world, local, r) for r in range(world)],
+    }
+    for name, gens in iters.items():
+        for step in range(3 * local * max(1, world // local)):
+            steps = [next(g) for g in gens]
+            for r in range(world):
+                (send,), (recv,) = steps[r]
+                assert 0 <= send < world and send != r, (name, step, r, send)
+                assert steps[send][1][0] == r, (
+                    f"{name} step {step}: {r} sends to {send}, but {send} "
+                    f"expects recv from {steps[send][1][0]}")
+                assert steps[recv][0][0] == r, (
+                    f"{name} step {step}: {r} recvs from {recv}, but {recv} "
+                    f"sends to {steps[recv][0][0]}")
+
+
+@pytest.mark.parametrize("world,local", [(6, 3), (12, 3)])
+def test_exp2_machine_iterator_consistency(world, local):
+    """GetExp2DynamicSendRecvMachineRanks at 2 and 4 machines with odd
+    local_size: machine send/recv pairing is mutual every step."""
+    num_machines = world // local
+    gens = {}
+    for m in range(num_machines):
+        r = m * local  # local_rank 0 on each machine
+        gens[m] = topology_util.GetExp2DynamicSendRecvMachineRanks(
+            world, local, r, 0)
+    for step in range(2 * num_machines + 1):
+        steps = {m: next(g) for m, g in gens.items()}
+        for m in range(num_machines):
+            (send,), (recv,) = steps[m]
+            assert steps[send][1][0] == m, (step, m, send, steps)
+            assert steps[recv][0][0] == m, (step, m, recv, steps)
+
+
+def test_hierarchical_local_size_3():
+    """Hierarchical neighbor allreduce with 2 machines x 3 ranks: local
+    averaging then cross-machine combine, exact values."""
+    bf.init(devices=cpu_devices(6), local_size=3)
+    try:
+        x = rank_x(6)
+        out = np.asarray(bf.hierarchical_neighbor_allreduce(x))
+        m0, m1 = x[:3].mean(axis=0), x[3:].mean(axis=0)
+        want = (m0 + m1) / 2.0
+        np.testing.assert_allclose(out, np.tile(want, (6, 1)), atol=1e-5)
+    finally:
+        bf.shutdown()
+
+
+def test_window_ragged_in_degrees(bfn):
+    """Star windows at odd n: the center's mailbox uses d_max = n-1 slots,
+    leaves use 1 of d_max — put + update must still be exact."""
+    b, n = bfn
+    b.set_topology(topology_util.StarGraph(n))
+    topo = b.load_topology()
+    x = rank_x(n, width=2)
+    assert b.win_create(x, "odd.star", zero_init=True)
+    try:
+        b.win_put(x, "odd.star")
+        out = np.asarray(b.win_update("odd.star"))
+        for r in range(n):
+            nbrs = topology_util.in_neighbor_ranks(topo, r)
+            want = (x[r] + sum(x[s] for s in nbrs)) / (len(nbrs) + 1)
+            np.testing.assert_allclose(out[r], want, atol=1e-5)
+    finally:
+        b.win_free("odd.star")
+
+
+def test_window_dynamic_partial_destinations(bfn):
+    """Partial-destination puts at odd n over expo2: only the chosen edge
+    set lands, with per-edge weights."""
+    b, n = bfn
+    b.set_topology(topology_util.ExponentialTwoGraph(n))
+    topo = b.load_topology()
+    x = rank_x(n, width=2)
+    assert b.win_create(x, "odd.dyn", zero_init=True)
+    try:
+        # each rank puts only to its FIRST out-neighbor, weight 2.0
+        dsts = {r: {topology_util.out_neighbor_ranks(topo, r)[0]: 2.0}
+                for r in range(n)}
+        b.win_put(x, "odd.dyn", dst_weights=dsts)
+        out = np.asarray(b.win_update("odd.dyn"))
+        for r in range(n):
+            nbrs = topology_util.in_neighbor_ranks(topo, r)
+            contrib = {s: (2.0 * x[s] if dsts[s].get(r) else 0.0 * x[s])
+                       for s in nbrs}
+            want = (x[r] + sum(contrib.values())) / (len(nbrs) + 1)
+            np.testing.assert_allclose(out[r], want, atol=1e-5)
+    finally:
+        b.win_free("odd.dyn")
+
+
+def test_allreduce_allgather_odd_sizes(bfn):
+    """The global collectives are size-agnostic too (sanity at odd n)."""
+    b, n = bfn
+    x = rank_x(n)
+    np.testing.assert_allclose(
+        np.asarray(b.allreduce(x, average=True)),
+        np.tile(x.mean(axis=0), (n, 1)), atol=1e-5)
+    gathered = np.asarray(b.allgather(x))
+    # rank-stacked view: every rank's row carries the full gathered concat
+    assert gathered.shape == (n, n * 3)
+    np.testing.assert_allclose(gathered[0], x.reshape(-1), atol=1e-6)
