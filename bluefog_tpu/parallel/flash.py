@@ -50,8 +50,10 @@ def _env_tile(name: str, s: int, default: int) -> int:
 
 
 def _q_tile(sq: int) -> int:
+    # 512/2048 defaults from the r5 on-chip sweep (S=8192, D=128): +5 %
+    # step time over the r4 256/1024 defaults; 1024/4096 fail to fit VMEM.
     return _env_tile("BLUEFOG_FLASH_TQ", sq,
-                     _tile(sq, (256, 128, 64, 32, 16, 8, 4, 2, 1)))
+                     _tile(sq, (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)))
 
 
 def _k_tile(sk: int) -> int:
@@ -59,8 +61,19 @@ def _k_tile(sk: int) -> int:
     # holding the whole K/V block per kernel invocation overflows the 16 MB
     # scoped limit past S~4k
     return _env_tile("BLUEFOG_FLASH_TK", sk,
-                     _tile(sk, (1024, 512, 256, 128, 64, 32, 16, 8, 4, 2, 1)))
+                     _tile(sk, (2048, 1024, 512, 256, 128, 64, 32, 16, 8,
+                                4, 2, 1)))
 
+
+
+def _dot_prec(dtype):
+    """Kernel matmul precision: DEFAULT for sub-f32 operands (bf16 x bf16
+    runs the MXU at 4x its f32 rate and the products are exact for bf16
+    operands), HIGHEST for f32 (DEFAULT decomposes f32 dots into bf16
+    passes on some backends — measured 0.1-level error — which would break
+    the f32 oracle contract interpret-mode tests pin)."""
+    return (jax.lax.Precision.HIGHEST if jnp.dtype(dtype) == jnp.float32
+            else jax.lax.Precision.DEFAULT)
 
 def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
             causal: bool, scale: float):
@@ -77,14 +90,20 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
         m_ref[0] = jnp.full_like(m_ref[0], _NEG)
         l_ref[0] = jnp.zeros_like(l_ref[0])
 
-    def body():
-        q = q_ref[0].astype(jnp.float32) * scale      # [TQ, D]
-        k = k_ref[0].astype(jnp.float32)              # [TK, D]
-        v = v_ref[0].astype(jnp.float32)
+    def body(masked: bool):
+        # Dots keep the inputs' NATIVE dtype (bf16) with f32 accumulation:
+        # the MXU runs bf16x bf16 at 4x its f32 rate, and the operands are
+        # already bf16 so the products are bit-identical; only the scale
+        # (applied post-dot, in f32) and the p cast below round differently
+        # — the standard flash-attention-2 precision recipe.
+        q = q_ref[0]                                  # [TQ, D] native dtype
+        k = k_ref[0]                                  # [TK, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if causal:
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(q_ref.dtype)) * scale
+        if masked:
             q_pos = offs_ref[0] + qi * tq + jax.lax.broadcasted_iota(
                 jnp.int32, (tq, tk), 0)
             k_pos = offs_ref[1] + kj * tk + jax.lax.broadcasted_iota(
@@ -96,25 +115,33 @@ def _kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
         alpha = jnp.exp(m_prev - m_new)               # 0 on the first block
         p = jnp.exp(s - m_new[:, None])
-        if causal:
+        if masked:
             p = jnp.where(allowed, p, 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1)
         o_ref[0] = alpha[:, None] * o_ref[0] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(q_ref.dtype))
         # m/l carry a size-8 lane dim purely for TPU tiling (sublane x lane
         # constraints); consumers read lane 0.
         m_ref[0] = jnp.broadcast_to(m_new[:, None], (tq, 8))
         l_ref[0] = jnp.broadcast_to(l_new[:, None], (tq, 8))
 
     if causal:
-        # skip k-blocks that lie entirely in the future of this q tile
-        # (~half the grid for single-device causal attention)
+        # Three tile classes (VPU saver — masking builds two [TQ, TK]
+        # iotas + compares + selects per tile, and only DIAGONAL tiles
+        # need it): dead tiles (K entirely in the future) are skipped;
+        # interior tiles (K entirely in the past) run unmasked; diagonal
+        # tiles pay the mask. At S >> TQ the diagonal is a vanishing
+        # fraction of live tiles.
         live = (offs_ref[1] + kj * tk
                 <= offs_ref[0] + qi * tq + tq - 1)
-        pl.when(live)(body)
+        interior = (offs_ref[1] + kj * tk + tk - 1
+                    <= offs_ref[0] + qi * tq)
+        pl.when(interior)(lambda: body(False))
+        pl.when(live & ~interior)(lambda: body(True))
     else:
-        body()
+        body(False)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "interpret"))
@@ -186,7 +213,7 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
 
 
 def _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref,
-               d_ref, causal: bool, scale: float):
+               d_ref, masked: bool, scale: float):
     """Shared backward-tile recompute: (q*scale, k, v, g, d, P, dS).
 
     The probability tile P is rebuilt in VMEM from the saved GLOBAL (m, l)
@@ -196,16 +223,20 @@ def _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref,
     (and their masking) from drifting apart."""
     tq = q_ref.shape[1]
     tk = k_ref.shape[1]
-    q = q_ref[0].astype(jnp.float32) * scale
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32)
+    # native-dtype (bf16) dot operands, f32 accumulation — see _kernel; the
+    # scale moves AFTER the qk dot (q stays unscaled, so the dk pass
+    # applies it explicitly)
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    g = g_ref[0]
     m = m_ref[0][:, 0]
     inv_l = 1.0 / l_ref[0][:, 0]
     d = d_ref[0][:, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-    if causal:
+                            preferred_element_type=jnp.float32,
+                            precision=_dot_prec(q_ref.dtype)) * scale
+    if masked:
         q_pos = offs_ref[0] + qi * tq + jax.lax.broadcasted_iota(
             jnp.int32, (tq, tk), 0)
         k_pos = offs_ref[1] + kj * tk + jax.lax.broadcasted_iota(
@@ -213,10 +244,11 @@ def _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref,
         allowed = q_pos >= k_pos
         s = jnp.where(allowed, s, _NEG)
     p = jnp.exp(s - m[:, None]) * inv_l[:, None]
-    if causal:
+    if masked:
         p = jnp.where(allowed, p, 0.0)
     dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
+                             preferred_element_type=jnp.float32,
+                             precision=_dot_prec(q_ref.dtype))
     ds = p * (dp - d[:, None])
     return q, k, g, p, ds
 
@@ -226,6 +258,12 @@ def _bwd_live(offs_ref, qi, kj, tq, tk):
     the forward): the tile pair is dead when the whole K tile lies in the
     future of the last query row."""
     return offs_ref[1] + kj * tk <= offs_ref[0] + qi * tq + tq - 1
+
+
+def _bwd_interior(offs_ref, qi, kj, tq, tk):
+    """K tile entirely in the past of the whole q tile: masking is a no-op
+    (see the forward's three tile classes)."""
+    return offs_ref[1] + kj * tk + tk - 1 <= offs_ref[0] + qi * tq
 
 
 def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
@@ -240,19 +278,23 @@ def _dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
     def _init():
         dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    def body():
+    def body(masked: bool):
         _, k, _, _, ds = _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref,
-                                    g_ref, m_ref, l_ref, d_ref, causal,
+                                    g_ref, m_ref, l_ref, d_ref, masked,
                                     scale)
         dq_ref[0] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(q_ref.dtype)) * scale
 
     if causal:
-        pl.when(_bwd_live(offs_ref, qi, kj, q_ref.shape[1],
-                          k_ref.shape[1]))(body)
+        tq, tk = q_ref.shape[1], k_ref.shape[1]
+        live = _bwd_live(offs_ref, qi, kj, tq, tk)
+        interior = _bwd_interior(offs_ref, qi, kj, tq, tk)
+        pl.when(interior)(lambda: body(False))
+        pl.when(live & ~interior)(lambda: body(True))
     else:
-        body()
+        body(False)
 
 
 def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
@@ -267,22 +309,29 @@ def _dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, d_ref,
         dk_ref[0] = jnp.zeros_like(dk_ref[0])
         dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    def body():
+    def body(masked: bool):
         q, _, g, p, ds = _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref,
-                                    g_ref, m_ref, l_ref, d_ref, causal,
+                                    g_ref, m_ref, l_ref, d_ref, masked,
                                     scale)
         dv_ref[0] += jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(q_ref.dtype))
+        # q is unscaled in the shared tile recompute: apply the score scale
+        # here (dK = dS^T @ (scale * Q))
         dk_ref[0] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=_dot_prec(q_ref.dtype)) * scale
 
     if causal:
-        pl.when(_bwd_live(offs_ref, qi, kj, q_ref.shape[1],
-                          k_ref.shape[1]))(body)
+        tq, tk = q_ref.shape[1], k_ref.shape[1]
+        live = _bwd_live(offs_ref, qi, kj, tq, tk)
+        interior = _bwd_interior(offs_ref, qi, kj, tq, tk)
+        pl.when(interior)(lambda: body(False))
+        pl.when(live & ~interior)(lambda: body(True))
     else:
-        body()
+        body(False)
 
 
 def _lane8(x):  # [B, S, H] -> [B*H, S, 8] (TPU sublane x lane tiling)

@@ -20,13 +20,17 @@ Run on the real chip:  python scripts/convgrad_probe.py
 """
 
 import json
+import os
 import sys
-import time
+import tempfile
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from resnet_profile import device_op_seconds  # noqa: E402
 
 V5E_HBM = 819e9     # bytes/s
 V5E_BF16 = 197e12   # FLOP/s
@@ -50,13 +54,14 @@ def weight_grad(x, dy, k, stride):
     def fwd(w):
         return jax.lax.conv_general_dilated(
             x, w, (stride, stride), [(pad, pad), (pad, pad)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
     w0 = jnp.zeros((k, k, x.shape[-1], dy.shape[-1]), x.dtype)
     _, vjp = jax.vjp(fwd, w0)
     (dw,) = vjp(dy)
-    return dw
+    # real training accumulates dW in f32 (the trace's convert_reduce
+    # fusions); include the convert so the probe matches the step's bucket
+    return dw.astype(jnp.float32)
 
 
 def main() -> int:
@@ -69,13 +74,18 @@ def main() -> int:
         dy = jnp.asarray(rng.randn(B, Ho, Wo, Cout), jnp.bfloat16)
         fn = jax.jit(lambda x, dy: weight_grad(x, dy, k, stride))
         out = fn(x, dy)
-        jax.block_until_ready(out)  # compile
+        float(out[0, 0, 0, 0])  # compile + sync (host transfer: the remote
+        # tunnel can return early from block_until_ready)
+        # Wall-clocking reps over the remote tunnel measures dispatch RTT
+        # (~5-7 ms), not the 0.1-2 ms kernel: read DEVICE time from a
+        # profiler trace instead, like scripts/resnet_profile.py.
         reps = 20
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = fn(x, dy)
-        jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / reps
+        with tempfile.TemporaryDirectory() as td:
+            with jax.profiler.trace(td):
+                for _ in range(reps):
+                    out = fn(x, dy)
+                float(out[0, 0, 0, 0])
+            dt = device_op_seconds(td) / reps
         read_bytes = (x.size + dy.size) * 2            # bf16 operands
         write_bytes = k * k * Cin * Cout * 4           # f32 dW
         gbs = (read_bytes + write_bytes) / dt / 1e9

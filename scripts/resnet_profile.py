@@ -41,12 +41,16 @@ def categorize(name: str) -> str:
     return "other"
 
 
-def parse_trace(trace_dir: str) -> None:
+def iter_device_op_events(trace_dir: str):
+    """Yield (name, args, dur_us) for XLA-op rows on device lanes.
+
+    These are the ONLY rows safe to sum: the steps/modules lanes of the
+    same device pid re-cover the identical time spans and would double-
+    count. Shared by parse_trace and scripts/convgrad_probe.py."""
     files = sorted(glob.glob(
         os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))
     if not files:
-        print("no chrome trace found under", trace_dir)
-        return
+        raise RuntimeError(f"no chrome trace found under {trace_dir}")
     with gzip.open(files[-1], "rt") as f:
         events = json.load(f)["traceEvents"]
     # device lanes: pid whose process_name mentions TPU/device; fall back to
@@ -55,20 +59,33 @@ def parse_trace(trace_dir: str) -> None:
                  for e in events if e.get("name") == "process_name"}
     device_pids = {p for p, n in pid_names.items()
                    if "TPU" in n or "/device" in n.lower()}
-    per_cat = collections.Counter()
-    per_op = collections.Counter()
-    total = 0.0
     for e in events:
         if e.get("ph") != "X" or e.get("pid") not in device_pids:
             continue
-        # XLA op rows live on the "XLA Ops" thread; steps/modules lanes
-        # would double-count the same time
         dur = float(e.get("dur", 0.0))
         name = e.get("name", "")
         args = e.get("args") or {}
         if not (args.get("long_name") or args.get("hlo_category")
                 or name.startswith(("%", "fusion", "convolution", "copy"))):
             continue
+        yield name, args, dur
+
+
+def device_op_seconds(trace_dir: str) -> float:
+    """Total device XLA-op time in seconds (double-count-safe)."""
+    return sum(d for _, _, d in iter_device_op_events(trace_dir)) / 1e6
+
+
+def parse_trace(trace_dir: str) -> None:
+    files = sorted(glob.glob(
+        os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))
+    if not files:
+        print("no chrome trace found under", trace_dir)
+        return
+    per_cat = collections.Counter()
+    per_op = collections.Counter()
+    total = 0.0
+    for name, args, dur in iter_device_op_events(trace_dir):
         cat = args.get("hlo_category") or categorize(name)
         per_cat[cat] += dur
         per_op[name.split(".")[0]] += dur
