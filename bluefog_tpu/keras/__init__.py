@@ -1,0 +1,179 @@
+"""Keras 3 frontend: the TF-family migration target, on the JAX backend.
+
+The reference shipped a TensorFlow frontend — async ``AsyncOpKernel``s
+(tensorflow/mpi_ops.cc:46-212), a TF op surface (tensorflow/mpi_ops.py:
+77-213), and ``DistributedOptimizer`` / ``DistributedGradientTape``
+(tensorflow/optimizers.py:1-203). TF itself has no TPU-native place in
+this stack (MIGRATION.md documents the drop), but the USERS of that
+frontend — people with Keras models and Keras optimizers — do: Keras 3
+runs natively on the JAX backend, and this subpackage gives them the
+reference's high-level surface on top of this framework's compiled ops:
+
+  * :func:`broadcast_variables` — reference tensorflow's
+    ``broadcast_variables`` (utility.py): root rank's weights to all;
+  * :class:`DistributedOptimizer` — the reference TF
+    ``DistributedOptimizer`` semantics (average gradients across ranks
+    before applying, optimizers.py:118-160) plus the decentralized modes
+    this framework adds (``communication_type="neighbor.allreduce"``
+    mixes weights with the topology after each apply, the
+    decentralized-SGD contract);
+  * models are per-rank replicas, exactly like the torch frontend
+    (``bluefog_tpu.torch``) — a controller owns its ranks' replicas and
+    communication is one rank-stacked compiled op per variable.
+
+Requires ``KERAS_BACKEND=jax`` (anything else would put keras tensors on
+a different framework than the mesh); import fails loudly otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+import keras
+
+import bluefog_tpu as _api
+
+if keras.backend.backend() != "jax":  # pragma: no cover - env-dependent
+    raise ImportError(
+        "bluefog_tpu.keras needs the Keras JAX backend; set "
+        "KERAS_BACKEND=jax before importing keras (got "
+        f"'{keras.backend.backend()}')")
+
+__all__ = ["broadcast_variables", "DistributedOptimizer"]
+
+
+def _stacked(models: Sequence["keras.Model"]) -> List[np.ndarray]:
+    """[per-rank model] -> per-variable rank-stacked arrays (positional:
+    keras auto-numbers layer names per replica, so variable PATHS differ
+    across structurally identical models)."""
+    per = [m.trainable_variables + m.non_trainable_variables for m in models]
+    shapes = [tuple(v.shape) for v in per[0]]
+    for vs in per[1:]:
+        if [tuple(v.shape) for v in vs] != shapes:
+            raise ValueError("models must share an identical variable set")
+    return [np.stack([np.asarray(vs[i]) for vs in per])
+            for i in range(len(shapes))]
+
+
+def _write_back(models, mixed: List[np.ndarray]) -> None:
+    for r, m in enumerate(models):
+        for i, v in enumerate(m.trainable_variables
+                              + m.non_trainable_variables):
+            v.assign(mixed[i][r])
+
+
+def broadcast_variables(models, root_rank: int = 0) -> None:
+    """Overwrite every rank's model variables with ``root_rank``'s
+    (reference: tensorflow utility.py broadcast_variables)."""
+    if isinstance(models, keras.Model) or not isinstance(
+            models, (list, tuple)):
+        models = [models]
+    mixed = [np.asarray(_api.broadcast(t, root_rank=root_rank))
+             for t in _stacked(models)]
+    _write_back(models, mixed)
+
+
+class DistributedOptimizer:
+    """Wrap a keras optimizer with cross-rank communication.
+
+    ``communication_type="allreduce"`` (default) averages the incoming
+    gradients across ranks before applying — the reference TF
+    ``DistributedOptimizer`` (tensorflow/optimizers.py:118-160). Gradients
+    arrive per replica: call :meth:`apply_stacked` with one gradient list
+    per replica (``apply_gradients`` is accepted only in the 1-replica
+    case and raises otherwise — a raw per-replica apply would silently
+    skip the communication).
+
+    ``communication_type="neighbor.allreduce"`` applies local gradients
+    untouched and then mixes each variable with the rank's in-neighbors
+    under the current topology — the decentralized family the reference
+    only offered on torch, available to keras here.
+    """
+
+    def __init__(self, optimizer, models,
+                 communication_type: str = "allreduce",
+                 num_steps_per_communication: int = 1) -> None:
+        if isinstance(models, keras.Model):
+            models = [models]
+        if communication_type not in ("allreduce", "neighbor.allreduce"):
+            raise ValueError(f"unknown communication_type "
+                             f"'{communication_type}'")
+        self.models = list(models)
+        # A keras optimizer binds to the variables it was built with, so
+        # per-rank replicas need per-rank optimizer instances. Accept a
+        # zero-arg FACTORY (one instance minted per replica), a list (one
+        # per replica), or a single instance for the 1-replica case.
+        if callable(optimizer) and not isinstance(
+                optimizer, keras.optimizers.Optimizer):
+            self.optimizers = [optimizer() for _ in self.models]
+        elif isinstance(optimizer, (list, tuple)):
+            if len(optimizer) != len(self.models):
+                raise ValueError("need one optimizer per model replica")
+            self.optimizers = list(optimizer)
+        elif len(self.models) == 1:
+            self.optimizers = [optimizer]
+        else:
+            raise ValueError(
+                "pass a zero-arg optimizer factory (e.g. lambda: "
+                "keras.optimizers.SGD(0.1)) or one optimizer per replica "
+                "— a single keras optimizer cannot drive several models")
+        self.communication_type = communication_type
+        self.num_steps_per_communication = num_steps_per_communication
+        self._counter = 0
+
+    @property
+    def optimizer(self):
+        return self.optimizers[0]
+
+    # -- gradient-averaging mode -------------------------------------------
+
+    def apply_stacked(self, grads_per_rank: List[list]) -> None:
+        """Apply per-rank gradient lists (one list per model replica).
+
+        allreduce mode: grads are rank-averaged first (the TF reference's
+        semantic); neighbor mode: applied locally, then weights mix. Both
+        communicate every ``num_steps_per_communication``-th call (local
+        steps in between, like the reference's knob).
+        """
+        if len(grads_per_rank) != len(self.models):
+            raise ValueError("need one gradient list per model replica")
+        self._counter += 1
+        communicate = \
+            self._counter % self.num_steps_per_communication == 0
+        if communicate and self.communication_type == "allreduce":
+            stacked = [np.stack([np.asarray(g[i]) for g in grads_per_rank])
+                       for i in range(len(grads_per_rank[0]))]
+            averaged = [np.asarray(_api.allreduce(s, average=True))
+                        for s in stacked]
+            grads_per_rank = [[a[r] for a in averaged]
+                              for r in range(len(self.models))]
+        for opt, m, grads in zip(self.optimizers, self.models,
+                                 grads_per_rank):
+            opt.apply_gradients(
+                zip([keras.ops.convert_to_tensor(g) for g in grads],
+                    m.trainable_variables))
+        if communicate and self.communication_type == "neighbor.allreduce":
+            mixed = [np.asarray(_api.neighbor_allreduce(t))
+                     for t in _stacked(self.models)]
+            _write_back(self.models, mixed)
+
+    def apply_gradients(self, grads_and_vars) -> None:
+        """Single-replica convenience; multi-replica callers must use
+        :meth:`apply_stacked` (a raw per-replica apply would bypass the
+        cross-rank communication silently)."""
+        if len(self.models) != 1:
+            raise RuntimeError(
+                "apply_gradients on a multi-replica DistributedOptimizer "
+                "would skip communication; use apply_stacked with one "
+                "gradient list per replica")
+        pairs = list(grads_and_vars)
+        self.apply_stacked([[g for g, _ in pairs]])
+
+    def __getattr__(self, name):  # passthrough (learning_rate, ...)
+        if "optimizers" not in self.__dict__:
+            # unpickling probes dunders before __init__ ran; raise rather
+            # than recurse through self.optimizers
+            raise AttributeError(name)
+        return getattr(self.optimizers[0], name)

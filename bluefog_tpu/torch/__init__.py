@@ -1,0 +1,285 @@
+"""Live torch-tensor bindings: drive the framework from a torch loop.
+
+The reference's ``bluefog.torch`` frontend wraps every op for live torch
+tensors (adapter: torch/adapter.h:32-92; op surface: torch/mpi_ops.py) so
+a torch training loop can call ``bf.neighbor_allreduce(p.data)`` directly.
+Round 4 shipped checkpoint-format interop only (utils/torch_interop.py —
+"bring your weights"); this subpackage closes the remaining gap: bring
+your *training loop*.
+
+Mapping to the TPU-native execution model: the reference runs one process
+per rank, so its torch API is per-rank. Here a controller owns one or
+more ranks of the SPMD mesh, and every torch-facing function takes the
+RANK-STACKED view of this controller's ranks (leading dim = ``size()`` in
+single-controller jobs — the same convention as the jax API). Tensors
+convert torch→jax at the boundary (zero-copy where dlpack allows, bf16
+via a bit-level view: numpy has no bfloat16), the op runs as the usual
+compiled SPMD program, and the result converts back to a torch tensor.
+The compute path is unchanged — this is a *frontend*, exactly like the
+reference's torch layer over its C++ core.
+
+Covered surface (reference torch/mpi_ops.py parity where TPU-meaningful):
+collectives (allreduce / neighbor_allreduce / broadcast / allgather /
+neighbor_allgather, with the reference's dynamic-topology kwargs), the
+one-sided window family (win_create/put/get/accumulate/update/free), and
+the high-level hooks torch loops actually use: ``broadcast_parameters`` /
+``broadcast_optimizer_state`` (reference torch/utility.py) and
+``DistributedTorchOptimizer`` — a torch.optim wrapper that mixes
+parameters with the neighbor graph after each ``step()`` (reference
+torch/optimizers.py's CommunicatedOptimizer family).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import torch
+
+import jax
+
+import bluefog_tpu as _api  # the jax-facing surface (parent package)
+from ..ops import windows as _windows
+from ..runtime.state import _global_state
+
+try:  # optional: bf16 bridging
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    ml_dtypes = None
+    _BF16 = None
+
+__all__ = [
+    "to_jax", "to_torch", "allreduce", "neighbor_allreduce", "broadcast",
+    "allgather", "neighbor_allgather", "win_create", "win_put", "win_get",
+    "win_accumulate", "win_update", "win_update_then_collect", "win_free",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "DistributedTorchOptimizer",
+]
+
+
+# ---------------------------------------------------------------------------
+# tensor bridging
+# ---------------------------------------------------------------------------
+
+def to_jax(t):
+    """torch.Tensor (or pytree of them) -> jax array on the rank mesh.
+
+    bf16 crosses as a uint16 bit-view (numpy has no bfloat16 dtype); other
+    dtypes go through numpy, which is zero-copy for contiguous CPU
+    tensors. The result is placed rank-sharded like every op input.
+    """
+    if isinstance(t, dict):
+        return {k: to_jax(v) for k, v in t.items()}
+    if isinstance(t, (list, tuple)):
+        return type(t)(to_jax(v) for v in t)
+    if not isinstance(t, torch.Tensor):
+        return t
+    x = t.detach()
+    if x.device.type != "cpu":
+        x = x.cpu()
+    x = x.contiguous()
+    if x.dtype == torch.bfloat16:
+        if _BF16 is None:  # pragma: no cover
+            raise RuntimeError("bfloat16 bridging needs ml_dtypes")
+        host = x.view(torch.uint16).numpy().view(_BF16)
+    else:
+        host = x.numpy()
+    st = _global_state()
+    return jax.device_put(host, _api.rank_sharding(st.mesh))
+
+
+def to_torch(a) -> torch.Tensor:
+    """jax array (or pytree) -> torch CPU tensor (bf16 preserved)."""
+    if isinstance(a, dict):
+        return {k: to_torch(v) for k, v in a.items()}
+    if isinstance(a, (list, tuple)):
+        return type(a)(to_torch(v) for v in a)
+    host = np.asarray(a)
+    if _BF16 is not None and host.dtype == _BF16:
+        return torch.from_numpy(host.view(np.uint16).copy()).view(
+            torch.bfloat16)
+    # copy: arrays exported by jax are read-only buffers, and torch tensors
+    # aliasing them would warn (and invite undefined behavior on write)
+    return torch.from_numpy(np.ascontiguousarray(host).copy())
+
+
+def _wrap(op):
+    def run(tensor, *args, **kwargs):
+        out = to_torch(op(to_jax(tensor), *args, **kwargs))
+        # restore the caller's dtype: JAX's default config computes f64
+        # inputs in f32 (jax_enable_x64 unset); the torch caller still
+        # gets back the dtype it sent, like the reference frontend
+        if isinstance(tensor, torch.Tensor) and isinstance(
+                out, torch.Tensor) and out.dtype != tensor.dtype:
+            out = out.to(tensor.dtype)
+        return out
+    run.__name__ = op.__name__
+    run.__doc__ = (f"torch frontend of bluefog_tpu.{op.__name__} — accepts "
+                   "and returns torch tensors (see this module's docstring "
+                   "for the rank-stacked convention; float64 computes in "
+                   "f32 unless jax_enable_x64 is set).\n\n" +
+                   (op.__doc__ or ""))
+    return run
+
+
+allreduce = _wrap(_api.allreduce)
+neighbor_allreduce = _wrap(_api.neighbor_allreduce)
+broadcast = _wrap(_api.broadcast)
+allgather = _wrap(_api.allgather)
+neighbor_allgather = _wrap(_api.neighbor_allgather)
+
+
+# ---------------------------------------------------------------------------
+# windows (one-sided) — torch tensors in, torch tensors out
+# ---------------------------------------------------------------------------
+
+def win_create(tensor: torch.Tensor, name: str,
+               zero_init: bool = False) -> bool:
+    return _windows.win_create(to_jax(tensor), name, zero_init=zero_init)
+
+
+def win_put(tensor: torch.Tensor, name: str, **kw) -> int:
+    return _windows.win_put(to_jax(tensor), name, **kw)
+
+
+def win_accumulate(tensor: torch.Tensor, name: str, **kw) -> int:
+    return _windows.win_accumulate(to_jax(tensor), name, **kw)
+
+
+def win_get(name: str, **kw) -> int:
+    return _windows.win_get(name, **kw)
+
+
+def win_update(name: str, **kw) -> torch.Tensor:
+    return to_torch(_windows.win_update(name, **kw))
+
+
+def win_update_then_collect(name: str, **kw) -> torch.Tensor:
+    return to_torch(_windows.win_update_then_collect(name, **kw))
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    return _windows.win_free(name)
+
+
+# ---------------------------------------------------------------------------
+# module / optimizer hooks (reference torch/utility.py + optimizers.py)
+# ---------------------------------------------------------------------------
+
+def _stacked_params(modules) -> Dict[str, torch.Tensor]:
+    """[per-rank nn.Module] -> {name: rank-stacked tensor}."""
+    named = [dict(m.named_parameters()) for m in modules]
+    names = list(named[0])
+    for d in named[1:]:
+        if list(d) != names:
+            raise ValueError("modules must share an identical parameter set")
+    return {nm: torch.stack([d[nm].data for d in named]) for nm in names}
+
+
+def _write_back(modules, mixed: Dict[str, torch.Tensor]) -> None:
+    with torch.no_grad():
+        for r, m in enumerate(modules):
+            for nm, p in m.named_parameters():
+                p.data.copy_(mixed[nm][r])
+
+
+def broadcast_parameters(modules, root_rank: int = 0) -> None:
+    """Overwrite every rank's module parameters with root_rank's.
+
+    ``modules``: one nn.Module per rank this controller owns (a single
+    module is accepted for the 1-rank case). Reference:
+    torch/utility.py broadcast_parameters.
+    """
+    if isinstance(modules, torch.nn.Module):
+        modules = [modules]
+    stacked = _stacked_params(modules)
+    mixed = {nm: broadcast(t, root_rank=root_rank)
+             for nm, t in stacked.items()}
+    _write_back(modules, mixed)
+
+
+def broadcast_optimizer_state(optimizer: "torch.optim.Optimizer", modules,
+                              root_rank: int = 0) -> None:
+    """Broadcast rank ``root_rank``'s optimizer state to every rank.
+
+    With per-rank module replicas, a torch optimizer's per-param state
+    (momentum buffers, Adam moments) is ALSO per-rank: the state entry of
+    rank r's parameter is rank r's state. This stacks each named
+    parameter's state tensors across the replica ranks, broadcasts, and
+    writes root_rank's values back onto every rank's entries — the
+    reference's broadcast_optimizer_state contract (torch/utility.py:
+    137-230) restated for the replica model. Scalar entries (step
+    counters) copy from root_rank directly.
+    """
+    if isinstance(modules, torch.nn.Module):
+        modules = [modules]
+    named = [dict(m.named_parameters()) for m in modules]
+    for nm in named[0]:
+        states = [optimizer.state.get(d[nm]) for d in named]
+        if not states[0]:
+            continue
+        for k, root_v in states[root_rank].items():
+            if isinstance(root_v, torch.Tensor) and root_v.ndim >= 1:
+                stacked = torch.stack([st[k] for st in states])
+                mixed = broadcast(stacked, root_rank=root_rank)
+                for r, st in enumerate(states):
+                    st[k] = mixed[r].clone()
+            else:
+                for st in states:
+                    st[k] = root_v
+
+
+class DistributedTorchOptimizer:
+    """Decentralized wrapper for a torch optimizer driving per-rank modules.
+
+    The reference's ``DistributedNeighborAllreduceOptimizer`` for torch
+    (torch/optimizers.py): after every local ``step()``, each rank's
+    parameters are averaged with its in-neighbors under the current
+    topology. Here the controller owns all of its ranks' module replicas;
+    communication is one rank-stacked neighbor_allreduce per parameter.
+
+    ``num_steps_per_communication`` matches the reference knob (local
+    steps between mixings).
+    """
+
+    def __init__(self, optimizer: "torch.optim.Optimizer", modules,
+                 num_steps_per_communication: int = 1) -> None:
+        if isinstance(modules, torch.nn.Module):
+            modules = [modules]
+        self.optimizer = optimizer
+        self.modules = list(modules)
+        self.num_steps_per_communication = num_steps_per_communication
+        self._counter = 0
+        # dynamic-topology knobs, same surface as the jax optimizers
+        self.self_weight = None
+        self.neighbor_weights = None
+        self.send_neighbors = None
+
+    def zero_grad(self, *a, **k):
+        return self.optimizer.zero_grad(*a, **k)
+
+    def step(self, *a, **k):
+        out = self.optimizer.step(*a, **k)
+        self._counter += 1
+        if self._counter % self.num_steps_per_communication == 0:
+            stacked = _stacked_params(self.modules)
+            # forward whichever knobs are set: static-topology custom
+            # weights are legal without send_neighbors
+            kw = {key: val for key, val in (
+                ("self_weight", self.self_weight),
+                ("neighbor_weights", self.neighbor_weights),
+                ("send_neighbors", self.send_neighbors),
+            ) if val is not None}
+            mixed = {nm: neighbor_allreduce(t, **kw)
+                     for nm, t in stacked.items()}
+            _write_back(self.modules, mixed)
+        return out
+
+    def __getattr__(self, name):  # passthrough (param_groups, state, ...)
+        if "optimizer" not in self.__dict__:
+            # e.g. unpickling probes dunders before __init__ ran; a plain
+            # AttributeError here instead of infinite __getattr__ recursion
+            raise AttributeError(name)
+        return getattr(self.optimizer, name)

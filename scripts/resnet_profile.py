@@ -77,20 +77,19 @@ def device_op_seconds(trace_dir: str) -> float:
 
 
 def parse_trace(trace_dir: str) -> None:
-    files = sorted(glob.glob(
-        os.path.join(trace_dir, "plugins/profile/*/*.trace.json.gz")))
-    if not files:
-        print("no chrome trace found under", trace_dir)
-        return
     per_cat = collections.Counter()
     per_op = collections.Counter()
     total = 0.0
-    for name, args, dur in iter_device_op_events(trace_dir):
-        cat = args.get("hlo_category") or categorize(name)
-        per_cat[cat] += dur
-        per_op[name.split(".")[0]] += dur
-        total += dur
-    print(f"\ndevice op time by category ({files[-1].split('/')[-1]}):")
+    try:
+        for name, args, dur in iter_device_op_events(trace_dir):
+            cat = args.get("hlo_category") or categorize(name)
+            per_cat[cat] += dur
+            per_op[name.split(".")[0]] += dur
+            total += dur
+    except RuntimeError as exc:
+        print(exc)
+        return
+    print(f"\ndevice op time by category ({os.path.basename(trace_dir)}):")
     for cat, dur in per_cat.most_common():
         print(f"  {cat:32s} {dur / 1e3:8.2f} ms  {100 * dur / total:5.1f} %")
     print(f"  {'TOTAL':32s} {total / 1e3:8.2f} ms")

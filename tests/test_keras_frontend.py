@@ -1,0 +1,89 @@
+"""Keras 3 (JAX backend) frontend: the TF-family migration target.
+
+Holds bluefog_tpu.keras to the reference TF frontend's contracts
+(tensorflow/optimizers.py): gradient averaging equals the mean-gradient
+step, broadcast_variables equalizes replicas, and the decentralized mode
+drives replicas toward consensus.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+keras = pytest.importorskip("keras")
+if keras.backend.backend() != "jax":  # pragma: no cover
+    pytest.skip("keras must run the jax backend", allow_module_level=True)
+
+import bluefog_tpu as bf  # noqa: E402
+import bluefog_tpu.keras as bfk  # noqa: E402
+
+N = 8
+
+
+def _models(seed=0):
+    out = []
+    for r in range(N):
+        keras.utils.set_random_seed(seed + r)
+        m = keras.Sequential([keras.layers.Dense(2, use_bias=True)])
+        m.build((None, 4))
+        out.append(m)
+    return out
+
+
+def test_broadcast_variables(bf8):
+    mods = _models()
+    want = [np.asarray(v) for v in mods[3].trainable_variables]
+    bfk.broadcast_variables(mods, root_rank=3)
+    for m in mods:
+        for v, w in zip(m.trainable_variables, want):
+            np.testing.assert_allclose(np.asarray(v), w, atol=1e-6)
+
+
+def test_allreduce_mode_matches_mean_gradient_step(bf8):
+    """Reference TF DistributedOptimizer semantics: applying per-rank
+    grads through the wrapper equals applying the rank-MEAN gradient."""
+    mods = _models(seed=5)
+    bfk.broadcast_variables(mods, root_rank=0)  # identical start
+    opt = bfk.DistributedOptimizer(
+        lambda: keras.optimizers.SGD(0.5), mods,
+        communication_type="allreduce")
+    rng = np.random.RandomState(0)
+    grads_per_rank = [
+        [rng.randn(*v.shape).astype(np.float32)
+         for v in mods[r].trainable_variables]
+        for r in range(N)]
+    w0 = [np.asarray(v) for v in mods[0].trainable_variables]
+    opt.apply_stacked(grads_per_rank)
+    mean_g = [np.mean([grads_per_rank[r][i] for r in range(N)], axis=0)
+              for i in range(len(w0))]
+    for m in mods:  # every replica took the SAME mean-gradient step
+        for v, w, g in zip(m.trainable_variables, w0, mean_g):
+            np.testing.assert_allclose(np.asarray(v), w - 0.5 * g,
+                                       atol=1e-5)
+
+
+def test_neighbor_mode_drives_consensus(bf8):
+    mods = _models(seed=11)
+    opt = bfk.DistributedOptimizer(
+        lambda: keras.optimizers.SGD(0.0), mods,
+        communication_type="neighbor.allreduce")
+    zero = [[np.zeros(v.shape, np.float32) for v in m.trainable_variables]
+            for m in mods]
+    for _ in range(25):
+        opt.apply_stacked(zero)  # lr=0 -> pure consensus mixing
+    w = np.stack([np.asarray(m.trainable_variables[0]) for m in mods])
+    assert np.abs(w - w.mean(axis=0, keepdims=True)).max() < 1e-3
+
+
+def test_validations(bf8):
+    mods = _models()
+    with pytest.raises(ValueError, match="communication_type"):
+        bfk.DistributedOptimizer(lambda: keras.optimizers.SGD(0.1), mods,
+                                 communication_type="bogus")
+    opt = bfk.DistributedOptimizer(lambda: keras.optimizers.SGD(0.1), mods)
+    with pytest.raises(ValueError, match="factory"):
+        bfk.DistributedOptimizer(keras.optimizers.SGD(0.1), mods)
+    with pytest.raises(ValueError, match="one gradient list"):
+        opt.apply_stacked([[np.zeros((4, 2), np.float32)]])

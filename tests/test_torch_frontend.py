@@ -1,0 +1,167 @@
+"""Live torch-tensor frontend: collectives, windows, module hooks.
+
+The reference's torch op suite (torch_ops_test.py / torch_win_ops_test.py)
+drives every op with live torch tensors; these tests hold the new
+``bluefog_tpu.torch`` frontend to the same exactness oracles as the jax
+surface — same values, torch tensors in and out, dtypes preserved
+(incl. bfloat16, which crosses the bridge as a bit-view).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import bluefog_tpu as bf  # noqa: E402
+import bluefog_tpu.torch as bft  # noqa: E402
+from bluefog_tpu import topology as topology_util  # noqa: E402
+
+N = 8
+
+
+def rank_t(width=3, dtype=torch.float32):
+    return (torch.arange(N, dtype=torch.float32)[:, None]
+            * torch.ones(1, width)).to(dtype)
+
+
+def test_roundtrip_dtypes(bf8):
+    for dt in (torch.float32, torch.int32, torch.bfloat16, torch.float16):
+        t = rank_t(dtype=dt)
+        back = bft.to_torch(bft.to_jax(t))
+        assert back.dtype == dt
+        assert torch.equal(back.float(), t.float())
+    # float64: JAX computes in f32 by default (jax_enable_x64 unset); the
+    # raw bridge surfaces that, the OP wrappers restore the caller's dtype
+    t64 = rank_t(dtype=torch.float64)
+    assert bft.to_torch(bft.to_jax(t64)).dtype == torch.float32
+    out = bft.allreduce(t64, average=True)
+    assert out.dtype == torch.float64
+    np.testing.assert_allclose(out.numpy(), 3.5, atol=1e-6)
+
+
+def test_allreduce_torch(bf8):
+    out = bft.allreduce(rank_t(), average=True)
+    assert isinstance(out, torch.Tensor)
+    np.testing.assert_allclose(out.numpy(), 3.5, atol=1e-6)
+
+
+def test_neighbor_allreduce_torch_matches_oracle(bf8):
+    bf.set_topology(topology_util.RingGraph(N))
+    out = bft.neighbor_allreduce(rank_t())
+    for r in range(N):
+        exp = (r + (r - 1) % N + (r + 1) % N) / 3.0
+        np.testing.assert_allclose(out[r].numpy(), exp, atol=1e-5)
+
+
+def test_dynamic_neighbor_allreduce_torch(bf8):
+    sends = {r: [(r + 1) % N] for r in range(N)}
+    out = bft.neighbor_allreduce(
+        rank_t(), self_weight=0.5,
+        neighbor_weights={r: {(r - 1) % N: 0.5} for r in range(N)},
+        send_neighbors=sends)
+    for r in range(N):
+        exp = 0.5 * r + 0.5 * ((r - 1) % N)
+        np.testing.assert_allclose(out[r].numpy(), exp, atol=1e-5)
+
+
+def test_broadcast_allgather_torch(bf8):
+    b = bft.broadcast(rank_t(), root_rank=3)
+    np.testing.assert_allclose(b.numpy(), 3.0, atol=1e-6)
+    g = bft.allgather(rank_t(width=2))
+    assert g.shape == (N, N * 2)
+
+
+def test_bf16_neighbor_allreduce_preserves_dtype(bf8):
+    out = bft.neighbor_allreduce(torch.ones(N, 4, dtype=torch.bfloat16))
+    assert out.dtype == torch.bfloat16
+    np.testing.assert_allclose(out.float().numpy(), 1.0, atol=1e-2)
+
+
+def test_windows_torch(bf8):
+    x = rank_t(width=2)
+    assert bft.win_create(x, "t.win", zero_init=True)
+    try:
+        bft.win_put(x, "t.win")
+        out = bft.win_update("t.win")
+        assert isinstance(out, torch.Tensor)
+        topo = bf.load_topology()
+        for r in range(N):
+            nbrs = topology_util.in_neighbor_ranks(topo, r)
+            want = (x[r] + sum(x[s] for s in nbrs)) / (len(nbrs) + 1)
+            np.testing.assert_allclose(out[r].numpy(), want.numpy(),
+                                       atol=1e-5)
+    finally:
+        bft.win_free("t.win")
+
+
+def _make_modules(seed=0):
+    mods = []
+    for r in range(N):
+        torch.manual_seed(seed + r)
+        mods.append(torch.nn.Linear(4, 2))
+    return mods
+
+
+def test_broadcast_parameters(bf8):
+    mods = _make_modules()
+    want = {nm: p.data.clone() for nm, p in mods[2].named_parameters()}
+    bft.broadcast_parameters(mods, root_rank=2)
+    for m in mods:
+        for nm, p in m.named_parameters():
+            np.testing.assert_allclose(p.data.numpy(), want[nm].numpy(),
+                                       atol=1e-6)
+
+
+def test_distributed_torch_optimizer_mixes_params(bf8):
+    """A real torch loop: per-rank Linear modules, SGD steps, neighbor
+    mixing after each step drives the ranks toward consensus — the
+    reference's decentralized-optimizer contract, live torch end to end."""
+    bf.set_topology(topology_util.ExponentialTwoGraph(N))
+    mods = _make_modules(seed=42)
+    params = [p for m in mods for p in m.parameters()]
+    opt = bft.DistributedTorchOptimizer(
+        torch.optim.SGD(params, lr=0.0), mods)
+    x = torch.randn(16, 4)
+    for _ in range(25):
+        opt.zero_grad()
+        loss = sum(m(x).square().mean() for m in mods)
+        loss.backward()
+        opt.step()  # lr=0 -> pure consensus dynamics
+    w = torch.stack([m.weight.data for m in mods])
+    spread = (w - w.mean(dim=0, keepdim=True)).abs().max()
+    assert float(spread) < 1e-3, float(spread)
+
+
+def test_optimizer_num_steps_per_communication(bf8):
+    mods = _make_modules(seed=7)
+    params = [p for m in mods for p in m.parameters()]
+    opt = bft.DistributedTorchOptimizer(
+        torch.optim.SGD(params, lr=0.0), mods,
+        num_steps_per_communication=3)
+    w0 = mods[0].weight.data.clone()
+    for i in range(2):
+        opt.step()  # steps 1-2: no communication
+        assert torch.equal(mods[0].weight.data, w0)
+    opt.step()  # step 3: mixing happens
+    assert not torch.equal(mods[0].weight.data, w0)
+
+
+def test_broadcast_optimizer_state(bf8):
+    """Momentum buffers really move: divergent per-rank SGD momenta are
+    replaced by root_rank's (the r5 review caught a no-op version that
+    stacked the LOCAL tensor and broadcast it to itself)."""
+    mods = _make_modules(seed=3)
+    params = [p for m in mods for p in m.parameters()]
+    opt = torch.optim.SGD(params, lr=0.1, momentum=0.9)
+    for r, m in enumerate(mods):  # divergent grads -> divergent momenta
+        loss = (m(torch.full((4, 4), float(r + 1))) ** 2).mean()
+        loss.backward()
+    opt.step()
+    named = [dict(m.named_parameters()) for m in mods]
+    key = "weight"
+    mom = lambda r: opt.state[named[r][key]]["momentum_buffer"]  # noqa: E731
+    assert not torch.allclose(mom(0), mom(5))
+    bft.broadcast_optimizer_state(opt, mods, root_rank=5)
+    want = mom(5).clone()
+    for r in range(N):
+        np.testing.assert_allclose(mom(r).numpy(), want.numpy(), atol=1e-6)
