@@ -218,14 +218,27 @@ def broadcast_optimizer_state(optimizer: "torch.optim.Optimizer", modules,
     named = [dict(m.named_parameters()) for m in modules]
     for nm in named[0]:
         states = [optimizer.state.get(d[nm]) for d in named]
-        if not states[0]:
-            continue
+        if not states[root_rank]:
+            continue  # root has nothing to broadcast for this param
+        missing = [r for r, st in enumerate(states) if not st]
+        if missing:
+            raise ValueError(
+                f"optimizer state for parameter '{nm}' exists on rank "
+                f"{root_rank} but not on ranks {missing} — run one "
+                "optimizer step everywhere (or broadcast parameters and "
+                "re-init the optimizer) before broadcasting state")
         for k, root_v in states[root_rank].items():
             if isinstance(root_v, torch.Tensor) and root_v.ndim >= 1:
                 stacked = torch.stack([st[k] for st in states])
                 mixed = broadcast(stacked, root_rank=root_rank)
                 for r, st in enumerate(states):
                     st[k] = mixed[r].clone()
+            elif isinstance(root_v, torch.Tensor):
+                # 0-dim tensors (Adam's 'step') must be CLONED per rank:
+                # aliasing one tensor across ranks would make every
+                # in-place `step += 1` advance a shared counter N times
+                for st in states:
+                    st[k] = root_v.clone()
             else:
                 for st in states:
                     st[k] = root_v

@@ -109,11 +109,13 @@ def main() -> None:
     est = {r: float(x_np[r, 0]) for r in owned}
     # generous margin for loaded CI hosts: the fast controllers' 20 rounds
     # of contended server round-trips must comfortably beat the slow one's
-    # 8 x 1.0 s floor, or the uncoupling assert below flakes
+    # 8 x 2.5 s floor, or the uncoupling assert below flakes (observed at
+    # 1.0 s when the full suite shares this box's single core: 20 rounds
+    # can exceed 8 s under that contention)
     rounds = 8 if pid == 3 else 20
     for _ in range(rounds):
         if pid == 3:
-            time.sleep(1.0)  # the slow controller
+            time.sleep(2.5)  # the slow controller
         p_all = bf.win_associated_p_all("q.ps")
         numer = np.zeros((N, 1), np.float32)
         for r in owned:

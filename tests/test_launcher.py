@@ -348,3 +348,16 @@ def test_one_of_four_crash_detected_by_all_survivors():
         assert f"SURVIVOR_SYNC_RAISED {i}" in outs[i]
     for i in range(4):
         assert f"HEALTHY {i}" in outs[i]
+
+
+@pytest.mark.slow
+def test_torch_frontend_example():
+    """The live-torch-loop consensus example through bfrun --simulate 8."""
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--simulate", "8",
+         "--", sys.executable,
+         str(TESTS.parent / "examples" / "torch_average_consensus.py")],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "TORCH CONSENSUS OK" in out.stdout

@@ -165,3 +165,24 @@ def test_broadcast_optimizer_state(bf8):
     want = mom(5).clone()
     for r in range(N):
         np.testing.assert_allclose(mom(r).numpy(), want.numpy(), atol=1e-6)
+
+
+def test_broadcast_optimizer_state_adam_step_not_aliased(bf8):
+    """Adam's 0-dim 'step' tensors must be CLONED per rank: a shared
+    tensor would advance N times per step (r5 review finding)."""
+    mods = _make_modules(seed=9)
+    params = [p for m in mods for p in m.parameters()]
+    opt = torch.optim.Adam(params, lr=0.01)
+    for r, m in enumerate(mods):
+        ((m(torch.randn(4, 4)) * (r + 1)) ** 2).mean().backward()
+    opt.step()
+    bft.broadcast_optimizer_state(opt, mods, root_rank=0)
+    named = [dict(m.named_parameters()) for m in mods]
+    steps = [opt.state[named[r]["weight"]]["step"] for r in range(N)]
+    assert len({id(s) for s in steps}) == N  # distinct tensor objects
+    for _ in range(2):  # further steps advance every rank's counter by 1
+        for r, m in enumerate(mods):
+            ((m(torch.randn(4, 4)) * (r + 1)) ** 2).mean().backward()
+        opt.step()
+    for r in range(N):
+        assert float(opt.state[named[r]["weight"]]["step"]) == 3.0
