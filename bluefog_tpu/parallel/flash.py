@@ -214,13 +214,16 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
 
 def _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref,
                d_ref, masked: bool, scale: float):
-    """Shared backward-tile recompute: (q*scale, k, v, g, d, P, dS).
+    """Shared backward-tile recompute -> (q, k, g*inv_l, P_unnorm, dS).
 
-    The probability tile P is rebuilt in VMEM from the saved GLOBAL (m, l)
+    The probability tile is rebuilt in VMEM from the saved GLOBAL (m, l)
     row statistics with the same offset-based causal mask as the forward
-    kernel, and dS = P * (dP - D) is the softmax-jacobian product both
-    backward passes consume. One definition keeps the dq and dk/dv kernels
-    (and their masking) from drifting apart."""
+    kernel; the row normalizer rides the RETURNED g (see the inline note)
+    so the [TQ, TK] tile is touched once less, and dS = P * (dP - D) is
+    the softmax-jacobian product both backward passes consume. One
+    definition keeps the dq and dk/dv kernels (and their masking) from
+    drifting apart. q is returned UNSCALED — the dk pass applies the
+    score scale itself."""
     tq = q_ref.shape[1]
     tk = k_ref.shape[1]
     # native-dtype (bf16) dot operands, f32 accumulation — see _kernel; the
@@ -243,14 +246,24 @@ def _bwd_tiles(offs_ref, qi, kj, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref,
             jnp.int32, (tq, tk), 1)
         allowed = q_pos >= k_pos
         s = jnp.where(allowed, s, _NEG)
-    p = jnp.exp(s - m[:, None]) * inv_l[:, None]
+    # VPU saver: the softmax row normalizer inv_l is folded into the
+    # per-ROW quantities instead of the [TQ, TK] tile — p stays
+    # UNNORMALIZED (exp(s - m), in [0, 1] since m is the global row max)
+    # and the returned g is pre-scaled g * inv_l, so
+    #   dP  = g @ V^T           becomes dp' = (g inv_l) @ V^T = dP inv_l
+    #   dS  = P (dP - d)        becomes ds  = p_un (dp' - d inv_l) = dS
+    #   dV += P^T g             becomes      p_un^T (g inv_l)      = dV
+    # — one fewer full-tile elementwise pass per (q, k) tile pair.
+    p = jnp.exp(s - m[:, None])
     if masked:
         p = jnp.where(allowed, p, 0.0)
-    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+    g_scaled = (g.astype(jnp.float32)
+                * inv_l[:, None]).astype(g.dtype)   # [TQ, D]: cheap
+    dp = jax.lax.dot_general(g_scaled, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32,
                              precision=_dot_prec(q_ref.dtype))
-    ds = p * (dp - d[:, None])
-    return q, k, g, p, ds
+    ds = p * (dp - (d * inv_l)[:, None])
+    return q, k, g_scaled, p, ds
 
 
 def _bwd_live(offs_ref, qi, kj, tq, tk):

@@ -1,0 +1,91 @@
+"""Decentralized training of per-rank Keras models (bluefog_tpu.keras).
+
+The reference's TF-frontend story on the Keras 3 JAX backend: per-rank
+Keras replicas train on disjoint synthetic shards with the wrapped
+optimizer averaging gradients across ranks (the reference TF
+``DistributedOptimizer`` semantics), and every replica ends bit-close to
+every other — data parallelism without a torch or TF runtime anywhere.
+
+Run:  KERAS_BACKEND=jax bfrun --simulate 8 -- python examples/keras_mnist.py
+"""
+
+import os as _os
+import sys as _sys
+
+_os.environ.setdefault("KERAS_BACKEND", "jax")
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+
+import numpy as np
+
+import keras
+
+import bluefog_tpu as bf
+import bluefog_tpu.keras as bfk
+
+
+def main() -> None:
+    bf.init()
+    n = bf.size()
+    rng = np.random.RandomState(0)
+    # synthetic 8x8 "digits": each rank sees its own shard
+    xs = rng.randn(n, 256, 64).astype(np.float32)
+    w_true = rng.randn(64, 10).astype(np.float32)
+    ys = np.argmax(np.einsum("rbd,dk->rbk", xs, w_true), axis=-1)
+
+    models = []
+    for r in range(n):
+        keras.utils.set_random_seed(r)  # deliberately divergent init
+        m = keras.Sequential([keras.layers.Dense(32, activation="relu"),
+                              keras.layers.Dense(10)])
+        m.build((None, 64))
+        models.append(m)
+    bfk.broadcast_variables(models, root_rank=0)
+    opt = bfk.DistributedOptimizer(
+        lambda: keras.optimizers.Adam(1e-2), models,
+        communication_type="allreduce")
+
+    loss_fn = keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    # keras-on-jax exposes stateless_call: functional grads via jax. Build
+    # ONE jitted grad function per replica up front — a fresh closure per
+    # step would re-trace 8 ranks x 40 steps times.
+    import jax
+
+    def make_grad_fn(m):
+        ntv = [v.value for v in m.non_trainable_variables]
+
+        def jloss(tv, x, y):
+            logits, _ = m.stateless_call(tv, ntv, x)
+            return loss_fn(y, logits)
+
+        return jax.jit(jax.grad(jloss))
+
+    grad_fns = [make_grad_fn(m) for m in models]
+
+    for step in range(40):
+        grads_per_rank = [
+            [np.asarray(g) for g in grad_fns[r](
+                [v.value for v in models[r].trainable_variables],
+                xs[r], ys[r])]
+            for r in range(n)]
+        opt.apply_stacked(grads_per_rank)
+
+    # all replicas took identical mean-gradient steps from a common init:
+    # they must agree, and fit their shards
+    accs = []
+    for r in range(n):
+        pred = np.argmax(np.asarray(models[r](xs[r])), axis=-1)
+        accs.append(float((pred == ys[r]).mean()))
+    w0 = np.asarray(models[0].trainable_variables[0])
+    spread = max(
+        float(np.abs(np.asarray(m.trainable_variables[0]) - w0).max())
+        for m in models)
+    print(f"ranks: {n} (keras frontend), mean shard accuracy "
+          f"{np.mean(accs):.3f}, replica spread {spread:.2e}")
+    assert spread < 1e-5, spread
+    assert np.mean(accs) > 0.55, accs
+    print("KERAS TRAIN OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -361,3 +361,18 @@ def test_torch_frontend_example():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "TORCH CONSENSUS OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_keras_frontend_example():
+    """The keras data-parallel training example through bfrun --simulate 8."""
+    env = _scrubbed_env()
+    env["KERAS_BACKEND"] = "jax"
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--simulate", "8",
+         "--", sys.executable,
+         str(TESTS.parent / "examples" / "keras_mnist.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "KERAS TRAIN OK" in out.stdout
