@@ -44,6 +44,23 @@ if keras.backend.backend() != "jax":  # pragma: no cover - env-dependent
 __all__ = ["broadcast_variables", "DistributedOptimizer"]
 
 
+def _check_single_controller() -> None:
+    # the keras frontend moves variables through full host stacks; the
+    # local-shard plumbing the torch frontend has (to_jax/to_torch over
+    # owned ranks) is not wired here yet — fail loudly rather than let a
+    # multi-controller job device_put non-addressable rows. Read the
+    # MESH-resolved process count from runtime state: the argless
+    # jax.process_count() reads the default backend, which can be a
+    # single-process accelerator plugin alongside a multi-process CPU mesh
+    # (and touching it can hang when its tunnel is down).
+    from bluefog_tpu.runtime.state import _global_state
+
+    if _global_state().process_count > 1:
+        raise NotImplementedError(
+            "bluefog_tpu.keras currently supports single-controller jobs; "
+            "for multi-controller torch-style loops use bluefog_tpu.torch")
+
+
 def _stacked(models: Sequence["keras.Model"]) -> List[np.ndarray]:
     """[per-rank model] -> per-variable rank-stacked arrays (positional:
     keras auto-numbers layer names per replica, so variable PATHS differ
@@ -67,6 +84,7 @@ def _write_back(models, mixed: List[np.ndarray]) -> None:
 def broadcast_variables(models, root_rank: int = 0) -> None:
     """Overwrite every rank's model variables with ``root_rank``'s
     (reference: tensorflow utility.py broadcast_variables)."""
+    _check_single_controller()
     if isinstance(models, keras.Model) or not isinstance(
             models, (list, tuple)):
         models = [models]
@@ -100,6 +118,7 @@ class DistributedOptimizer:
         if communication_type not in ("allreduce", "neighbor.allreduce"):
             raise ValueError(f"unknown communication_type "
                              f"'{communication_type}'")
+        _check_single_controller()
         self.models = list(models)
         # A keras optimizer binds to the variables it was built with, so
         # per-rank replicas need per-rank optimizer instances. Accept a

@@ -10,13 +10,15 @@ your *training loop*.
 Mapping to the TPU-native execution model: the reference runs one process
 per rank, so its torch API is per-rank. Here a controller owns one or
 more ranks of the SPMD mesh, and every torch-facing function takes the
-RANK-STACKED view of this controller's ranks (leading dim = ``size()`` in
-single-controller jobs — the same convention as the jax API). Tensors
-convert torch→jax at the boundary (zero-copy where dlpack allows, bf16
-via a bit-level view: numpy has no bfloat16), the op runs as the usual
-compiled SPMD program, and the result converts back to a torch tensor.
-The compute path is unchanged — this is a *frontend*, exactly like the
-reference's torch layer over its C++ core.
+RANK-STACKED view of THIS CONTROLLER'S ranks — leading dim = ``size()``
+in single-controller jobs, and the controller's local rank count in
+multi-controller jobs (every controller calls every op, SPMD-style, each
+holding its own rows; results come back as the same local view). Tensors
+convert torch→jax at the boundary (bf16 via a bit-level view: numpy has
+no bfloat16), the op runs as the usual compiled SPMD program, and the
+result converts back to a torch tensor. The compute path is unchanged —
+this is a *frontend*, exactly like the reference's torch layer over its
+C++ core.
 
 Covered surface (reference torch/mpi_ops.py parity where TPU-meaningful):
 collectives (allreduce / neighbor_allreduce / broadcast / allgather /
@@ -63,19 +65,22 @@ __all__ = [
 # tensor bridging
 # ---------------------------------------------------------------------------
 
-def to_jax(t):
-    """torch.Tensor (or pytree of them) -> jax array on the rank mesh.
+def owned_ranks():
+    """Global rank indexes whose devices belong to THIS controller, in
+    global order (== range(size()) in single-controller jobs).
 
-    bf16 crosses as a uint16 bit-view (numpy has no bfloat16 dtype); other
-    dtypes go through numpy, which is zero-copy for contiguous CPU
-    tensors. The result is placed rank-sharded like every op input.
-    """
-    if isinstance(t, dict):
-        return {k: to_jax(v) for k, v in t.items()}
-    if isinstance(t, (list, tuple)):
-        return type(t)(to_jax(v) for v in t)
-    if not isinstance(t, torch.Tensor):
-        return t
+    Delegates to the runtime's ownership helper (the same one the window
+    subsystem uses) with the state's process index, which is already
+    resolved against the MESH's platform — the default backend's index
+    can disagree when an accelerator plugin is registered alongside a
+    CPU mesh."""
+    st = _global_state()
+    from ..runtime import control_plane as _cp
+
+    return _cp.owned_ranks(st.devices, st.process_index)
+
+
+def _np_of(t: "torch.Tensor") -> np.ndarray:
     x = t.detach()
     if x.device.type != "cpu":
         x = x.cpu()
@@ -83,23 +88,62 @@ def to_jax(t):
     if x.dtype == torch.bfloat16:
         if _BF16 is None:  # pragma: no cover
             raise RuntimeError("bfloat16 bridging needs ml_dtypes")
-        host = x.view(torch.uint16).numpy().view(_BF16)
-    else:
-        host = x.numpy()
+        return x.view(torch.uint16).numpy().view(_BF16)
+    return x.numpy()
+
+
+def to_jax(t):
+    """torch.Tensor (or pytree of them) -> global jax array on the mesh.
+
+    ``t`` carries THIS controller's rank rows (leading dim = local rank
+    count); each controller contributes exactly its addressable shards,
+    so the global array assembles without cross-process data movement.
+    bf16 crosses as a uint16 bit-view (numpy has no bfloat16 dtype).
+    """
+    if isinstance(t, dict):
+        return {k: to_jax(v) for k, v in t.items()}
+    if isinstance(t, (list, tuple)):
+        return type(t)(to_jax(v) for v in t)
+    if not isinstance(t, torch.Tensor):
+        return t
+    host = _np_of(t)
     st = _global_state()
-    return jax.device_put(host, _api.rank_sharding(st.mesh))
+    owned = owned_ranks()
+    if host.shape[0] != len(owned):
+        raise ValueError(
+            f"expected this controller's rank-stacked view with leading "
+            f"dim {len(owned)} (its owned ranks), got shape "
+            f"{tuple(host.shape)}")
+    sh = _api.rank_sharding(st.mesh)
+    if len(owned) == st.size:  # single controller: place the whole stack
+        return jax.device_put(host, sh)
+    local_of = {r: i for i, r in enumerate(owned)}
+    shape = (st.size,) + host.shape[1:]
+    return jax.make_array_from_callback(
+        shape, sh, lambda idx: host[local_of[idx[0].start or 0]][None])
 
 
 def to_torch(a) -> torch.Tensor:
-    """jax array (or pytree) -> torch CPU tensor (bf16 preserved)."""
+    """jax array (or pytree) -> torch CPU tensor holding THIS controller's
+    rank rows (the full stack in single-controller jobs; bf16 preserved)."""
     if isinstance(a, dict):
         return {k: to_torch(v) for k, v in a.items()}
     if isinstance(a, (list, tuple)):
         return type(a)(to_torch(v) for v in a)
-    host = np.asarray(a)
+    fresh = False
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        rows = sorted(((s.index[0].start or 0, np.asarray(s.data))
+                       for s in a.addressable_shards), key=lambda p: p[0])
+        host = np.concatenate([v for _, v in rows], axis=0)
+        fresh = True  # concatenate already allocated a writable buffer
+    else:
+        host = np.asarray(a)
     if _BF16 is not None and host.dtype == _BF16:
-        return torch.from_numpy(host.view(np.uint16).copy()).view(
+        u16 = host.view(np.uint16)
+        return torch.from_numpy(u16 if fresh else u16.copy()).view(
             torch.bfloat16)
+    if fresh:
+        return torch.from_numpy(host)
     # copy: arrays exported by jax are read-only buffers, and torch tensors
     # aliasing them would warn (and invite undefined behavior on write)
     return torch.from_numpy(np.ascontiguousarray(host).copy())
