@@ -18,8 +18,11 @@ reference's high-level surface on top of this framework's compiled ops:
     mixes weights with the topology after each apply, the
     decentralized-SGD contract);
   * models are per-rank replicas, exactly like the torch frontend
-    (``bluefog_tpu.torch``) — a controller owns its ranks' replicas and
-    communication is one rank-stacked compiled op per variable.
+    (``bluefog_tpu.torch``) — a controller owns its ranks' replicas
+    (all of them in single-controller jobs, its owned ranks' in
+    multi-controller ones; utils/local_view.py assembles the global
+    arrays from each controller's shards) and communication is one
+    rank-stacked compiled op per variable.
 
 Requires ``KERAS_BACKEND=jax`` (anything else would put keras tensors on
 a different framework than the mesh); import fails loudly otherwise.
@@ -34,6 +37,9 @@ import numpy as np
 import keras
 
 import bluefog_tpu as _api
+from ..utils.local_view import (owned_ranks as _owned_ranks,
+                                to_global as _to_global,
+                                to_local as _to_local)
 
 if keras.backend.backend() != "jax":  # pragma: no cover - env-dependent
     raise ImportError(
@@ -44,27 +50,15 @@ if keras.backend.backend() != "jax":  # pragma: no cover - env-dependent
 __all__ = ["broadcast_variables", "DistributedOptimizer"]
 
 
-def _check_single_controller() -> None:
-    # the keras frontend moves variables through full host stacks; the
-    # local-shard plumbing the torch frontend has (to_jax/to_torch over
-    # owned ranks) is not wired here yet — fail loudly rather than let a
-    # multi-controller job device_put non-addressable rows. Read the
-    # MESH-resolved process count from runtime state: the argless
-    # jax.process_count() reads the default backend, which can be a
-    # single-process accelerator plugin alongside a multi-process CPU mesh
-    # (and touching it can hang when its tunnel is down).
-    from bluefog_tpu.runtime.state import _global_state
-
-    if _global_state().process_count > 1:
-        raise NotImplementedError(
-            "bluefog_tpu.keras currently supports single-controller jobs; "
-            "for multi-controller torch-style loops use bluefog_tpu.torch")
-
-
 def _stacked(models: Sequence["keras.Model"]) -> List[np.ndarray]:
-    """[per-rank model] -> per-variable rank-stacked arrays (positional:
-    keras auto-numbers layer names per replica, so variable PATHS differ
-    across structurally identical models)."""
+    """[per-owned-rank model] -> per-variable LOCAL rank stacks
+    (positional: keras auto-numbers layer names per replica, so variable
+    PATHS differ across structurally identical models)."""
+    owned = _owned_ranks()
+    if len(models) != len(owned):
+        raise ValueError(
+            f"need one model replica per rank this controller owns "
+            f"({len(owned)}), got {len(models)}")
     per = [m.trainable_variables + m.non_trainable_variables for m in models]
     shapes = [tuple(v.shape) for v in per[0]]
     for vs in per[1:]:
@@ -84,11 +78,10 @@ def _write_back(models, mixed: List[np.ndarray]) -> None:
 def broadcast_variables(models, root_rank: int = 0) -> None:
     """Overwrite every rank's model variables with ``root_rank``'s
     (reference: tensorflow utility.py broadcast_variables)."""
-    _check_single_controller()
     if isinstance(models, keras.Model) or not isinstance(
             models, (list, tuple)):
         models = [models]
-    mixed = [np.asarray(_api.broadcast(t, root_rank=root_rank))
+    mixed = [_to_local(_api.broadcast(_to_global(t), root_rank=root_rank))
              for t in _stacked(models)]
     _write_back(models, mixed)
 
@@ -118,7 +111,6 @@ class DistributedOptimizer:
         if communication_type not in ("allreduce", "neighbor.allreduce"):
             raise ValueError(f"unknown communication_type "
                              f"'{communication_type}'")
-        _check_single_controller()
         self.models = list(models)
         # A keras optimizer binds to the variables it was built with, so
         # per-rank replicas need per-rank optimizer instances. Accept a
@@ -164,7 +156,7 @@ class DistributedOptimizer:
         if communicate and self.communication_type == "allreduce":
             stacked = [np.stack([np.asarray(g[i]) for g in grads_per_rank])
                        for i in range(len(grads_per_rank[0]))]
-            averaged = [np.asarray(_api.allreduce(s, average=True))
+            averaged = [_to_local(_api.allreduce(_to_global(s), average=True))
                         for s in stacked]
             grads_per_rank = [[a[r] for a in averaged]
                               for r in range(len(self.models))]
@@ -174,7 +166,7 @@ class DistributedOptimizer:
                 zip([keras.ops.convert_to_tensor(g) for g in grads],
                     m.trainable_variables))
         if communicate and self.communication_type == "neighbor.allreduce":
-            mixed = [np.asarray(_api.neighbor_allreduce(t))
+            mixed = [_to_local(_api.neighbor_allreduce(_to_global(t)))
                      for t in _stacked(self.models)]
             _write_back(self.models, mixed)
 

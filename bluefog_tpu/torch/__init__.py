@@ -43,7 +43,6 @@ import jax
 
 import bluefog_tpu as _api  # the jax-facing surface (parent package)
 from ..ops import windows as _windows
-from ..runtime.state import _global_state
 
 try:  # optional: bf16 bridging
     import ml_dtypes
@@ -53,6 +52,7 @@ except ImportError:  # pragma: no cover - ml_dtypes ships with jax
     _BF16 = None
 
 __all__ = [
+    "owned_ranks",
     "to_jax", "to_torch", "allreduce", "neighbor_allreduce", "broadcast",
     "allgather", "neighbor_allgather", "win_create", "win_put", "win_get",
     "win_accumulate", "win_update", "win_update_then_collect", "win_free",
@@ -65,19 +65,7 @@ __all__ = [
 # tensor bridging
 # ---------------------------------------------------------------------------
 
-def owned_ranks():
-    """Global rank indexes whose devices belong to THIS controller, in
-    global order (== range(size()) in single-controller jobs).
-
-    Delegates to the runtime's ownership helper (the same one the window
-    subsystem uses) with the state's process index, which is already
-    resolved against the MESH's platform — the default backend's index
-    can disagree when an accelerator plugin is registered alongside a
-    CPU mesh."""
-    st = _global_state()
-    from ..runtime import control_plane as _cp
-
-    return _cp.owned_ranks(st.devices, st.process_index)
+from ..utils.local_view import owned_ranks, to_global, to_local  # noqa: E402
 
 
 def _np_of(t: "torch.Tensor") -> np.ndarray:
@@ -97,8 +85,9 @@ def to_jax(t):
 
     ``t`` carries THIS controller's rank rows (leading dim = local rank
     count); each controller contributes exactly its addressable shards,
-    so the global array assembles without cross-process data movement.
-    bf16 crosses as a uint16 bit-view (numpy has no bfloat16 dtype).
+    so the global array assembles without cross-process data movement
+    (utils/local_view.py). bf16 crosses as a uint16 bit-view (numpy has
+    no bfloat16 dtype).
     """
     if isinstance(t, dict):
         return {k: to_jax(v) for k, v in t.items()}
@@ -106,21 +95,7 @@ def to_jax(t):
         return type(t)(to_jax(v) for v in t)
     if not isinstance(t, torch.Tensor):
         return t
-    host = _np_of(t)
-    st = _global_state()
-    owned = owned_ranks()
-    if host.shape[0] != len(owned):
-        raise ValueError(
-            f"expected this controller's rank-stacked view with leading "
-            f"dim {len(owned)} (its owned ranks), got shape "
-            f"{tuple(host.shape)}")
-    sh = _api.rank_sharding(st.mesh)
-    if len(owned) == st.size:  # single controller: place the whole stack
-        return jax.device_put(host, sh)
-    local_of = {r: i for i, r in enumerate(owned)}
-    shape = (st.size,) + host.shape[1:]
-    return jax.make_array_from_callback(
-        shape, sh, lambda idx: host[local_of[idx[0].start or 0]][None])
+    return to_global(_np_of(t))
 
 
 def to_torch(a) -> torch.Tensor:
@@ -130,14 +105,8 @@ def to_torch(a) -> torch.Tensor:
         return {k: to_torch(v) for k, v in a.items()}
     if isinstance(a, (list, tuple)):
         return type(a)(to_torch(v) for v in a)
-    fresh = False
-    if isinstance(a, jax.Array) and not a.is_fully_addressable:
-        rows = sorted(((s.index[0].start or 0, np.asarray(s.data))
-                       for s in a.addressable_shards), key=lambda p: p[0])
-        host = np.concatenate([v for _, v in rows], axis=0)
-        fresh = True  # concatenate already allocated a writable buffer
-    else:
-        host = np.asarray(a)
+    fresh = isinstance(a, jax.Array) and not a.is_fully_addressable
+    host = to_local(a)  # fresh (writable) iff the multi-controller gather
     if _BF16 is not None and host.dtype == _BF16:
         u16 = host.view(np.uint16)
         return torch.from_numpy(u16 if fresh else u16.copy()).view(

@@ -1,0 +1,70 @@
+"""Local rank-stack <-> global rank-stacked array bridging.
+
+The frontends (``bluefog_tpu.torch``, ``bluefog_tpu.keras``) speak in
+THIS controller's rank rows: a host array whose leading dim is the number
+of ranks this controller owns (== ``size()`` in single-controller jobs).
+These helpers move that local view onto the mesh and back:
+
+* :func:`to_global` — assemble the global rank-stacked jax array, each
+  controller contributing exactly its addressable shards (no
+  cross-process data movement);
+* :func:`to_local` — gather a jax array's addressable rows back into the
+  local host stack, in global rank order.
+
+Ownership comes from the runtime's mesh-resolved process index (the same
+helper the window subsystem uses) — never the default backend's, which
+can disagree when an accelerator plugin is registered alongside a CPU
+mesh.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+
+from ..runtime import control_plane as _cp
+from ..runtime.state import _global_state
+
+
+def owned_ranks() -> List[int]:
+    """Global rank indexes whose devices belong to THIS controller."""
+    st = _global_state()
+    return _cp.owned_ranks(st.devices, st.process_index)
+
+
+def to_global(host: np.ndarray):
+    """Local rank-stack (leading dim = owned rank count) -> global array."""
+    st = _global_state()
+    owned = owned_ranks()
+    host = np.asarray(host)
+    if host.shape[0] != len(owned):
+        raise ValueError(
+            f"expected this controller's rank-stacked view with leading "
+            f"dim {len(owned)} (its owned ranks), got shape "
+            f"{tuple(host.shape)}")
+    from ..ops.plan import rank_sharding
+
+    sh = rank_sharding(st.mesh)
+    if len(owned) == st.size:  # single controller: place the whole stack
+        return jax.device_put(host, sh)
+    local_of = {r: i for i, r in enumerate(owned)}
+    shape = (st.size,) + host.shape[1:]
+    return jax.make_array_from_callback(
+        shape, sh, lambda idx: host[local_of[idx[0].start or 0]][None])
+
+
+def to_local(a) -> np.ndarray:
+    """Global jax array -> this controller's rows (host), global order.
+
+    Returns a freshly-allocated writable array in the multi-controller
+    case; the single-controller fast path may return a read-only view of
+    the jax buffer — callers that mutate must copy.
+    """
+    if isinstance(a, jax.Array) and not a.is_fully_addressable:
+        rows = sorted(((s.index[0].start or 0, np.asarray(s.data))
+                       for s in a.addressable_shards), key=lambda p: p[0])
+        return np.concatenate([v for _, v in rows], axis=0)
+    return np.asarray(a)

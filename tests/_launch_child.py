@@ -136,6 +136,37 @@ def main() -> None:
     else:  # pragma: no cover - torch always present in CI image
         print(f"TORCH_MC_SKIP {pid}", flush=True)
 
+    # Keras frontend across controllers (opt-in: the parent must export
+    # KERAS_BACKEND=jax — keras would otherwise try its default backend).
+    try:
+        import keras
+    except ImportError:  # pragma: no cover - keras present in CI image
+        keras = None
+    if keras is not None and os.environ.get("KERAS_BACKEND") == "jax":
+        import bluefog_tpu.keras as bfk
+        from bluefog_tpu.utils.local_view import owned_ranks
+
+        owned_k = owned_ranks()
+        kms = []
+        for r in owned_k:
+            keras.utils.set_random_seed(100 + r)  # divergent across ranks
+            m = keras.Sequential([keras.layers.Dense(2)])
+            m.build((None, 3))
+            kms.append(m)
+        bfk.broadcast_variables(kms, root_rank=1)
+        # rank 1's kernel everywhere: rebuild it on every controller for
+        # the oracle (same seed recipe, global rank 1)
+        keras.utils.set_random_seed(101)
+        ref = keras.Sequential([keras.layers.Dense(2)])
+        ref.build((None, 3))
+        want = np.asarray(ref.trainable_variables[0])
+        for m in kms:
+            np.testing.assert_allclose(
+                np.asarray(m.trainable_variables[0]), want, atol=1e-6)
+        print(f"KERAS_MC_OK {pid}", flush=True)
+    else:  # pragma: no cover - keras present in CI image
+        print(f"KERAS_MC_SKIP {pid}", flush=True)
+
     # Control-plane primitives are live across the two controllers.
     cl = control_plane.client()
     total = cl.fetch_add("smoke.counter", 1)

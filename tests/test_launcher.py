@@ -149,6 +149,7 @@ def test_two_process_launch_smoke(tmp_path):
     """
     env = _scrubbed_env()
     env["SMOKE_CKPT_DIR"] = str(tmp_path / "ck")
+    env["KERAS_BACKEND"] = "jax"  # opt into the keras frontend phase
     # fast heartbeat cadence so the coordinated-shutdown observation at the
     # end of the child doesn't wait out the default 5 s interval
     env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.3"
@@ -158,6 +159,8 @@ def test_two_process_launch_smoke(tmp_path):
         assert f"CHILD_OK {i}" in out
         # live-torch frontend across 2 controllers (skipped if no torch)
         assert (f"TORCH_MC_OK {i}" in out or f"TORCH_MC_SKIP {i}" in out)
+        # keras frontend across 2 controllers (skipped if no keras)
+        assert (f"KERAS_MC_OK {i}" in out or f"KERAS_MC_SKIP {i}" in out)
 
 
 def test_parse_hosts_formats(tmp_path):
