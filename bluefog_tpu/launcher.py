@@ -355,7 +355,13 @@ def main(argv=None) -> int:
     if args.verbose:
         env["BLUEFOG_LOG_LEVEL"] = "debug"
     if args.simulate:
-        env["JAX_PLATFORMS"] = ""
+        # Respect an explicit operator pin (JAX_PLATFORMS=cpu keeps a dev
+        # box off a flaky accelerator tunnel: an unset value makes every
+        # simulated child re-probe the TPU plugin, a multi-minute timeout
+        # when the tunnel is down). Default stays "" — the CPU mesh can
+        # coexist with a working default accelerator backend.
+        if not env.get("JAX_PLATFORMS"):
+            env["JAX_PLATFORMS"] = ""
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "")
             + f" --xla_force_host_platform_device_count={args.simulate}"

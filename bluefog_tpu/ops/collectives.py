@@ -25,6 +25,7 @@ from ..runtime import handles as _handles
 from ..runtime.state import _global_state
 from ..runtime.timeline import timeline_context
 from .neighbors import _auto_name, _check_rank_stacked
+from ..utils.compat import shard_map
 
 
 def _jit_smap(mesh, spec, body):
@@ -38,7 +39,7 @@ def _jit_smap(mesh, spec, body):
     """
 
     def call(leaves):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             body, mesh=mesh,
             in_specs=tuple(spec for _ in leaves),
             out_specs=tuple(spec for _ in leaves),
@@ -253,7 +254,7 @@ def _allgather_v_fn(mesh, sizes: tuple):
         padded = jnp.stack([
             jnp.pad(t, [(0, b_max - t.shape[0])] + pad_trailing) for t in leaves
         ])
-        mapped = jax.shard_map(
+        mapped = shard_map(
             body, mesh=mesh, in_specs=P("rank"), out_specs=P("rank"))
         return mapped(padded)[0]
 

@@ -35,6 +35,7 @@ from ..runtime import handles as _handles
 from ..runtime.state import _global_state
 from ..runtime.timeline import timeline_context
 from .plan import CombinePlan, apply_plan
+from ..utils.compat import shard_map
 
 Weights = Union[float, Dict[int, float]]
 NestedWeights = Union[Dict[int, float], Dict[int, Dict[int, float]]]
@@ -524,7 +525,7 @@ def _hierarchical_fn(mesh, shifts: tuple, n_machines: int):
         return tuple(outs)
 
     def call(w, leaves):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_rank,
             mesh=mesh,
             in_specs=(P(),) + tuple(P(("machine", "local")) for _ in leaves),
@@ -585,7 +586,7 @@ def _gather_exchange_fn(mesh, shifts: tuple, n: int, d_max: int):
         return tuple(outs)
 
     def call(slot, leaves):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_rank,
             mesh=mesh,
             in_specs=(P(),) + tuple(P("rank") for _ in leaves),

@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import topology as topology_util
+from ..utils.compat import shard_map
 
 
 # Accumulate in f32 whenever inputs are lower precision (bf16 params on TPU):
@@ -132,7 +133,7 @@ def _combine_fn(mesh: Mesh, axis: str, shifts: Tuple[int, ...], use_gather: bool
     # shard_map specs must match the number of leaves; rebuild per leaf-count
     # (traced once per shape signature under the jit below).
     def call(w, leaves: Tuple):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_rank,
             mesh=mesh,
             in_specs=(P(),) + tuple(P(axis) for _ in leaves),

@@ -60,9 +60,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import topology as topology_util
 from ..runtime import control_plane as _cp
 from ..runtime import handles as _handles
+from ..runtime.logging import logger
 from ..runtime.state import _global_state
 from ..runtime.timeline import timeline_context
 from .neighbors import _check_rank_stacked, _per_rank
+from ..utils.compat import shard_map
 
 Weights = Union[float, Dict[int, float], Dict[int, Dict[int, float]]]
 
@@ -461,7 +463,11 @@ class Window:
             else jnp.dtype(jnp.float32)
         self.mail_dtype = mail_dtype
         self.row_shape = tuple(tensor.shape[1:])
-        mail_shape = (st.size, d) + self.row_shape
+        # Collective-plane mailboxes carry one extra SCRATCH slot (index
+        # d_max): the compiled exchange redirects inactive-edge writes there
+        # so the put path stays write-only (see _exchange_fn). The hosted
+        # plane's host-side rows don't need it.
+        mail_shape = (st.size, d + 1) + self.row_shape
         self.hosted = _hosted_mode_enabled()
         # Scalar protocols (versions / push-sum p / mutexes): controller-local
         # host memory, or the job-wide control plane when one is attached
@@ -497,8 +503,7 @@ class Window:
                         self._rows[r][None], (d,) + self.row_shape
                     ).astype(mail_dtype).copy()
                     for r in self.owned}
-            for r in self.owned:
-                self._publish_self(r)
+            self._publish_selves(self.owned)
             # creation is aligned across controllers (like MPI_Win_create);
             # data-plane OPS afterwards never barrier — that's the point
             self.host.flush()
@@ -539,6 +544,10 @@ class Window:
         self.state_mu = threading.RLock()
         self._exchange_cache: Dict[Tuple, object] = {}
         self._update_cache: Dict[Tuple, object] = {}
+        # Monotonic deposit sequence for the tagged wire (one counter per
+        # window per controller suffices: every mailbox key has exactly one
+        # writing controller and state_mu serializes its deposits).
+        self._dep_seq = 0
 
     # -- self_value: a property so both planes share the publish contract ---
 
@@ -557,7 +566,7 @@ class Window:
         with self.state_mu:
             for r in self.owned:
                 self._rows[r] = np.asarray(rows[r]).astype(self.dtype)
-                self._publish_self(r)
+            self._publish_selves(self.owned)
 
     # -- hosted-plane internals --------------------------------------------
 
@@ -569,19 +578,33 @@ class Window:
 
     def _publish_self(self, rank: int) -> None:
         """Refresh rank's 'exposed window' copy on the server (win_get)."""
-        _cp.client().put_bytes(self._self_key(rank),
-                               self._rows[rank].tobytes())
+        self._publish_selves([rank])
 
     def _publish_selves(self, ranks) -> None:
-        """Batched publish: all owned rows in one pipelined round-trip."""
+        """Batched publish: all owned rows in one pipelined round-trip.
+
+        Rows go out as uint8 views (always exportable, even for ml_dtypes
+        extension floats) through the native scatter-gather write — a
+        100 MB publish costs zero Python-side copies, where ``tobytes()``
+        duplicated every published byte (this is half the win_update wire
+        traffic at ResNet scale)."""
         ranks = list(ranks)
         if ranks:
             _cp.client().put_bytes_many(
                 [self._self_key(r) for r in ranks],
-                [self._rows[r].tobytes() for r in ranks])
+                [np.ascontiguousarray(self._rows[r]).reshape(-1).view(
+                    np.uint8) for r in ranks])
 
     def _read_remote_self(self, rank: int) -> np.ndarray:
         return self._read_remote_selves([rank])[0]
+
+    def _check_published_len(self, rank: int, nbytes: int) -> None:
+        expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
+            self.dtype.itemsize
+        if nbytes != expect:
+            raise RuntimeError(
+                f"window '{self.name}': published tensor for rank "
+                f"{rank} has {nbytes} bytes, expected {expect}")
 
     def _read_remote_selves(self, ranks) -> List[np.ndarray]:
         """Batched read of published tensors: one pipelined round-trip."""
@@ -590,32 +613,78 @@ class Window:
             return []
         raws = _cp.client().get_bytes_many(
             [self._self_key(r) for r in ranks])
-        expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
-            self.dtype.itemsize
         out = []
         for rank, raw in zip(ranks, raws):
-            if len(raw) != expect:
-                raise RuntimeError(
-                    f"window '{self.name}': published tensor for rank "
-                    f"{rank} has {len(raw)} bytes, expected {expect}")
+            self._check_published_len(rank, len(raw))
             out.append(np.frombuffer(raw, self.dtype).reshape(
-                self.row_shape).copy())
+                self.row_shape))
         return out
+
+    def _read_remote_self_view(self, rank: int):
+        """One published row as a zero-copy array over the native reply.
+
+        Returns ``(row, owner)``; the caller folds the row and then
+        ``owner.close()``. The win_get pipeline uses this per source so
+        the next source's stream overlaps the current source's fold,
+        without ``string_at``-copying 100 MB rows on the way through."""
+        cl = _cp.client()
+        owner = cl._bytes_multi_in_raw(cl._OP_GET_BYTES,
+                                       [self._self_key(rank)])
+        (ln,) = struct.unpack_from("<Q", owner.view, 0)
+        self._check_published_len(rank, ln)
+        row = np.frombuffer(owner.view[8:8 + ln], self.dtype).reshape(
+            self.row_shape)
+        return row, owner
 
     def _fold_record(self, dst: int, k: int, mode: int,
                      contrib: np.ndarray) -> None:
         """Fold one deposit into the local mailbox slot (owner side).
 
         Same cast discipline as the compiled plane: accumulate in the acc
-        dtype, cast back to the mail dtype per record."""
+        dtype, cast back to the mail dtype per record. Wide-enough
+        mailboxes (f32/f64 — the mail dtype IS an acc dtype) fold in one
+        in-place pass instead of the cast-add-cast-store four."""
         acc_t = np.dtype(_win_acc_dtype(self.mail_dtype))
-        cur = self._mail_rows[dst][k]
+        slot = self._mail_rows[dst][k]
         if mode == _DEP_ACC:
-            new = (cur.astype(acc_t) + contrib.astype(acc_t)).astype(
-                self.mail_dtype)
+            if np.dtype(self.mail_dtype) == acc_t:
+                np.add(slot, contrib.astype(acc_t, copy=False), out=slot)
+            else:
+                slot[...] = (slot.astype(acc_t) +
+                             contrib.astype(acc_t)).astype(self.mail_dtype)
         else:
-            new = contrib.astype(self.mail_dtype)
-        self._mail_rows[dst][k] = new
+            np.copyto(slot, contrib, casting="unsafe")
+
+    def _start_deposit(self, pair, rec) -> Optional[_PendingDeposit]:
+        """Parse a deposit's header record into reassembly state.
+
+        Put-mode deposits stream straight into the mailbox slot: the wire
+        dtype always equals the mail dtype (floating windows ship their own
+        dtype; integer windows' mailboxes ARE the f32 acc dtype), so a put
+        is a pure byte copy with no accumulation pass. Accumulate-mode
+        stages into a scratch buffer and folds once complete."""
+        seq = int.from_bytes(rec[:_DEP_TAG], "little") >> 24
+        mode, has_p, pc, _nchunks = struct.unpack_from("<BBdI", rec, _DEP_TAG)
+        if mode == _DEP_PUT:
+            target = self._mail_rows[pair[0]][pair[1]].reshape(-1).view(
+                np.uint8)
+            staging = None
+        else:
+            expect = self._mail_rows[pair[0]][pair[1]].nbytes
+            staging = np.empty(expect, np.uint8)
+            target = staging
+        return _PendingDeposit(mode, has_p, pc, seq, target, staging)
+
+    def _finish_deposit(self, pair, pend: _PendingDeposit) -> None:
+        if pend.mode == _DEP_ACC:
+            wire_t = _win_wire_dtype(self.mail_dtype)
+            contrib = pend.staging.view(wire_t).reshape(self.row_shape)
+            self._fold_record(pair[0], pair[1], _DEP_ACC, contrib)
+        if pend.has_p:
+            if pend.mode == _DEP_ACC:
+                self.host.add_p_mail(pair[0], pair[1], pend.pc)
+            else:
+                self.host.set_p_mail(pair[0], pair[1], pend.pc)
 
     def _drain_deposits(self, strict: bool = False) -> None:
         """Take pending server deposits for every owned rank and fold them
@@ -628,6 +697,22 @@ class Window:
         whose continuation chunks are still in flight from a concurrently
         writing origin is held as partial state and completed by a bounded
         re-poll — never folded torn.
+
+        **Pipelined fold** (r6): after a round that produced records, the
+        NEXT round's take is issued immediately on a prefetch thread, so
+        the server-side gather + socket stream of round i+1 overlaps the
+        fold of round i (the fold-vs-stream split is measured by
+        scripts/win_microbench.py's fold_vs_stream probe). Each record is
+        a zero-copy view into the native reply buffer and is copied
+        exactly once — into the mailbox slot itself for put-mode deposits
+        (wire dtype == mail dtype, no accumulation pass) or an acc-mode
+        staging buffer.
+
+        **Orphan discard** (ADVICE r5 medium): every record carries the
+        server-prefixed deposit tag. A continuation chunk whose (seq,
+        index) doesn't extend the key's pending deposit — the tail a
+        win_free/win_fence clear raced past — is discarded instead of
+        being misparsed as a header.
 
         ``strict`` (caller holds the rank mutexes AND the job opted in via
         ``BLUEFOG_WIN_STRICT=1``): verify the write/read exclusion actually
@@ -645,68 +730,76 @@ class Window:
         cl = _cp.client()
         pairs = [(r, k) for r in self.owned
                  for k in range(self.layout.d_max)]
-        names = [self._dep_key(r, k) for r, k in pairs]
-        wire_t = _win_wire_dtype(self.mail_dtype)
         expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
-            wire_t.itemsize
+            _win_wire_dtype(self.mail_dtype).itemsize
         touched: set = set()
-        # (r, k) -> [mode, has_p, pc, got_bytes, [chunks...], first_seen_ts]
-        partial: Dict[Tuple[int, int], list] = {}
+        partial: Dict[Tuple[int, int], _PendingDeposit] = {}
+        orphans = 0
         drain_timeout = float(os.environ.get(
             "BLUEFOG_WIN_DRAIN_TIMEOUT", "60"))
-        poll_all = True
+
+        def sweep(poll_pairs):
+            poll_names = [self._dep_key(r, k) for r, k in poll_pairs]
+            return (_Prefetch(lambda: cl.take_bytes_many_views(poll_names)),
+                    poll_pairs)
+
+        fetch, fetch_pairs = sweep(pairs)
         while True:
-            if poll_all:
-                poll_pairs, poll_names = pairs, names
-            else:
-                # only the keys holding partial chunk sequences can produce
-                # the awaited continuations; don't sweep owned x d_max keys
-                # 200x/s while waiting on one slow origin
-                poll_pairs = sorted(partial)
-                poll_names = [self._dep_key(r, k) for r, k in poll_pairs]
-            batches = cl.take_bytes_many(poll_names)
-            got = False
-            for pair, records in zip(poll_pairs, batches):
-                if not records:
-                    continue
-                got = True
-                touched.add(pair)
-                pend = partial.pop(pair, None)
-                for rec in records:
-                    if pend is None:
-                        mode, has_p, pc, _nchunks = struct.unpack_from(
-                            "<BBdI", rec)
-                        part = rec[_DEP_HDR:]
-                        pend = [mode, has_p, pc, len(part),
-                                [part] if part else [], time.monotonic()]
-                    else:
-                        pend[3] += len(rec)
-                        pend[4].append(rec)
-                    if pend[3] >= expect:
-                        if pend[3] != expect:
+            batches, owner = fetch.result()
+            cur_pairs, fetch = fetch_pairs, None
+            got = any(batches)
+            if got:
+                # progress: sweep everything once more, streamed WHILE the
+                # records below fold (an empty extra sweep costs one RTT)
+                fetch, fetch_pairs = sweep(pairs)
+            try:
+                for pair, records in zip(cur_pairs, batches):
+                    if not records:
+                        continue
+                    touched.add(pair)
+                    pend = partial.pop(pair, None)
+                    for rec in records:
+                        tag = int.from_bytes(rec[:_DEP_TAG], "little")
+                        seq, idx = tag >> 24, tag & 0xFFFFFF
+                        body = rec[_DEP_TAG + (_DEP_HDR if idx == 0 else 0):]
+                        if idx == 0:
+                            if pend is not None:
+                                # structurally impossible from the clear
+                                # race (a clear eats a deposit's PREFIX);
+                                # belt-and-braces for a corrupted peer
+                                orphans += 1
+                            pend = self._start_deposit(pair, rec)
+                        elif (pend is None or seq != pend.seq
+                                or idx != pend.next_idx):
+                            # orphaned continuation: a win_free/win_fence
+                            # clear consumed this deposit's header + early
+                            # chunks; the tail landed afterwards
+                            orphans += 1
+                            continue
+                        else:
+                            pend.next_idx += 1
+                        blen = len(body)
+                        if pend.got + blen > expect:
                             raise RuntimeError(
                                 f"window '{self.name}': deposit for (rank, "
-                                f"slot) {pair} carries {pend[3]} bytes, "
-                                f"expected {expect} — wire corruption or a "
-                                "mismatched window shape across controllers")
-                        contrib = np.frombuffer(
-                            b"".join(pend[4]), wire_t,
-                        ).reshape(self.row_shape)
-                        self._fold_record(pair[0], pair[1], pend[0], contrib)
-                        if pend[1]:
-                            if pend[0] == _DEP_ACC:
-                                self.host.add_p_mail(pair[0], pair[1],
-                                                     pend[2])
-                            else:
-                                self.host.set_p_mail(pair[0], pair[1],
-                                                     pend[2])
-                        pend = None
-                if pend is not None:
-                    partial[pair] = pend
+                                f"slot) {pair} carries {pend.got + blen} "
+                                f"bytes, expected {expect} — wire "
+                                "corruption or a mismatched window shape "
+                                "across controllers")
+                        if blen:
+                            pend.target[pend.got:pend.got + blen] = \
+                                np.frombuffer(body, np.uint8)
+                            pend.got += blen
+                        if pend.got == expect:
+                            self._finish_deposit(pair, pend)
+                            pend = None
+                    if pend is not None:
+                        partial[pair] = pend
+            finally:
+                owner.close()
             if not partial:
                 if not got:
-                    break
-                poll_all = True
+                    break  # no prefetch outstanding (got False issued none)
                 continue
             # Per-PARTIAL deadline, anchored when that chunk sequence first
             # appeared: progress on unrelated keys must not keep a torn
@@ -714,16 +807,23 @@ class Window:
             # reset a shared clock on every round).
             now = time.monotonic()
             stale = [p for p, pend in partial.items()
-                     if now - pend[5] > drain_timeout]
+                     if now - pend.t0 > drain_timeout]
             if stale:
                 raise RuntimeError(
                     f"window '{self.name}': deposit chunk sequence for "
                     f"(rank, slot) {sorted(stale)} never completed within "
                     f"{drain_timeout:.0f}s — the origin died mid-deposit "
                     "(BLUEFOG_WIN_DRAIN_TIMEOUT)")
-            poll_all = got  # sweep once more after progress, else sit on
-            if not got:     # the partial keys at a gentle cadence
+            if not got:
+                # only the keys holding partial chunk sequences can produce
+                # the awaited continuations; don't sweep owned x d_max keys
+                # 200x/s while waiting on one slow origin
                 time.sleep(0.005)
+                fetch, fetch_pairs = sweep(sorted(partial))
+        if orphans:
+            logger.debug(
+                "window '%s': discarded %d orphaned deposit chunk(s) left "
+                "by a concurrent clear", self.name, orphans)
         if strict and touched:
             stale = sorted(touched)
             vers = self.host.get_versions(stale)
@@ -765,54 +865,100 @@ class Window:
 
     # -- compiled programs -------------------------------------------------
 
-    def _exchange_fn(self, accumulate: bool):
-        """One-program put/get/accumulate: ppermute per shift + slot blend."""
-        key = ("xchg", accumulate)
+    def _exchange_fn(self, accumulate: bool, donate_source: bool = False,
+                     identity_self: bool = False):
+        """One-program put/get/accumulate: ppermute per shift + slot write.
+
+        The mailbox carries one extra SCRATCH slot (index ``d_max``) so the
+        put path can be pure write-only dynamic updates: an inactive edge
+        redirects its write to the scratch slot instead of select-blending
+        against the current slot value. Measured on the CPU mesh, any read
+        of the donated mailbox inside the program (a ``jnp.where`` against
+        ``cur``, a static-slice add) forces XLA into a defensive full-buffer
+        copy per shift — 3-4x the whole op's cost at optimizer scale — while
+        write-only updates alias in place even with a traced slot index.
+        Accumulate must read the current slot by definition; it keeps the
+        read-add-write per shift (and still benefits from the scratch
+        redirect replacing the select).
+
+        ``identity_self``: compile-time specialization for the all-ones
+        self-weight the window optimizers pass on every put — the new self
+        value IS the input, so the program skips a full window-sized
+        multiply + materialize (with ``donate_source`` it aliases
+        outright). ``donate_source``: the caller relinquishes the input
+        buffer (the optimizer's packed fusion buffer is dead after the
+        put), letting XLA reuse it instead of allocating a fresh self
+        tensor.
+        """
+        key = ("xchg", accumulate, donate_source, identity_self)
         fn = self._exchange_cache.get(key)
         if fn is not None:
             return fn
         st = _global_state()
         lay = self.layout
         n, shifts = lay.n, lay.shifts
-        slot_c = np.asarray(lay.slot)  # compile-time const inside the program
+        d_max = lay.d_max
+        slot_c = np.asarray(lay.slot)  # [S, n] compile-time const
 
         def per_rank(x, mail, w, active, self_w):
             me = lax.axis_index("rank")
             xb = x[0]
-            mb = mail[0]
+            mb = mail[0]  # [d_max + 1, ...]; row d_max is scratch
             acc_t = _win_acc_dtype(xb.dtype)
             for si, s in enumerate(shifts):
                 perm = [(i, (i + s) % n) for i in range(n)]
                 moved = lax.ppermute(xb, "rank", perm)  # from (me - s) % n
-                wk = w[si, me].astype(acc_t)
                 ak = active[si, me]
-                k = jnp.asarray(slot_c)[si, me]  # traced const, no eager hop
-                cur = lax.dynamic_index_in_dim(mb, k, axis=0, keepdims=False)
+                # effective weight carries the active mask: an inactive
+                # shift's write is redirected to the scratch slot AND its
+                # payload is zeroed, so the scratch row stays finite and
+                # win_update can contract the full buffer with a zero-padded
+                # weight vector instead of slicing the scratch off (a partial
+                # read would force the defensive copy documented above)
+                wk = (w[si, me] * ak).astype(acc_t)
+                k = jnp.where(ak > 0, jnp.asarray(slot_c)[si, me], d_max)
                 contrib = moved.astype(acc_t) * wk
                 if accumulate:
                     # accumulate in acc_t: bf16 mailboxes would otherwise
                     # round small contributions away (256 + 0.5 -> 256)
+                    cur = lax.dynamic_index_in_dim(mb, k, axis=0,
+                                                   keepdims=False)
                     val = (cur.astype(acc_t) + contrib).astype(mb.dtype)
                 else:
                     val = contrib.astype(mb.dtype)
-                new = jnp.where(ak > 0, val, cur)
-                mb = lax.dynamic_update_index_in_dim(mb, new, k, axis=0)
-            new_self = (xb.astype(acc_t) * self_w[me].astype(acc_t)).astype(xb.dtype)
+                mb = lax.dynamic_update_index_in_dim(mb, val, k, axis=0)
+            if identity_self:
+                new_self = xb
+            else:
+                new_self = (xb.astype(acc_t)
+                            * self_w[me].astype(acc_t)).astype(xb.dtype)
             return new_self[None], mb[None]
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_rank,
             mesh=st.mesh,
             in_specs=(P("rank"), P("rank"), P(), P(), P()),
             out_specs=(P("rank"), P("rank")),
         )
-        fn = jax.jit(mapped)
+        # Donate the mailbox: every caller rebinds win.mail to the output,
+        # and without donation each per-shift dynamic_update materializes a
+        # full mailbox copy (d_max x window bytes x shifts of pure memcpy —
+        # the dominant cost of a collective-plane win_put at optimizer
+        # scale). With donation XLA updates the buffer in place.
+        donate = (0, 1) if donate_source else (1,)
+        fn = jax.jit(mapped, donate_argnums=donate)
         self._exchange_cache[key] = fn
         return fn
 
-    def _update_fn(self):
-        """One-program combine: out = sw*self + nw . mail, + slot reset."""
-        key = ("upd",)
+    def _update_fn(self, reset: bool = False):
+        """One-program combine: out = sw*self + nw . mail, + slot reset.
+
+        Specialized on ``reset``: the no-reset variant returns the mailbox
+        STRUCTURALLY unchanged, which — with the mailbox donated — lets XLA
+        alias the output to the input (zero mailbox traffic) instead of
+        multiplying every slot by a traced all-ones keep mask.
+        """
+        key = ("upd", reset)
         fn = self._update_cache.get(key)
         if fn is not None:
             return fn
@@ -820,31 +966,48 @@ class Window:
 
         def per_rank(self_v, mail, sw, nw, reset_mask):
             me = lax.axis_index("rank")
+            mb = mail[0]          # [d_max + 1, ...]; row d_max is scratch
             sv = self_v[0]
-            mb = mail[0]
             acc_t = _win_acc_dtype(sv.dtype)
-            w_me = nw[me].astype(acc_t)  # [d_max]
+            # Contract the FULL buffer with a zero-padded weight vector: the
+            # scratch row is guaranteed finite (_exchange_fn zeroes inactive
+            # payloads), and slicing it off ([:d_max]) would be a partial
+            # read of the donated buffer — the defensive-copy pathology
+            # _exchange_fn documents.
+            w_me = jnp.concatenate(
+                [nw[me], jnp.zeros((1,), nw.dtype)]).astype(acc_t)
             combined = sw[me].astype(acc_t) * sv.astype(acc_t) + jnp.tensordot(
                 w_me, mb.astype(acc_t), axes=(0, 0))
-            keep = (1.0 - reset_mask[me]).reshape(
-                (mb.shape[0],) + (1,) * (mb.ndim - 1))
-            mail_new = (mb.astype(acc_t) * keep).astype(mb.dtype)
+            if reset:
+                keep = jnp.concatenate(
+                    [1.0 - reset_mask[me], jnp.ones((1,), reset_mask.dtype)]
+                ).reshape((mb.shape[0],) + (1,) * (mb.ndim - 1))
+                mail_new = (mb.astype(acc_t) * keep).astype(mb.dtype)
+            else:
+                mail_new = mb
             return combined.astype(sv.dtype)[None], mail_new[None]
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             per_rank,
             mesh=st.mesh,
             in_specs=(P("rank"), P("rank"), P(), P(), P()),
             out_specs=(P("rank"), P("rank")),
         )
-        fn = jax.jit(mapped)
+        fn = jax.jit(mapped, donate_argnums=(1,))
         self._update_cache[key] = fn
         return fn
 
 
 # Deposit record (hosted plane wire format):
-#   u8 mode | u8 has_p | f64 p_contrib | u32 nchunks | first payload chunk
-# followed by nchunks-1 raw continuation records on the same mailbox key.
+#   i64 tag | u8 mode | u8 has_p | f64 p_contrib | u32 nchunks | payload chunk
+# followed by nchunks-1 ``i64 tag | raw chunk`` continuation records on the
+# same mailbox key. The tag — ``seq << 24 | record_index`` — is supplied to
+# the server per record (kAppendBytesTagged) and prefixed server-side, so
+# the drain can tell a deposit's first record (index 0, carries the header)
+# from a continuation chunk STRUCTURALLY: after win_free/win_fence clears a
+# mailbox mid-deposit, the orphaned continuation chunks that land afterwards
+# are discarded by tag instead of being misparsed as headers (spurious "wire
+# corruption" / 60 s drain timeouts — ADVICE r5 medium).
 # Payload dtype is the WINDOW's own dtype for floating windows (VERDICT r4
 # #1: acc-dtype deposits shipped 2x the bytes for bf16 windows; the
 # reference's wire also carries the tensor's own dtype). Integer windows
@@ -859,7 +1022,73 @@ class Window:
 _DEP_PUT = 0
 _DEP_ACC = 1
 _DEP_HDR = struct.calcsize("<BBdI")
+_DEP_TAG = 8  # server-prefixed i64 tag bytes per stored record
 _DEFAULT_MAX_SENT = 16 << 20
+
+
+def _deposit_tags(seq: int, nrec: int) -> List[int]:
+    """Per-record int64 tags for one deposit: ``seq << 24 | record_index``.
+
+    ``seq`` wraps at 39 bits (uniqueness only matters between ADJACENT
+    deposits on one single-writer key); 24 index bits cover rows up to
+    ~1 PB at the 64 KiB chunk floor."""
+    base = (seq & 0x7FFFFFFFFF) << 24
+    return [base | (i & 0xFFFFFF) for i in range(nrec)]
+
+
+class _Prefetch:
+    """Run ``fn()`` on a worker thread; ``result()`` joins and re-raises.
+
+    The drain/get pipelines use it to stream the NEXT server reply while
+    the current one folds — ctypes releases the GIL inside the native
+    call and numpy releases it for bulk copies, so the overlap is real."""
+
+    __slots__ = ("_t", "_r", "_e")
+
+    def __init__(self, fn) -> None:
+        self._r = self._e = None
+
+        def run():
+            try:
+                self._r = fn()
+            except BaseException as exc:  # noqa: BLE001 — re-raised in result
+                self._e = exc
+
+        self._t = threading.Thread(target=run, name="bf-win-prefetch",
+                                   daemon=True)
+        self._t.start()
+
+    def result(self):
+        self._t.join()
+        if self._e is not None:
+            raise self._e
+        return self._r
+
+
+class _PendingDeposit:
+    """Reassembly state for one in-flight deposit on one mailbox key.
+
+    Chunks copy straight into ``target`` as they arrive (a flat uint8 view
+    of the destination): the mailbox slot itself for put-mode deposits —
+    the wire dtype IS the mail dtype, so a put needs no accumulation pass
+    at all — or a staging buffer for accumulate-mode, folded once complete.
+    This replaces the r5 join-then-frombuffer-then-cast fold (three full
+    copies of every drained byte) with one copy per byte."""
+
+    __slots__ = ("mode", "has_p", "pc", "seq", "next_idx", "got",
+                 "staging", "target", "t0")
+
+    def __init__(self, mode: int, has_p: int, pc: float, seq: int,
+                 target: np.ndarray, staging) -> None:
+        self.mode = mode
+        self.has_p = has_p
+        self.pc = pc
+        self.seq = seq
+        self.next_idx = 1  # record 0 (the header) creates this object
+        self.got = 0
+        self.target = target    # flat uint8 view, len == expected bytes
+        self.staging = staging  # acc-mode staging array (None for put)
+        self.t0 = time.monotonic()
 
 
 def _win_wire_dtype(mail_dtype):
@@ -870,9 +1099,31 @@ def _win_wire_dtype(mail_dtype):
         _win_acc_dtype(mail_dtype))
 
 
+_sent_clamp_warned = False
+
+
 def _max_sent_bytes() -> int:
-    return max(1 << 16, int(os.environ.get(
-        "BLUEFOG_MAX_WIN_SENT_LENGTH", str(_DEFAULT_MAX_SENT))))
+    raw = os.environ.get("BLUEFOG_MAX_WIN_SENT_LENGTH")
+    if raw is None:
+        return _DEFAULT_MAX_SENT
+    v = int(raw)
+    if v < (1 << 16):
+        # Unit change vs the reference (mpi_controller.cc:41-46): there the
+        # knob counted ELEMENTS, here it counts BYTES. A sub-64 KiB value is
+        # almost certainly a migrated element-count config (e.g. the
+        # reference default 20000); warn once instead of silently chunking
+        # at the clamp floor (docs/env_variables.md, MIGRATION.md).
+        global _sent_clamp_warned
+        if not _sent_clamp_warned:
+            _sent_clamp_warned = True
+            logger.warning(
+                "BLUEFOG_MAX_WIN_SENT_LENGTH=%d is below the 64 KiB clamp "
+                "floor and will be clamped. Note the unit changed vs the "
+                "reference BlueFog: this knob now counts BYTES per wire "
+                "chunk, not elements — a migrated element-count config "
+                "should be multiplied by the element size (see "
+                "MIGRATION.md).", v)
+    return max(1 << 16, v)
 
 
 def _pack_deposit(mode: int, has_p: int, pc: float, payload) -> List:
@@ -912,15 +1163,21 @@ def _precheck_mailbox_cap(win: Window, dep_names, dep_blobs,
     race-free because each mailbox key has exactly ONE writer (slot (dst,
     k) maps 1:1 to a source rank owned by this controller) and the owner's
     drain only shrinks the box — a stale read is always conservative in
-    the safe direction (pending can only have gone DOWN since)."""
-    cap = int(float(os.environ.get(
-        "BLUEFOG_CP_MAILBOX_MAX_MB", "256")) * (1 << 20))
+    the safe direction (pending can only have gone DOWN since).
+
+    The cap value comes from the SERVING process (published at server
+    startup under a well-known kv key) rather than this origin's local
+    env: a cross-host ``BLUEFOG_CP_MAILBOX_MAX_MB`` mismatch would
+    otherwise let the origin's pre-check pass while the server's real cap
+    tears a multi-record deposit mid-sequence (ADVICE r5 low)."""
+    cap = _cp.mailbox_cap_bytes()
     if cap <= 0:
         return set()
     sizes: Dict[str, int] = {}
     edge_of: Dict[str, Tuple[int, int, int]] = {}
     for nm, blob, edge in zip(dep_names, dep_blobs, dep_edge_of):
-        sizes[nm] = sizes.get(nm, 0) + _blen(blob)
+        # + _DEP_TAG: the server stores the tag prefix in the same box
+        sizes[nm] = sizes.get(nm, 0) + _blen(blob) + _DEP_TAG
         edge_of[nm] = edge
     # a single deposit larger than the cap can NEVER land, drained or not
     # — that's a configuration error, not a dead-owner symptom; diagnose
@@ -1144,6 +1401,7 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 # mpi_controller.cc:932-1034). Local folds stay in acc_t.
                 dep_names: List[str] = []
                 dep_blobs: List = []  # bytes headers + zero-copy np views
+                dep_tags: List[int] = []  # (seq, index) per record
                 dep_edge_of: List[Tuple[int, int, int]] = []  # per record
                 deposited = set()
                 try:
@@ -1171,8 +1429,11 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                     np.ascontiguousarray(
                                         contrib.astype(wire_t, copy=False)))
                                 key = win._dep_key(dst, k)
+                                win._dep_seq += 1
                                 dep_names.extend([key] * len(recs))
                                 dep_blobs.extend(recs)
+                                dep_tags.extend(
+                                    _deposit_tags(win._dep_seq, len(recs)))
                                 dep_edge_of.extend(
                                     [(src, dst, k)] * len(recs))
                         # post-send self scaling (push-sum down-weighting)
@@ -1188,10 +1449,11 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                     if dep_edge_of[i] not in full]
                             dep_names = [dep_names[i] for i in keep]
                             dep_blobs = [dep_blobs[i] for i in keep]
+                            dep_tags = [dep_tags[i] for i in keep]
                             dep_edge_of = [dep_edge_of[i] for i in keep]
                     if dep_names:
-                        replies = _cp.client().append_bytes_many(
-                            dep_names, dep_blobs)
+                        replies = _cp.client().append_bytes_tagged_many(
+                            dep_names, dep_blobs, dep_tags)
                         # backstop only: the pre-check above keeps the
                         # server cap from ever tearing a multi-record
                         # deposit; a -2 here means the client's
@@ -1234,29 +1496,47 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
             else:
                 # pull each in-edge source's published tensor into MY
                 # mailbox; a get may read a REMOTE source's p scalar.
-                # Remote rows are prefetched in ONE pipelined round-trip.
                 p_all = win.host.read_p() if use_p else None
                 remote_srcs = sorted({
                     src for dst in win.owned for src in range(win.size)
                     if src not in owned and table[src].get(dst) is not None})
-                fetched = dict(zip(remote_srcs,
-                                   win._read_remote_selves(remote_srcs)))
                 pulled = []
-                for dst in win.owned:
-                    for src in range(win.size):
+
+                def fold_src(src, val):
+                    contrib_base = val.astype(acc_t, copy=False)
+                    for dst in win.owned:
                         wt = table[src].get(dst)
                         if wt is None:
                             continue
                         k = win.layout.slot_of[dst][src]
-                        val = (win._rows[src] if src in owned
-                               else fetched[src])
                         win._fold_record(dst, k, _DEP_PUT,
-                                         val.astype(acc_t) * np.asarray(
-                                             wt, acc_t))
+                                         contrib_base * np.asarray(wt, acc_t))
                         if use_p:
                             win.host.set_p_mail(dst, k,
                                                 float(p_all[src] * wt))
                         pulled.append((dst, k))
+
+                for src in sorted(owned):
+                    if any(table[src].get(dst) is not None
+                           for dst in win.owned):
+                        fold_src(src, win._rows[src])
+                # Remote rows: per-source zero-copy fetches chained through
+                # a prefetch thread, so source i+1 STREAMS while source i
+                # FOLDS (the r5 single bulk read serialized the full
+                # 2x-row stream ahead of any fold work — win_get ran at
+                # 31-39 % of the raw-get transport ceiling).
+                nxt = (_Prefetch(lambda s=remote_srcs[0]:
+                                 win._read_remote_self_view(s))
+                       if remote_srcs else None)
+                for j, src in enumerate(remote_srcs):
+                    row, owner = nxt.result()
+                    nxt = (_Prefetch(lambda s=remote_srcs[j + 1]:
+                                     win._read_remote_self_view(s))
+                           if j + 1 < len(remote_srcs) else None)
+                    try:
+                        fold_src(src, row)
+                    finally:
+                        owner.close()
                 win.host.bump_versions(pulled)
     finally:
         if require_mutex:
@@ -1267,7 +1547,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
 
 
 def _do_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
-                 require_mutex: bool, activity: str, from_get: bool = False):
+                 require_mutex: bool, activity: str, from_get: bool = False,
+                 donate_source: bool = False):
     if win.hosted:
         return _hosted_exchange(win, tensor, table, sw_list, accumulate,
                                 require_mutex, activity, from_get)
@@ -1284,7 +1565,16 @@ def _do_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
     # eager jnp.asarray would round-trip them through the default device.
     source = None if from_get else tensor  # get reads under lock
     sw_arr = np.asarray(sw_list, np.float32)
-    fn = win._exchange_fn(accumulate)
+    # Compile-time specializations, gated on donate_source so the default
+    # path keeps its ONE compiled variant (specializing on runtime weight
+    # values would double every test/user compile). A donated source must
+    # not be a get's x (win.self_value survives the op); with it, all-ones
+    # self weights make the program's self output a pure alias of the
+    # donated input — the optimizer-gossip put drops a full window of
+    # alloc+copy.
+    donate = donate_source and not from_get
+    identity_self = donate and bool(np.all(sw_arr == 1.0))
+    fn = win._exchange_fn(accumulate, donate, identity_self)
     _acquire(win, touched, require_mutex)
     try:
         with timeline_context(win.name, activity), win.state_mu:
@@ -1314,12 +1604,18 @@ def win_put_nonblocking(
     self_weight: Optional[Weights] = None,
     dst_weights: Optional[Weights] = None,
     require_mutex: bool = False,
+    donate_source: bool = False,
 ) -> int:
     """Write ``tensor[src] * w`` into each destination's mailbox slot for src.
 
     After the sends, the locally stored window tensor becomes
     ``tensor * self_weight`` (the reference's in-place post-send scaling,
     mpi_ops.py:1036-1073).
+
+    ``donate_source``: the caller relinquishes ``tensor`` (its buffer may
+    be reused by the compiled exchange — read it again and jax raises a
+    deleted-buffer error). The window optimizers pass this for their
+    packed fusion buffers, which are dead after the put.
     """
     win = _get_window(name)
     st = _global_state()
@@ -1327,12 +1623,14 @@ def win_put_nonblocking(
     table = _edge_weights(dst_weights, win.out_neighbors, 1.0, "dst_weights", st.size)
     sw = _per_rank(1.0 if self_weight is None else self_weight, st.size, "self_weight")
     return _do_exchange(win, tensor, table, sw, accumulate=False,
-                        require_mutex=require_mutex, activity="WIN_PUT")
+                        require_mutex=require_mutex, activity="WIN_PUT",
+                        donate_source=donate_source)
 
 
 def win_put(tensor, name: str, self_weight=None, dst_weights=None,
-            require_mutex: bool = False) -> bool:
-    handle = win_put_nonblocking(tensor, name, self_weight, dst_weights, require_mutex)
+            require_mutex: bool = False, donate_source: bool = False) -> bool:
+    handle = win_put_nonblocking(tensor, name, self_weight, dst_weights,
+                                 require_mutex, donate_source)
     return win_wait(handle)
 
 
@@ -1342,22 +1640,26 @@ def win_accumulate_nonblocking(
     self_weight: Optional[Weights] = None,
     dst_weights: Optional[Weights] = None,
     require_mutex: bool = False,
+    donate_source: bool = False,
 ) -> int:
     """Add ``tensor[src] * w`` into each destination's mailbox slot (SUM only,
-    like the reference, mpi_ops.py:1168-1213)."""
+    like the reference, mpi_ops.py:1168-1213). ``donate_source`` as in
+    :func:`win_put_nonblocking`."""
     win = _get_window(name)
     st = _global_state()
     _check_rank_stacked(tensor, st.size, "win_accumulate")
     table = _edge_weights(dst_weights, win.out_neighbors, 1.0, "dst_weights", st.size)
     sw = _per_rank(1.0 if self_weight is None else self_weight, st.size, "self_weight")
     return _do_exchange(win, tensor, table, sw, accumulate=True,
-                        require_mutex=require_mutex, activity="WIN_ACCUMULATE")
+                        require_mutex=require_mutex, activity="WIN_ACCUMULATE",
+                        donate_source=donate_source)
 
 
 def win_accumulate(tensor, name: str, self_weight=None, dst_weights=None,
-                   require_mutex: bool = False) -> bool:
+                   require_mutex: bool = False,
+                   donate_source: bool = False) -> bool:
     handle = win_accumulate_nonblocking(
-        tensor, name, self_weight, dst_weights, require_mutex
+        tensor, name, self_weight, dst_weights, require_mutex, donate_source
     )
     return win_wait(handle)
 
@@ -1459,7 +1761,7 @@ def win_update(
         _acquire(win, range(n), require_mutex)
         win.state_mu.acquire()
         try:
-            fn = win._update_fn()
+            fn = win._update_fn(reset)
             result, new_mail = fn(
                 win.self_value, win.mail,
                 np.asarray(sw_list, np.float32), np.asarray(nw),
@@ -1515,13 +1817,22 @@ def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
                 p_mail = win.host.read_p_mail_owned()
             results: Dict[int, np.ndarray] = {}
             for r in win.owned:
-                combined = np.asarray(sw_list[r], acc_t) * \
-                    win._rows[r].astype(acc_t)
+                # fewest full-row passes (this loop is ~10 % of a 100 MB
+                # win_update): the multiply reads the stored dtype straight
+                # into the acc dtype (no same-dtype .astype copy), each
+                # edge folds as one multiply + one in-place add, and the
+                # final cast is a no-op view when the window dtype IS the
+                # acc dtype (f32/f64 windows)
+                combined = np.multiply(
+                    win._rows[r], np.asarray(sw_list[r], acc_t),
+                    dtype=acc_t)
                 for src, wt in nw_table.get(r, {}).items():
                     k = lay.slot_of[r][src]
-                    combined = combined + np.asarray(wt, acc_t) * \
-                        win._mail_rows[r][k].astype(acc_t)
-                results[r] = combined.astype(win.dtype)
+                    np.add(combined,
+                           np.multiply(win._mail_rows[r][k],
+                                       np.asarray(wt, acc_t), dtype=acc_t),
+                           out=combined)
+                results[r] = combined.astype(win.dtype, copy=False)
                 if reset:
                     keep = (1.0 - read_mask[r]).reshape(
                         (lay.d_max,) + (1,) * len(win.row_shape))
@@ -1535,21 +1846,30 @@ def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
                 win.host.write_p_mail_rows({
                     r: p_mail[r] * (1.0 - read_mask[r].astype(np.float64))
                     for r in win.owned})
+            pub = None
             if not clone:
                 for r in win.owned:
                     win._rows[r] = results[r]
-                    win._publish_self(r)
                 if use_p:
                     win.host.write_p_entries({
                         r: float(sw_list[r]) * p_own[r] + float(
                             np.sum(nw[r].astype(np.float64) * p_mail[r]))
                         for r in win.owned})
+                # stream the publish while the result assembles below (a
+                # 100 MB publish is most of a win_update's non-drain wall
+                # time); joined before the locks release, so mutex-holding
+                # readers still see the new value strictly after this
+                # update
+                pub = _Prefetch(lambda: win._publish_selves(win.owned))
+            out = _assemble_global(win, results)
+            if pub is not None:
+                pub.result()
         finally:
             win.state_mu.release()
             if require_mutex:
                 for r in reversed(win.owned):
                     win.host.mutex_release(r)
-    return _assemble_global(win, results)
+    return out
 
 
 def win_update_then_collect(name: str, require_mutex: bool = True):

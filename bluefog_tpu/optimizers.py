@@ -55,6 +55,7 @@ or ``loss_fn(params, model_state, batch) -> (loss, (model_state, aux))`` with
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -74,6 +75,7 @@ from .ops.neighbors import _dynamic_weight_matrix, _static_weight_matrix
 from .ops.plan import CombinePlan, spmd_combine
 from .runtime.state import _global_state
 from .runtime.timeline import timeline_context
+from .utils.compat import shard_map
 
 
 @struct.dataclass
@@ -175,7 +177,7 @@ def build_fused_step(mesh, kind: str, loss, opt, plan: Optional[CombinePlan]):
                 _restack(metrics))
 
     spec = P(mesh.axis_names)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_rank,
         mesh=mesh,
         in_specs=(P(), spec, spec, spec, spec),
@@ -229,7 +231,7 @@ def build_sharded_step(mesh, loss, opt):
                 _restack(metrics))
 
     spec = P(mesh.axis_names)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_rank,
         mesh=mesh,
         in_specs=(P(), spec, spec, spec, spec),
@@ -474,7 +476,7 @@ class DistributedShardedAllreduceOptimizer(_FusedOptimizer):
             return _restack(opt.init(shard))
 
         spec = P(mesh.axis_names)
-        opt_state = jax.jit(jax.shard_map(
+        opt_state = jax.jit(shard_map(
             per_rank, mesh=mesh, in_specs=(spec,), out_specs=spec))(params_r)
         return TrainState(
             params=params_r,
@@ -500,14 +502,20 @@ class _WindowOptimizer(_FusedOptimizer):
     through the mailbox window subsystem (reference: _DistributedWinOptimizer,
     optimizers.py:465-621).
 
-    **Fusion**: parameter leaves are batched into packed ``[n, total]``
-    exchange buffers of up to ``BLUEFOG_FUSION_THRESHOLD`` bytes each
-    (ops/fusion.py; the analog of the reference's fusion buffer,
-    tensor_queue.cc:127-155) — one window and therefore ONE compiled
-    put+update pair per group per gossip step, instead of the reference's
-    per-parameter win_create (optimizers.py:509-520). A ResNet-50 gossips in
-    ~13 programs at the default 8 MB threshold rather than ~320. Set the
-    threshold to 0 to recover per-leaf windows.
+    **One-program gossip** (whenever ``BLUEFOG_FUSION_THRESHOLD`` > 0): the
+    WHOLE parameter tree packs into a single flat ``[n, total]`` window, so
+    a gossip step dispatches exactly ONE win_put/win_accumulate + ONE
+    win_update program pair — where r5 dispatched one pair per 8 MB fusion
+    group (a ResNet-50 gossiped in ~13 pairs; measured 10.6x dispatch-bound
+    over a high-latency link, PERF.md r5). The per-rank window mutexes are
+    acquired ONCE around the put+update pair instead of once per op — the
+    inner ops' acquires are local depth bumps, so the hosted plane pays one
+    server lock round per step. Host version bookkeeping is already one
+    pipelined round-trip per op. Mixed-dtype parameter trees promote to the
+    widest leaf dtype inside the packed window (the gossip average is
+    computed in that dtype and cast back per leaf on unpack); set the
+    threshold to 0 to recover the r5 per-leaf windows and per-leaf
+    dtype-true wire.
     """
 
     _comm_kind = "none"
@@ -528,11 +536,18 @@ class _WindowOptimizer(_FusedOptimizer):
         state = super().init(params, model_state)
         leaves, self._treedef = jax.tree_util.tree_flatten(state.params)
         thr = _global_state().config.fusion_threshold_bytes
-        self._groups = _fusion.group_leaves(leaves, thr)
+        # threshold > 0: ONE window over the whole tree (one put+update
+        # program pair per gossip step); <= 0: per-leaf windows (the r5
+        # escape hatch — per-leaf dtype-true wire, one pair per leaf)
+        if thr > 0:
+            self._groups = [list(range(len(leaves)))]
+        else:
+            self._groups = [[i] for i in range(len(leaves))]
         self._specs = [
             _fusion.make_spec([leaves[i] for i in idxs])
             for idxs in self._groups
         ]
+        self._fused_pack = len(self._groups) == 1
         self._win_names = [
             f"{self._prefix}.{gi}" for gi in range(len(self._groups))]
         for nm, idxs, spec in zip(self._win_names, self._groups, self._specs):
@@ -564,31 +579,66 @@ class _WindowOptimizer(_FusedOptimizer):
     def _gossip(self, buffers):  # packed [n, total] buffers -> mixed buffers
         raise NotImplementedError
 
+    def _gossip_peers(self, win, owned):
+        """Remote ranks whose mutexes this controller's gossip ops lock
+        (superset of every inner op's lock set — the hoisted acquisition
+        must cover them all or the inner ops would acquire out of global
+        sorted order). Put-family ops lock write destinations."""
+        return {d for s in owned for d in win.out_neighbors[s]}
+
+    def _hoisted_mutex(self, name):
+        """One mutex acquisition for the whole put+update pair.
+
+        The inner ops still pass ``require_mutex=True``; their acquires are
+        local depth bumps on the already-held locks (no server round-trip),
+        so strict-mode drains keep working while the hosted plane pays ONE
+        lock round per step instead of one per op."""
+        if not self.require_mutex:
+            return contextlib.nullcontext()
+        win = _windows._get_window(name)
+        if not win.hosted:
+            ranks = range(win.size)
+        else:
+            owned = set(win.owned)
+            ranks = sorted(owned | self._gossip_peers(win, owned))
+        return _windows.win_mutex(name, ranks=ranks)
+
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
         self._counter += 1
+        do_comm = (self._counter % self.num_steps_per_communication) == 0
         with timeline_context(self.name, "STEP"):
             state, metrics = self._local_step(state, batch)
-            if (self._counter % self.num_steps_per_communication) == 0:
-                leaves = jax.tree_util.tree_flatten(state.params)[0]
-                # PACK/UNPACK sub-spans: fusion-buffer copy time, the analog
-                # of the reference's MEMCPY_IN/OUT_FUSION_BUFFER activities
-                # (common/timeline.cc usage, mpi_controller.cc:276-292) —
-                # without them the host cost of fusion is invisible next to
-                # the COMMUNICATE spans.
-                with timeline_context(self.name, "PACK"):
-                    packed = [
-                        _fusion.pack_jit([leaves[i] for i in idxs], spec)
-                        for idxs, spec in zip(self._groups, self._specs)
-                    ]
+            if not do_comm:
+                return state, metrics
+            leaves = jax.tree_util.tree_flatten(state.params)[0]
+            # PACK/UNPACK sub-spans: fusion-buffer copy time, the analog
+            # of the reference's MEMCPY_IN/OUT_FUSION_BUFFER activities
+            # (common/timeline.cc usage, mpi_controller.cc:276-292) —
+            # without them the host cost of fusion is invisible next to
+            # the COMMUNICATE spans. (Packing inside the step program was
+            # tried and measured ~45 ms SLOWER at MLP scale on the CPU
+            # mesh: the in-program concat defeats the donated in-place
+            # optimizer update.)
+            with timeline_context(self.name, "PACK"):
+                packed = [
+                    _fusion.pack_jit([leaves[i] for i in idxs], spec)
+                    for idxs, spec in zip(self._groups, self._specs)
+                ]
+            if self._fused_pack:
+                # single window: one mutex acquisition spans the whole
+                # put+update pair (inner acquires are local depth bumps)
+                with self._hoisted_mutex(self._win_names[0]):
+                    mixed = self._gossip(packed)
+            else:
                 mixed = self._gossip(packed)
-                with timeline_context(self.name, "UNPACK"):
-                    out = list(leaves)
-                    for idxs, spec, buf in zip(self._groups, self._specs,
-                                               mixed):
-                        for i, v in zip(idxs, _fusion.unpack_jit(buf, spec)):
-                            out[i] = v
-                params = jax.tree_util.tree_unflatten(self._treedef, out)
-                state = TrainState(params, state.opt_state, state.model_state)
+            with timeline_context(self.name, "UNPACK"):
+                out = list(leaves)
+                for idxs, spec, buf in zip(self._groups, self._specs,
+                                           mixed):
+                    for i, v in zip(idxs, _fusion.unpack_jit(buf, spec)):
+                        out[i] = v
+            params = jax.tree_util.tree_unflatten(self._treedef, out)
+            state = TrainState(params, state.opt_state, state.model_state)
         return state, metrics
 
 
@@ -606,8 +656,12 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
     def _gossip(self, leaves):
         out = []
         for nm, leaf in zip(self._win_names, leaves):
+            # donate_source: the packed fusion buffer is dead after the
+            # put — the compiled exchange reuses it for the self value
+            # (with the default all-ones self weight, a pure alias)
             _windows.win_put(leaf, nm, dst_weights=self.dst_weights,
-                             require_mutex=self.require_mutex)
+                             require_mutex=self.require_mutex,
+                             donate_source=True)
             out.append(_windows.win_update(
                 nm, self_weight=self.self_weight,
                 neighbor_weights=self.neighbor_weights,
@@ -624,6 +678,10 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
         self.src_weights = None
         self.self_weight = None
         self.neighbor_weights = None
+
+    def _gossip_peers(self, win, owned):
+        # a get locks the SOURCE ranks it reads (the in-neighbors)
+        return {s for d in owned for s in win.in_neighbors[d]}
 
     def _gossip(self, leaves):
         st = _global_state()
@@ -682,8 +740,10 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
             p_col = win.host.read_p()
             numer = leaf * np.asarray(p_col, leaf.dtype).reshape(
                 (n,) + (1,) * (leaf.ndim - 1))
+            # numer is this step's scratch product — donate it
             _windows.win_accumulate(numer, nm, self_weight=sw, dst_weights=dw,
-                                    require_mutex=self.require_mutex)
+                                    require_mutex=self.require_mutex,
+                                    donate_source=True)
             collected = _windows.win_update_then_collect(
                 nm, require_mutex=self.require_mutex)
             p_new = _windows.win_associated_p_all(nm)

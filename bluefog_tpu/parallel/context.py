@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..utils.compat import shard_map
 
 # Python scalar, not jnp.float32(...): a concrete array here would initialize
 # the XLA backend at import time, breaking jax.distributed.initialize() in
@@ -31,9 +32,13 @@ _NEG = -1e30
 if hasattr(lax, "pcast"):
     def _pvary(x, axes):
         return lax.pcast(x, axes, to="varying")
-else:  # jax < 0.9: pcast absent, pvary not yet deprecated
+elif hasattr(lax, "pvary"):  # jax < 0.9: pcast absent, pvary not deprecated
     def _pvary(x, axes):
         return lax.pvary(x, axes)
+else:  # jax <= 0.4.x: no varying-type system at all — shard_map does not
+    # track device-varying annotations, so the marker is a no-op
+    def _pvary(x, axes):
+        return x
 
 
 def reference_attention(q, k, v, causal: bool = False):
@@ -334,7 +339,7 @@ def _cp_fn(mesh: Mesh, axis: str, causal: bool, kind: str,
         body = functools.partial(ulysses_attention_shard, axis_name=axis,
                                  causal=causal)
     spec = P(None, axis)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..utils.compat import shard_map
 
 
 def ep_mesh(n_experts: int, devices: Optional[Sequence] = None) -> Mesh:
@@ -150,7 +151,7 @@ def _ep_fn(mesh: Mesh, num_experts: int, capacity: int, dtype):
                                    "expert", num_experts, capacity, dtype)
         return out.reshape(b, s, d), aux[None]
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(), P("expert"), P("expert"), P("expert")),
         out_specs=(P("expert"), P("expert")),
@@ -244,7 +245,7 @@ def _ep_lm_fn(model, mesh: Mesh, axis: str):
         return logits, aux[None]
 
     def call(p, toks):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             body, mesh=mesh, in_specs=(moe_param_specs(p, axis), P(axis)),
             out_specs=(P(axis), P(axis)))
         return mapped(p, toks)
@@ -275,7 +276,7 @@ def ep_lm_loss_fn(model, mesh: Mesh, axis: str = "expert",
             aux = _sum_intermediates(inter)
             return (ce + aux_weight * aux)[None]
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             body, mesh=mesh, in_specs=(specs, P(axis), P(axis)),
             out_specs=P(axis))
         # per-device local losses; equal local batches -> mean is global

@@ -27,6 +27,7 @@ import os
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from ..utils.compat import typeof as _typeof
 
 try:  # pallas TPU backend is absent on some CPU-only builds
     from jax.experimental.pallas import tpu as pltpu
@@ -171,7 +172,7 @@ def flash_block(q, k, v, q_off, k_off, *, causal: bool = True,
     # kernel compiles under interpret mode but fails to lower on real TPU.
     # Union over q/k/v: any varying operand makes the outputs varying (k/v
     # can be rank-varying while q is replicated, e.g. broadcast-query).
-    vmas = [getattr(jax.typeof(t), "vma", None) for t in (q, k, v)]
+    vmas = [getattr(_typeof(t), "vma", None) for t in (q, k, v)]
     kw = {} if all(m is None for m in vmas) else {
         "vma": frozenset().union(*(m for m in vmas if m is not None))}
     out_shape = (
@@ -375,7 +376,7 @@ def flash_block_bwd(q, k, v, g, d_term, m, l, q_off, k_off, *,
         return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
 
     offs = jnp.asarray([q_off, k_off], jnp.int32)
-    vmas = [getattr(jax.typeof(t), "vma", None) for t in (q, k, v, g)]
+    vmas = [getattr(_typeof(t), "vma", None) for t in (q, k, v, g)]
     kw = {} if all(mm is None for mm in vmas) else {
         "vma": frozenset().union(*(mm for mm in vmas if mm is not None))}
     operands = (offs, bhsd(q), bhsd(k), bhsd(v), bhsd(g),

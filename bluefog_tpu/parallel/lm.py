@@ -20,6 +20,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .context import ring_attention_shard, ulysses_attention_shard
+from ..utils.compat import shard_map
 
 
 def _cp_model(model, kind: str, axis: str):
@@ -63,7 +64,7 @@ def _cp_apply_fn(model, mesh: Mesh, axis: str, kind: str):
         positions = me * sq + jnp.arange(sq)
         return cp.apply(variables, toks, positions=positions)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(None, axis)),
         out_specs=P(None, axis),
@@ -143,7 +144,7 @@ def cp_loss_fn(model, mesh: Optional[Mesh] = None, axis: str = "rank",
         count = lax.psum(jnp.asarray(nll.size, jnp.float32), axis)
         return total / count
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(None, axis), P(None, axis)),
         out_specs=P(),

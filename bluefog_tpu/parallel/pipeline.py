@@ -43,6 +43,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .context import _pvary, reference_attention
+from ..utils.compat import shard_map
 
 
 def pp_mesh(n_stages: int, devices: Optional[Sequence] = None) -> Mesh:
@@ -158,7 +159,7 @@ def _pp_fwd(model, mesh: Mesh, n_stages: int, n_micro: int):
             "pipe")
 
     spec_stage = P("pipe")
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_stage, mesh=mesh,
         in_specs=(spec_stage, P(), P()),
         out_specs=P(),
@@ -283,7 +284,7 @@ def _pp_fused_loss(model, mesh: Mesh, n_stages: int, n_micro: int):
         # only the last stage accumulated; psum replicates the total
         return lax.psum(loss_acc, "pipe")
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         per_stage, mesh=mesh,
         in_specs=(P("pipe"), P(), P(), P()),
         out_specs=P(),

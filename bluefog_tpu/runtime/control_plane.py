@@ -109,6 +109,7 @@ def attach() -> Optional[ControlPlaneClient]:
             logger.warning("control plane env incomplete; staying local")
             return None
 
+        served_cap = None
         if rank == 0 and os.environ.get("BLUEFOG_CP_SERVE", "1") != "0":
             try:
                 max_mb = float(os.environ.get(
@@ -116,6 +117,7 @@ def attach() -> Optional[ControlPlaneClient]:
                 _server = ControlPlaneServer(
                     world, port, secret=secret,
                     max_mailbox_bytes=int(max_mb * (1 << 20)))
+                served_cap = int(max_mb * (1 << 20))
             except (OSError, RuntimeError) as exc:
                 # Another actor (launcher, tests) may already serve this port.
                 logger.debug("control plane server not started here (%s)", exc)
@@ -139,6 +141,14 @@ def attach() -> Optional[ControlPlaneClient]:
             return None
         _world = world
         _conn_params = (host, port, rank, secret)
+        if served_cap is not None:
+            # Publish the SERVING process's effective mailbox cap under a
+            # well-known key (value + 1, so a missing key's 0 is
+            # distinguishable from an explicit unlimited cap). Origins size
+            # their deposit pre-checks against this instead of their own
+            # BLUEFOG_CP_MAILBOX_MAX_MB, so a cross-host env mismatch
+            # cannot tear a multi-record deposit (ADVICE r5 low).
+            _client.put(_MAILBOX_CAP_KEY, served_cap + 1)
         logger.info("control plane attached: %s:%d rank=%d world=%d",
                     host, port, rank, world)
         return _client
@@ -175,7 +185,7 @@ def world() -> int:
 
 def detach() -> None:
     """Close the client (and server, when owned). Safe to call repeatedly."""
-    global _client, _server, _tried, _world, _conn_params
+    global _client, _server, _tried, _world, _conn_params, _cap_cache
     with _mu:
         if _client is not None:
             _client.close()
@@ -186,6 +196,7 @@ def detach() -> None:
         _tried = False
         _world = 1
         _conn_params = None
+        _cap_cache = None
 
 
 def reset_for_test() -> None:
@@ -196,6 +207,35 @@ def reset_for_test() -> None:
 def barrier(name: str = "default") -> None:
     if _client is not None:
         _client.barrier(name)
+
+
+# Well-known key holding the serving process's effective per-mailbox byte
+# cap, stored as (cap_bytes + 1) so 0 still means "not published".
+_MAILBOX_CAP_KEY = "bf.cp.mailbox_cap_bytes"
+_cap_cache: Optional[int] = None
+
+
+def mailbox_cap_bytes() -> int:
+    """The server's effective per-mailbox byte cap (0 = unlimited).
+
+    Reads the value the SERVING process published at startup; falls back
+    to this process's own ``BLUEFOG_CP_MAILBOX_MAX_MB`` when the server
+    predates the publish (an external actor's server, e.g. tests that
+    start :class:`ControlPlaneServer` directly). Cached per attachment —
+    the cap is fixed at server startup."""
+    global _cap_cache
+    if _cap_cache is not None:
+        return _cap_cache
+    cap = None
+    if _client is not None:
+        v = _client.get(_MAILBOX_CAP_KEY)
+        if v > 0:
+            cap = int(v) - 1
+    if cap is None:
+        cap = int(float(os.environ.get(
+            "BLUEFOG_CP_MAILBOX_MAX_MB", "256")) * (1 << 20))
+    _cap_cache = cap
+    return cap
 
 
 # -- float scalars over the int64 KV (IEEE754 bit-packing) ------------------

@@ -87,6 +87,13 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
     ]
+    lib.bf_cp_bytes_multi_outv_tagged.restype = ctypes.c_int64
+    lib.bf_cp_bytes_multi_outv_tagged.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int,
+    ]
     lib.bf_cp_bytes_multi_in.restype = ctypes.c_int64
     lib.bf_cp_bytes_multi_in.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
@@ -135,6 +142,44 @@ def load() -> Optional[ctypes.CDLL]:
             logger.info("native runtime load failed (%s)", exc)
             _lib = None
         return _lib
+
+
+class NativeReply:
+    """A malloc'd native reply buffer exposed as a zero-copy memoryview.
+
+    The bulk drain path hands out record views that alias the native
+    buffer directly, so a 100 MB drain is parsed without the two full
+    Python-side copies ``ctypes.string_at`` + per-record slicing cost.
+    Callers MUST finish consuming every view before ``close()`` (the
+    views dangle afterwards); close is idempotent and runs at GC as a
+    backstop.
+    """
+
+    def __init__(self, lib, ptr: "ctypes.c_void_p", length: int) -> None:
+        self._lib = lib
+        self._ptr = ptr
+        self.view = memoryview(
+            (ctypes.c_char * length).from_address(ptr.value)
+        ).cast("B") if length else memoryview(b"")
+
+    def close(self) -> None:
+        if self._ptr is not None:
+            self.view = memoryview(b"")
+            self._lib.bf_cp_free(self._ptr)
+            self._ptr = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):  # backstop only; explicit close is the contract
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
 
 class ControlPlaneServer:
@@ -318,8 +363,9 @@ class ControlPlaneClient:
     _OP_TAKE_BYTES = 9
     _OP_PUT_BYTES = 10
     _OP_GET_BYTES = 11
+    _OP_APPEND_BYTES_TAGGED = 13
 
-    def _bytes_multi_out(self, op: int, names, blobs) -> list:
+    def _bytes_multi_out(self, op: int, names, blobs, tags=None) -> list:
         """Records may be ``bytes`` or any C-contiguous buffer (numpy
         views): payloads are passed by POINTER to the native scatter-gather
         write, so a 100 MB deposit costs zero Python-side copies."""
@@ -360,17 +406,22 @@ class ControlPlaneClient:
                         ctypes.c_char.from_buffer(flat)) if nbytes else 0
                 lens[i] = nbytes
         out = (ctypes.c_int64 * n)()
-        if self._lib.bf_cp_bytes_multi_outv(
+        if tags is None:
+            r = self._lib.bf_cp_bytes_multi_outv(
+                self._h, op, "\n".join(names).encode(), ptrs, lens, out, n)
+        else:
+            tag_arr = (ctypes.c_int64 * n)(*[int(t) for t in tags])
+            r = self._lib.bf_cp_bytes_multi_outv_tagged(
                 self._h, op, "\n".join(names).encode(), ptrs, lens,
-                out, n) < 0:
+                tag_arr, out, n)
+        if r < 0:
             raise OSError("control plane bytes batch failed (connection "
                           "lost or not authenticated)")
         return list(out)
 
-    def _bytes_multi_in(self, op: int, names) -> list:
-        names = list(names)
-        if not names:
-            return []
+    def _bytes_multi_in_raw(self, op: int, names) -> NativeReply:
+        """One pipelined bulk-reply batch; the (u64 len | payload)* reply
+        stays in the native buffer, exposed as a zero-copy view."""
         n = len(names)
         out = ctypes.c_void_p()
         out_len = ctypes.c_int64()
@@ -379,18 +430,21 @@ class ControlPlaneClient:
                 ctypes.byref(out), ctypes.byref(out_len)) < 0:
             raise OSError("control plane bytes batch failed (connection "
                           "lost or not authenticated)")
-        try:
-            payload = ctypes.string_at(out.value, out_len.value) \
-                if out_len.value else b""
-        finally:
-            self._lib.bf_cp_free(out)
-        blobs = []
-        off = 0
-        for _ in range(n):
-            (ln,) = struct.unpack_from("<Q", payload, off)
-            off += 8
-            blobs.append(payload[off:off + ln])
-            off += ln
+        return NativeReply(self._lib, out, out_len.value)
+
+    def _bytes_multi_in(self, op: int, names) -> list:
+        names = list(names)
+        if not names:
+            return []
+        with self._bytes_multi_in_raw(op, names) as reply:
+            payload = reply.view
+            blobs = []
+            off = 0
+            for _ in range(len(names)):
+                (ln,) = struct.unpack_from("<Q", payload, off)
+                off += 8
+                blobs.append(bytes(payload[off:off + ln]))
+                off += ln
         return blobs
 
     def append_bytes_many(self, names, blobs) -> list:
@@ -401,11 +455,31 @@ class ControlPlaneClient:
         -2 entries mean that mailbox hit the server byte cap."""
         return self._bytes_multi_out(self._OP_APPEND_BYTES, names, blobs)
 
+    def append_bytes_tagged_many(self, names, blobs, tags) -> list:
+        """Like :meth:`append_bytes_many`, but each record's int64 tag is
+        prefixed to the stored record server-side (kAppendBytesTagged).
+        The window drain uses the tag — (sequence id, chunk index, chunk
+        count) — to discard orphaned continuation chunks after a
+        concurrent clear instead of misparsing them as headers."""
+        return self._bytes_multi_out(self._OP_APPEND_BYTES_TAGGED, names,
+                                     blobs, tags=tags)
+
     def put_bytes_many(self, names, blobs) -> None:
         """Pipelined multi-put of bytes slots (batched self publishes)."""
         for r in self._bytes_multi_out(self._OP_PUT_BYTES, names, blobs):
             if r < 0:
                 raise OSError("control plane put_bytes_many failed")
+
+    @staticmethod
+    def _parse_take_reply(payload) -> list:
+        records = []
+        off = 0
+        while off < len(payload):
+            (rl,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            records.append(payload[off:off + rl])
+            off += rl
+        return records
 
     def take_bytes_many(self, names) -> list:
         """Pipelined multi-drain: per-key record lists, one round-trip's
@@ -413,15 +487,31 @@ class ControlPlaneClient:
         server's per-reply cap, exactly like take_bytes."""
         out = []
         for payload in self._bytes_multi_in(self._OP_TAKE_BYTES, names):
-            records = []
-            off = 0
-            while off < len(payload):
-                (rl,) = struct.unpack_from("<I", payload, off)
-                off += 4
-                records.append(payload[off:off + rl])
-                off += rl
-            out.append(records)
+            out.append(self._parse_take_reply(payload))
         return out
+
+    def take_bytes_many_views(self, names):
+        """Zero-copy multi-drain: ``(per-key record lists, owner)``.
+
+        Records are memoryview slices aliasing ONE native reply buffer —
+        a 100+ MB drain is parsed without the full-payload copies
+        :meth:`take_bytes_many` pays (``string_at`` + per-record bytes
+        slices). The caller must finish consuming every record view and
+        then ``owner.close()`` (use as a context manager); this is the
+        hosted window drain's hot path."""
+        names = list(names)
+        if not names:
+            return [], NativeReply(self._lib, ctypes.c_void_p(), 0)
+        owner = self._bytes_multi_in_raw(self._OP_TAKE_BYTES, names)
+        payload = owner.view
+        out = []
+        off = 0
+        for _ in range(len(names)):
+            (ln,) = struct.unpack_from("<Q", payload, off)
+            off += 8
+            out.append(self._parse_take_reply(payload[off:off + ln]))
+            off += ln
+        return out, owner
 
     def get_bytes_many(self, names) -> list:
         """Pipelined multi-read of bytes slots (batched win_get pulls)."""

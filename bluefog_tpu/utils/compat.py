@@ -1,0 +1,43 @@
+"""JAX version compatibility shims.
+
+The framework targets the current jax API surface but must run on the
+images actually in the fleet. Centralizing the fallbacks here keeps every
+call site on one import instead of scattering try/excepts.
+
+``shard_map``: promoted to ``jax.shard_map`` in newer releases; older
+jax (e.g. 0.4.x) ships it as ``jax.experimental.shard_map.shard_map``
+with the same (f, mesh, in_specs, out_specs) surface. The newer
+``check_vma`` kwarg (varying-manual-axes check, nee ``check_rep``) is
+translated or dropped for releases that predate it.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _params = inspect.signature(_shard_map).parameters
+    if "check_vma" in _params:
+        shard_map = _shard_map
+    else:
+        def shard_map(*args, **kwargs):
+            # old releases call the same knob check_rep; map it through so
+            # call sites can stay on the current-jax spelling
+            if "check_vma" in kwargs:
+                v = kwargs.pop("check_vma")
+                if "check_rep" in _params:
+                    kwargs["check_rep"] = v
+            return _shard_map(*args, **kwargs)
+
+# ``jax.typeof``: aval accessor added in newer releases; get_aval is the
+# long-standing equivalent (callers only read metadata like ``.vma``, which
+# simply doesn't exist on old avals — getattr-with-default handles that).
+typeof = jax.typeof if hasattr(jax, "typeof") else jax.core.get_aval
+
+__all__ = ["shard_map", "typeof"]

@@ -185,7 +185,7 @@ namespace {
 enum Op : uint8_t {
   kBarrier = 1, kLock = 2, kUnlock = 3, kFetchAdd = 4, kPut = 5, kGet = 6,
   kShutdown = 7, kAppendBytes = 8, kTakeBytes = 9, kPutBytes = 10,
-  kGetBytes = 11, kBoxBytes = 12,
+  kGetBytes = 11, kBoxBytes = 12, kAppendBytesTagged = 13,
 };
 
 // -- SHA-256 / HMAC-SHA256 (self-contained; no OpenSSL in the image) --------
@@ -469,7 +469,15 @@ struct ControlServer {
           reply = kv.count(key) ? kv[key] : 0;
           break;
         }
-        case kAppendBytes: {
+        case kAppendBytes:
+        case kAppendBytesTagged: {
+          // kAppendBytesTagged prefixes the stored record with the request's
+          // 8-byte little-endian `arg` — the deposit tag (sequence id,
+          // chunk index, chunk count) the window drain uses to discard
+          // orphaned continuation chunks after a concurrent clear. The
+          // prefix rides the copy the append makes anyway, so tagging is
+          // free on the wire and in server memory (+8 bytes/record).
+          const size_t extra = (op == kAppendBytesTagged) ? 8 : 0;
           std::lock_guard<std::mutex> lk(mu);
           auto& box = mailbox[key];
           int64_t& bytes = box_bytes[key];
@@ -478,13 +486,17 @@ struct ControlServer {
           // memory without limit. -2 tells the client "mailbox full" so it
           // can raise a targeted error instead of a wire failure.
           if (max_box_bytes > 0 &&
-              bytes + static_cast<int64_t>(dlen) > max_box_bytes &&
+              bytes + static_cast<int64_t>(dlen + extra) > max_box_bytes &&
               !box.empty()) {
             reply = -2;
             break;
           }
-          box.emplace_back(data, dlen);
-          bytes += static_cast<int64_t>(dlen);
+          std::string rec;
+          rec.reserve(dlen + extra);
+          if (extra) rec.append(reinterpret_cast<const char*>(&arg), 8);
+          rec.append(data, dlen);
+          box.emplace_back(std::move(rec));
+          bytes += static_cast<int64_t>(dlen + extra);
           reply = static_cast<int64_t>(box.size());
           break;
         }
@@ -719,23 +731,48 @@ struct ControlClient {
   // payloads stream straight from the caller's buffers (no client-side
   // copy at all — `datas[i]` may point anywhere, e.g. into a live numpy
   // array, so a 100 MB deposit costs zero Python-side memcpys).
+  //
+  // In-flight replies are BOUNDED at kMaxInflight: the server replies 12
+  // bytes per request as it consumes them, and a batch large enough that
+  // the unread replies fill both socket buffers would park the server's
+  // send while the client is still blocked writing payload — a mutual-
+  // blocking deadlock (fine-grained BLUEFOG_MAX_WIN_SENT_LENGTH chunking
+  // times high out-degree reaches tens of thousands of records). Every
+  // already-written request's reply is guaranteed to arrive, so draining
+  // down to the bound mid-batch can stall only until the server catches
+  // up — never forever.
+  //
+  // `args` (optional): per-request int64 argument — the deposit tag for
+  // kAppendBytesTagged. When null, the payload length is sent (the
+  // original framing; the server ignores the field for untagged ops).
   int64_t CallBytesMultiOutV(uint8_t op, const char* keys_nl,
                              const void* const* datas, const int64_t* lens,
-                             int64_t* out, int n) {
+                             const int64_t* args, int64_t* out, int n) {
     std::lock_guard<std::mutex> lk(mu);
     const char* p = keys_nl;
     // Small records coalesce into one send buffer (fewer syscalls); large
     // ones are written directly from the source to skip the memcpy.
     constexpr size_t kCoalesce = 4u << 20;
+    constexpr int kMaxInflight = 128;
     std::vector<char> buf;
+    int replies_read = 0;
+    auto drain_to = [&](int target) -> bool {
+      for (; replies_read < target; ++replies_read) {
+        int64_t reply;
+        if (!ReadReply(&reply)) return false;
+        if (out) out[replies_read] = reply;
+      }
+      return true;
+    };
     for (int i = 0; i < n; ++i) {
       const char* e = std::strchr(p, '\n');
       std::string key = e ? std::string(p, e - p) : std::string(p);
       size_t dlen = static_cast<size_t>(lens[i]);
+      int64_t arg = args ? args[i] : lens[i];
       if (dlen <= kCoalesce) {
-        Encode(&buf, op, key, lens[i], datas[i], dlen);
+        Encode(&buf, op, key, arg, datas[i], dlen);
       } else {
-        Encode(&buf, op, key, lens[i]);  // header only, then stream payload
+        Encode(&buf, op, key, arg);  // header only, then stream payload
         // fix the frame length to include the payload we stream below
         uint32_t flen;
         size_t hdr = 4 + 1 + 4 + 2 + key.size() + 8;
@@ -747,15 +784,20 @@ struct ControlClient {
         if (!ControlServer::WriteAll(fd, datas[i], dlen)) return -1;
       }
       p = e ? e + 1 : p + key.size();
+      if (i + 1 - replies_read > kMaxInflight) {
+        // flush coalesced frames first: a reply only arrives once its
+        // request has actually reached the server
+        if (!buf.empty()) {
+          if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+          buf.clear();
+        }
+        if (!drain_to(i + 1 - kMaxInflight)) return -1;
+      }
     }
     if (!buf.empty() &&
         !ControlServer::WriteAll(fd, buf.data(), buf.size()))
       return -1;
-    for (int i = 0; i < n; ++i) {
-      int64_t reply;
-      if (!ReadReply(&reply)) return -1;
-      if (out) out[i] = reply;
-    }
+    if (!drain_to(n)) return -1;
     return n;
   }
 
@@ -977,7 +1019,17 @@ int64_t bf_cp_bytes_multi_outv(void* h, int op, const char* keys_nl,
                                const void* const* datas, const int64_t* lens,
                                int64_t* out, int n) {
   return static_cast<ControlClient*>(h)->CallBytesMultiOutV(
-      static_cast<uint8_t>(op), keys_nl, datas, lens, out, n);
+      static_cast<uint8_t>(op), keys_nl, datas, lens, nullptr, out, n);
+}
+// Tagged variant (kAppendBytesTagged=13): per-record int64 `tags` ride the
+// request's arg field and are prefixed to the stored records server-side.
+int64_t bf_cp_bytes_multi_outv_tagged(void* h, int op, const char* keys_nl,
+                                      const void* const* datas,
+                                      const int64_t* lens,
+                                      const int64_t* tags,
+                                      int64_t* out, int n) {
+  return static_cast<ControlClient*>(h)->CallBytesMultiOutV(
+      static_cast<uint8_t>(op), keys_nl, datas, lens, tags, out, n);
 }
 // Pipelined batch of n bulk-reply ops (kTakeBytes=9 / kGetBytes=11): one
 // malloc'd (u64 len | payload)* buffer, freed with bf_cp_free.

@@ -19,6 +19,7 @@ standard.
 """
 
 import json
+import os
 import struct
 import time
 
@@ -40,6 +41,21 @@ CONFIGS = [
     ("f32_1MB", np.float32, 262_144, 30),
     ("bf16_51MB", ml_dtypes.bfloat16, 25_600_000, 4),
 ]
+# --quick (CI smoke): tiny rows, 2 rounds — the full op/probe matrix still
+# runs, the numbers just don't mean anything
+if os.environ.get("BLUEFOG_WB_QUICK") == "1":
+    CONFIGS = [
+        ("f32_256KB", np.float32, 65_536, 2),
+        ("bf16_32KB", ml_dtypes.bfloat16, 16_384, 2),
+    ]
+
+
+def barrier():
+    # Control-plane rendezvous, NOT bf.barrier(): the compiled psum barrier
+    # needs multiprocess XLA collectives (unimplemented on the CPU
+    # backend), and this bench synchronizes PROCESSES around host-plane
+    # ops, not device work — the named barrier is the right primitive.
+    control_plane.barrier("wb.sync")
 
 
 def put_f(cl, key, v):
@@ -54,7 +70,7 @@ def report(cl, pid, config, op, times, wire_bytes):
     """Post my median; pid 0 prints the slowest controller's number."""
     med = float(np.median(times))
     put_f(cl, f"wb.{config}.{op}.{pid}", med)
-    bf.barrier()
+    barrier()
     if pid == 0:
         meds = [get_f(cl, f"wb.{config}.{op}.{p}") for p in range(N)]
         worst = max(meds)
@@ -65,7 +81,7 @@ def report(cl, pid, config, op, times, wire_bytes):
             "wire_mb": round(wire_bytes / 1e6, 2),
             "per_controller_ms": [round(m * 1e3, 3) for m in meds],
         }), flush=True)
-    bf.barrier()
+    barrier()
 
 
 def main() -> None:
@@ -81,17 +97,17 @@ def main() -> None:
         x[:] = np.arange(N, dtype=np.float32)[:, None].astype(dtype)
         name = f"wb.{tag}"
         assert bf.win_create(x, name, zero_init=True)
-        bf.barrier()
+        barrier()
 
         # -- win_put: 2 remote deposits + 1 self publish per op ------------
         ts = []
         for _ in range(rounds):
-            bf.barrier()
+            barrier()
             t0 = time.perf_counter()
             bf.win_put(x, name)
             ts.append(time.perf_counter() - t0)
             # keep server memory bounded: drain between rounds
-            bf.barrier()
+            barrier()
             bf.win_update(name)
         # wire bytes OUT per op: 2 deposits + 1 publish (deposit payload
         # dtype is whatever the transport ships — report the app-level
@@ -101,11 +117,11 @@ def main() -> None:
         # -- win_accumulate ------------------------------------------------
         ts = []
         for _ in range(rounds):
-            bf.barrier()
+            barrier()
             t0 = time.perf_counter()
             bf.win_accumulate(x, name)
             ts.append(time.perf_counter() - t0)
-            bf.barrier()
+            barrier()
             bf.win_update(name)
         report(cl, pid, tag, "win_accumulate", ts, 3 * row_bytes)
 
@@ -113,42 +129,79 @@ def main() -> None:
         ts = []
         for _ in range(rounds):
             bf.win_put(x, name)
-            bf.barrier()  # all deposits on the server before the drain
+            barrier()  # all deposits on the server before the drain
             t0 = time.perf_counter()
             bf.win_update(name)
             ts.append(time.perf_counter() - t0)
-            bf.barrier()
+            barrier()
         report(cl, pid, tag, "win_update", ts, 2 * row_bytes)
 
         # -- win_get: pull 2 published remote rows -------------------------
         ts = []
         for _ in range(rounds):
-            bf.barrier()
+            barrier()
             t0 = time.perf_counter()
             bf.win_get(name)
             ts.append(time.perf_counter() - t0)
         report(cl, pid, tag, "win_get", ts, 2 * row_bytes)
 
-        bf.barrier()
+        barrier()
         bf.win_free(name)
 
         # -- transport ceiling: raw put_bytes/get_bytes of one row ---------
         blob = x[0].tobytes()
         ts = []
         for _ in range(rounds):
-            bf.barrier()
+            barrier()
             t0 = time.perf_counter()
             cl.put_bytes(f"wb.raw.{pid}", blob)
             ts.append(time.perf_counter() - t0)
         report(cl, pid, tag, "raw_put_bytes", ts, row_bytes)
         ts = []
         for _ in range(rounds):
-            bf.barrier()
+            barrier()
             t0 = time.perf_counter()
             cl.get_bytes(f"wb.raw.{pid}")
             ts.append(time.perf_counter() - t0)
         report(cl, pid, tag, "raw_get_bytes", ts, row_bytes)
         cl.put_bytes(f"wb.raw.{pid}", b"")
+
+        # -- fold-vs-stream isolation (r6): the same 2-deposit drain load,
+        # timed as (a) the raw socket take alone and (b) the numpy fold
+        # alone. The gap between win_update and max(stream, fold) is the
+        # serialization the pipelined drain removes; BOTH numbers together
+        # bound what any drain implementation can reach.
+        chunk = 16 << 20  # the default BLUEFOG_MAX_WIN_SENT_LENGTH framing
+        blob = x[0].tobytes()
+        recs = [blob[o:o + chunk] for o in range(0, len(blob), chunk)] * 2
+        key = f"wb.fvs.{pid}"
+        staging = np.empty(2 * row_bytes, np.uint8)
+        acc = np.zeros(elems, np.float32)
+        t_stream, t_fold = [], []
+        for _ in range(rounds):
+            cl.append_bytes_many([key] * len(recs), recs)
+            barrier()
+            t0 = time.perf_counter()
+            got = []
+            while True:  # >64 MiB backlogs drain over multiple takes
+                part = cl.take_bytes(key)
+                if not part:
+                    break
+                got.extend(part)
+            t1 = time.perf_counter()
+            off = 0
+            for r_ in got:
+                staging[off:off + len(r_)] = np.frombuffer(r_, np.uint8)
+                off += len(r_)
+            for dep in range(2):
+                contrib = staging[dep * row_bytes:(dep + 1) * row_bytes] \
+                    .view(dtype)
+                np.add(acc, contrib.astype(np.float32, copy=False), out=acc)
+            t2 = time.perf_counter()
+            t_stream.append(t1 - t0)
+            t_fold.append(t2 - t1)
+        report(cl, pid, tag, "drain_stream", t_stream, 2 * row_bytes)
+        report(cl, pid, tag, "drain_fold", t_fold, 2 * row_bytes)
 
     bf.shutdown()
     if pid == 0:

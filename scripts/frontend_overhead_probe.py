@@ -1,0 +1,142 @@
+"""Frontend stacking-overhead probe (VERDICT r5 weak #4).
+
+Both frontends move parameters through host numpy on every communicate
+step: torch re-stacks every parameter (`torch/__init__.py:_stacked_params`
+-> `torch.stack` -> `to_jax`) and keras does the same per variable
+(`keras/__init__.py:_stacked`), then both tear the mixed result back down
+into the per-rank replicas. This probe measures what that costs for an
+MLP-sized model (the opt-matrix bench model, ~7.4 MB of f32 params) on
+the 8-device CPU mesh, split into the three phases of one communicate:
+
+  stack      host gather: per-rank replicas -> rank-stacked host arrays
+  comm       the compiled neighbor_allreduce over the stacked arrays
+  write_back scatter the mixed values back onto the replicas
+
+One JSON line per frontend goes to stdout; PERF.md records the row.
+
+Usage:  python scripts/frontend_overhead_probe.py [--rounds N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# 8 virtual CPU devices, configured before jax imports (conftest idiom)
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if not f.startswith("--xla_force_host_platform_device_count")]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=8"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+import bluefog_tpu as bf  # noqa: E402
+
+N = 8
+LAYERS = [(3072, 512), (512, 512), (512, 10)]  # the bench MLP's shape
+
+
+def _med(ts):
+    return round(float(np.median(ts)) * 1e3, 3)
+
+
+def probe_torch(rounds: int) -> dict:
+    import torch
+
+    import bluefog_tpu.torch as bft
+
+    class MLP(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.layers = torch.nn.ModuleList(
+                [torch.nn.Linear(i, o) for i, o in LAYERS])
+
+    modules = [MLP() for _ in range(N)]
+    param_bytes = sum(p.numel() * p.element_size()
+                      for p in modules[0].parameters())
+    t_stack, t_comm, t_wb = [], [], []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        stacked = bft._stacked_params(modules)
+        t1 = time.perf_counter()
+        mixed = {nm: bft.neighbor_allreduce(t) for nm, t in stacked.items()}
+        jax.block_until_ready(None)  # results already torch; no-op guard
+        t2 = time.perf_counter()
+        bft._write_back(modules, mixed)
+        t3 = time.perf_counter()
+        t_stack.append(t1 - t0)
+        t_comm.append(t2 - t1)
+        t_wb.append(t3 - t2)
+    return {
+        "frontend": "torch", "params_mb": round(param_bytes / 1e6, 2),
+        "stack_ms": _med(t_stack), "comm_ms": _med(t_comm),
+        "write_back_ms": _med(t_wb),
+        "host_overhead_ms": _med([a + b for a, b in zip(t_stack, t_wb)]),
+    }
+
+
+def probe_keras(rounds: int) -> dict:
+    import keras
+
+    import bluefog_tpu.keras as bfk
+
+    def make():
+        m = keras.Sequential(
+            [keras.layers.Input((LAYERS[0][0],))] +
+            [keras.layers.Dense(o) for _, o in LAYERS])
+        m.build((None, LAYERS[0][0]))
+        return m
+
+    models = [make() for _ in range(N)]
+    param_bytes = sum(
+        int(np.prod(v.shape)) * 4
+        for v in models[0].trainable_variables)
+    from bluefog_tpu.utils.local_view import to_global, to_local
+    t_stack, t_comm, t_wb = [], [], []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        stacked = bfk._stacked(models)
+        t1 = time.perf_counter()
+        mixed = [to_local(bf.neighbor_allreduce(to_global(t)))
+                 for t in stacked]
+        t2 = time.perf_counter()
+        bfk._write_back(models, mixed)
+        t3 = time.perf_counter()
+        t_stack.append(t1 - t0)
+        t_comm.append(t2 - t1)
+        t_wb.append(t3 - t2)
+    return {
+        "frontend": "keras", "params_mb": round(param_bytes / 1e6, 2),
+        "stack_ms": _med(t_stack), "comm_ms": _med(t_comm),
+        "write_back_ms": _med(t_wb),
+        "host_overhead_ms": _med([a + b for a, b in zip(t_stack, t_wb)]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--frontends", nargs="*",
+                    default=["torch", "keras"])
+    args = ap.parse_args()
+    bf.init(devices=jax.devices("cpu")[:N])
+    try:
+        for fe in args.frontends:
+            res = (probe_torch if fe == "torch" else probe_keras)(args.rounds)
+            res["where"] = "cpu-mesh-8dev"
+            print(json.dumps(res), flush=True)
+    finally:
+        bf.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

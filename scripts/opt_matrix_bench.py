@@ -6,14 +6,18 @@ comparison the reference published as its own benchmark harness
 (examples/pytorch_benchmark.py:52-60). Results go to stdout as one JSON
 line per mode; PERF.md records the table.
 
-Usage:  python scripts/opt_matrix_bench.py [--chip]
-  --chip: additionally run the single-chip-meaningful modes on the real
-          TPU (resnet50, batch 64) — at n=1 collectives are degenerate, so
-          this isolates per-mode dispatch overhead on the real device.
+Usage:  python scripts/opt_matrix_bench.py [--chip] [--quick] [--modes ...]
+  --chip:  additionally run the single-chip-meaningful modes on the real
+           TPU (resnet50, batch 64) — at n=1 collectives are degenerate, so
+           this isolates per-mode dispatch overhead on the real device.
+  --quick: 1 warmup / 2 batches / 1 iter per mode — the CI smoke setting
+           (tests/test_benchmark_smoke.py); exercises every mode's full
+           launch+step path in seconds, numbers NOT meaningful for PERF.md.
 """
 
 import argparse
 import json
+import os
 import re
 import subprocess
 import sys
@@ -33,16 +37,22 @@ CHIP_MODES = ["gradient_allreduce", "neighbor_allreduce", "win_put"]
 RATE_RE = re.compile(r"Total img/sec on \d+ chip\(s\): ([0-9.]+) \+-([0-9.]+)")
 
 
-def run_mode(mode: str, simulate: int, extra=()) -> dict:
+def run_mode(mode: str, simulate: int, extra=(), quick: bool = False) -> dict:
+    # CPU-mesh rows must not depend on the accelerator tunnel: pin the
+    # platform so simulated children skip the TPU-plugin probe (a
+    # multi-minute per-process timeout when the tunnel is down).
+    env = dict(os.environ, JAX_PLATFORMS="cpu") if simulate else None
     cmd = [sys.executable, "-m", "bluefog_tpu.launcher"]
     if simulate:
         cmd += ["--simulate", str(simulate)]
+    reps = ("1", "2", "1") if quick else ("3", "5", "3")
     cmd += ["--", sys.executable, str(REPO / "examples" / "benchmark.py"),
             "--model", "mlp", "--batch-size", "8",
-            "--num-warmup-batches", "3", "--num-batches-per-iter", "5",
-            "--num-iters", "3", "--dist-optimizer", mode, *extra]
+            "--num-warmup-batches", reps[0], "--num-batches-per-iter",
+            reps[1], "--num-iters", reps[2], "--dist-optimizer", mode,
+            *extra]
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
-                       cwd=REPO)
+                       cwd=REPO, env=env)
     m = RATE_RE.search(r.stdout)
     if r.returncode != 0 or not m:
         return {"mode": mode, "error": (r.stdout + r.stderr)[-500:]}
@@ -67,6 +77,7 @@ def run_chip_mode(mode: str) -> dict:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--chip", action="store_true")
+    ap.add_argument("--quick", action="store_true")
     ap.add_argument("--modes", nargs="*", default=None)
     args = ap.parse_args()
     rc = 0
@@ -83,7 +94,7 @@ def main() -> int:
                 # dynamic Expo-2 applies only to neighbor_allreduce; keep
                 # the others on their natural static path
                 extra = ("--disable-dynamic-topology",)
-            res = run_mode(mode, simulate=8, extra=extra)
+            res = run_mode(mode, simulate=8, extra=extra, quick=args.quick)
             res["where"] = "cpu-mesh-8dev-mlp-b8"
             print(json.dumps(res), flush=True)
             rc = rc or ("error" in res)

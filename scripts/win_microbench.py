@@ -8,9 +8,17 @@ win_accumulate / win_update / win_get on ResNet-sized (102 MB), small
 (1 MB), and bf16 windows, plus the raw put_bytes/get_bytes transport
 ceiling the numbers should be judged against.
 
-Usage:  python scripts/win_microbench.py
+Also prints a fold-vs-stream isolation line per config: the same drained
+bytes timed as (a) the socket take alone and (b) the numpy fold alone, so
+the drain pipeline's overlap headroom is a measured number, not a guess.
+
+Usage:  python scripts/win_microbench.py [--quick]
+  --quick: tiny windows, 2 rounds — seconds instead of minutes; exercised
+           by the CI smoke test (tests/test_benchmark_smoke.py), numbers
+           are NOT meaningful for PERF.md.
 """
 
+import argparse
 import os
 import secrets
 import socket
@@ -28,11 +36,20 @@ def free_port() -> int:
 
 
 def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
     env = os.environ.copy()
+    if args.quick:
+        env["BLUEFOG_WB_QUICK"] = "1"
     for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE",
               "BLUEFOG_CP_HOST", "BLUEFOG_CP_PORT"):
         env.pop(k, None)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # host-plane bench on a simulated mesh: skip the TPU-plugin probe (a
+    # multi-minute per-controller timeout when the accelerator tunnel is
+    # down)
+    env["JAX_PLATFORMS"] = "cpu"
     env["BLUEFOG_CP_SECRET"] = secrets.token_hex(16)  # auth ON (VERDICT r4)
     port = free_port()
     child = str(REPO / "scripts" / "_win_microbench_child.py")

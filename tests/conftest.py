@@ -22,8 +22,12 @@ os.environ["XLA_FLAGS"] = " ".join(
 # JAX_PLATFORMS lets both backends register so jax.devices('cpu') works.
 # BLUEFOG_TESTS_CPU_ONLY=1 pins strictly to CPU — the escape hatch for when
 # the remote-TPU tunnel is down (its plugin init would hang EVERY test).
+# An explicit JAX_PLATFORMS=cpu from the caller (the tier-1 runner's env)
+# is honored for the same reason: the caller asked for a CPU-only run, and
+# widening it to "" would re-probe a possibly-wedged accelerator tunnel.
 os.environ["JAX_PLATFORMS"] = (
-    "cpu" if os.environ.get("BLUEFOG_TESTS_CPU_ONLY") == "1" else "")
+    "cpu" if (os.environ.get("BLUEFOG_TESTS_CPU_ONLY") == "1"
+              or os.environ.get("JAX_PLATFORMS") == "cpu") else "")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

@@ -6,6 +6,7 @@ fused optimizer step show up in CI rather than only on hardware. The analog
 of running the reference's examples/pytorch_benchmark.py under mpirun.
 """
 
+import json
 import os
 import re
 import subprocess
@@ -22,6 +23,9 @@ def _scrubbed_env():
     for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE"):
         env.pop(k, None)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # CI smoke runs on the simulated CPU mesh; don't let children probe a
+    # possibly-down accelerator tunnel (multi-minute timeout per process)
+    env["JAX_PLATFORMS"] = "cpu"
     return env
 
 
@@ -42,3 +46,37 @@ def test_benchmark_mlp_smoke(dist_opt):
     m = re.search(r"Total img/sec on \d+ chip\(s\):\s*([0-9.]+)", out.stdout)
     assert m, f"no throughput line in:\n{out.stdout}"
     assert float(m.group(1)) > 0
+
+
+@pytest.mark.slow
+def test_win_microbench_quick():
+    """scripts/win_microbench.py --quick: the 4-controller hosted-plane
+    drain/get pipeline (put, accumulate, pipelined update drain, win_get,
+    fold-vs-stream probe) runs end to end at tiny sizes — the new drain
+    paths are CI-exercised, not hand-run only."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "win_microbench.py"),
+         "--quick"],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "WIN_MICROBENCH_OK" in out.stdout, out.stdout + out.stderr
+    ops = {json.loads(l)["op"] for l in out.stdout.splitlines()
+           if l.startswith("{")}
+    assert {"win_put", "win_update", "win_get", "drain_stream",
+            "drain_fold"} <= ops, out.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["win_put", "sharded_allreduce"])
+def test_opt_matrix_bench_quick(mode):
+    """scripts/opt_matrix_bench.py --quick on the two modes the r6
+    acceptance compares: a parseable throughput JSON line per mode."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "opt_matrix_bench.py"),
+         "--quick", "--modes", mode],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    res = json.loads(out.stdout.splitlines()[-1])
+    assert res["mode"] == mode and res.get("img_per_sec", 0) > 0, res

@@ -61,6 +61,15 @@ def bf_hosted():
     cp.reset_for_test()
 
 
+def _inject_deposit(cl, key, recs, seq=1):
+    """Append one deposit's records the way a remote origin now does: each
+    record tag-prefixed server-side (seq << 24 | index) so the drain can
+    tell headers from orphaned continuations."""
+    recs = list(recs)
+    cl.append_bytes_tagged_many([key] * len(recs), recs,
+                                win_ops._deposit_tags(seq, len(recs)))
+
+
 def test_hosted_plane_selected(bf_hosted):
     assert bf.win_create(jnp.ones((8, 2)), "h.sel")
     win = win_ops._get_window("h.sel")
@@ -247,7 +256,7 @@ def test_win_fence_folds_pending_deposits(bf_hosted):
     contrib = np.full((2,), 7.0, np.float32)
     import struct as _st
     rec = _st.pack("<BBdI", 1, 0, 0.0, 1) + contrib.tobytes()
-    cl.append_bytes(f"w.h.fence.dep.{dst}.{k}", rec)
+    _inject_deposit(cl, f"w.h.fence.dep.{dst}.{k}", [rec])
     assert bf.win_fence("h.fence")
     # deposit is now IN the owner's mailbox row, server box empty
     assert cl.take_bytes(f"w.h.fence.dep.{dst}.{k}") == []
@@ -273,12 +282,12 @@ def test_strict_update_rejects_version0_deposit(bf_hosted, monkeypatch):
     import struct as _st
     rec = _st.pack("<BBdI", 1, 0, 0.0, 1) + np.ones((2,), np.float32).tobytes()
     # no version bump: the origin "forgot" require_mutex's protocol
-    cl.append_bytes(f"w.h.strict.dep.{dst}.{k}", rec)
+    _inject_deposit(cl, f"w.h.strict.dep.{dst}.{k}", [rec], seq=1)
     with pytest.raises(RuntimeError, match="version 0"):
         bf.win_update("h.strict", require_mutex=True)
     # the compliant ordering passes: bump precedes deposit
     cl.fetch_add(f"w.h.strict.v.{dst}.{k}", 1)
-    cl.append_bytes(f"w.h.strict.dep.{dst}.{k}", rec)
+    _inject_deposit(cl, f"w.h.strict.dep.{dst}.{k}", [rec], seq=2)
     bf.win_update("h.strict", require_mutex=True)
     bf.win_free("h.strict")
 
@@ -377,7 +386,8 @@ def test_chunked_deposit_drain_reassembles(bf_hosted, monkeypatch):
     cl.fetch_add(f"w.h.chunk.v.{dst}.{k}", 1)
     recs = win_ops._pack_deposit(win_ops._DEP_ACC, 0, 0.0, contrib)
     assert len(recs) == 4  # header + 3 chunks
-    cl.append_bytes_many([f"w.h.chunk.dep.{dst}.{k}"] * len(recs), recs)
+    cl.append_bytes_tagged_many([f"w.h.chunk.dep.{dst}.{k}"] * len(recs),
+                                recs, win_ops._deposit_tags(1, len(recs)))
     bf.win_update("h.chunk", self_weight=1.0,
                   neighbor_weights={r: {s: 1.0 for s in win.in_neighbors[r]}
                                     for r in range(8)},
@@ -406,9 +416,105 @@ def test_bf16_deposit_wire_roundtrip(bf_hosted):
     recs = win_ops._pack_deposit(win_ops._DEP_PUT, 0, 0.0, contrib)
     # 8 payload bytes on the wire, not 16 (the r4 f32 format)
     assert len(recs) == 2 and memoryview(recs[1]).nbytes == 8
-    cl.append_bytes_many([f"w.h.bf16.dep.{dst}.{k}"] * 2, recs)
+    _inject_deposit(cl, f"w.h.bf16.dep.{dst}.{k}", recs)
     win._drain_deposits()
     np.testing.assert_allclose(
         np.asarray(win._mail_rows[dst][k], np.float32),
         np.asarray(contrib, np.float32))
     bf.win_free("h.bf16")
+
+
+def test_clear_discards_orphaned_continuation_chunks(bf_hosted, monkeypatch):
+    """ADVICE r5 medium: a win_free/win_fence clear that races a
+    multi-chunk deposit consumes the deposit's PREFIX; the tail chunks
+    land afterwards as orphans. The tagged drain must DISCARD them (by
+    sequence id) and still fold the next complete deposit exactly — not
+    misparse the tail as a header ("wire corruption" / drain timeout)."""
+    monkeypatch.setenv("BLUEFOG_MAX_WIN_SENT_LENGTH", str(1 << 16))
+    elems = 40_000  # 160 KB f32 -> header record + 3 continuation chunks
+    x = jnp.zeros((8, elems), jnp.float32)
+    assert bf.win_create(x, "h.orph", zero_init=True)
+    win = win_ops._get_window("h.orph")
+    dst, src = 0, sorted(win.in_neighbors[0])[0]
+    k = win.layout.slot_of[dst][src]
+    key = f"w.h.orph.dep.{dst}.{k}"
+    cl = cp.client()
+    contrib = np.arange(elems, dtype=np.float32)
+    recs = win_ops._pack_deposit(win_ops._DEP_ACC, 0, 0.0, contrib)
+    assert len(recs) == 4
+    # seq-7 deposit: the clear ate records 0-1 (header + first chunk);
+    # only the orphaned TAIL is on the key
+    tags = win_ops._deposit_tags(7, len(recs))
+    cl.append_bytes_tagged_many([key] * 2, recs[2:], tags[2:])
+    # seq-8 deposit lands complete afterwards
+    _inject_deposit(cl, key, recs, seq=8)
+    cl.fetch_add(f"w.h.orph.v.{dst}.{k}", 1)
+    bf.win_update("h.orph", self_weight=1.0,
+                  neighbor_weights={r: {s: 1.0 for s in win.in_neighbors[r]}
+                                    for r in range(8)},
+                  reset=True)
+    # ONLY the complete deposit folded; the orphan tail vanished silently
+    np.testing.assert_allclose(
+        np.asarray(win.self_value)[0], contrib, rtol=1e-6)
+    bf.win_free("h.orph")
+
+
+def test_concurrent_clear_during_deposit_stress(bf_hosted, monkeypatch):
+    """Advisory races must not crash: hammer a mailbox key with chunked
+    deposits (sent in two halves to widen the race window) while the main
+    thread repeatedly clears it mid-flight (the win_free/_clear take) and
+    runs real drains. No exception anywhere, and the window stays usable."""
+    import threading
+
+    monkeypatch.setenv("BLUEFOG_MAX_WIN_SENT_LENGTH", str(1 << 16))
+    monkeypatch.setenv("BLUEFOG_WIN_DRAIN_TIMEOUT", "30")
+    elems = 40_000
+    x = jnp.zeros((8, elems), jnp.float32)
+    assert bf.win_create(x, "h.race", zero_init=True)
+    win = win_ops._get_window("h.race")
+    dst, src = 0, sorted(win.in_neighbors[0])[0]
+    k = win.layout.slot_of[dst][src]
+    key = f"w.h.race.dep.{dst}.{k}"
+    cl = cp.client()
+    contrib = np.ones(elems, np.float32)
+    stop = threading.Event()
+    errors = []
+
+    def depositor():
+        seq = 100
+        try:
+            while not stop.is_set():
+                recs = win_ops._pack_deposit(
+                    win_ops._DEP_ACC, 0, 0.0, contrib)
+                tags = win_ops._deposit_tags(seq, len(recs))
+                seq += 1
+                # two halves: a clear between them orphans the tail
+                cl.append_bytes_tagged_many([key] * 2, recs[:2], tags[:2])
+                cl.append_bytes_tagged_many(
+                    [key] * (len(recs) - 2), recs[2:], tags[2:])
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    t = threading.Thread(target=depositor)
+    t.start()
+    try:
+        for i in range(40):
+            if i % 3 == 0:
+                cl.take_bytes(key)  # the _clear analog, mid-deposit
+            else:
+                win._drain_deposits()  # the win_update drain path
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors, errors
+    # the window still works end to end: a fresh deposit folds exactly
+    win._drain_deposits()  # consume any leftover complete deposits
+    base = np.asarray(win._mail_rows[dst][k], np.float64).copy()
+    _inject_deposit(cl, key, win_ops._pack_deposit(
+        win_ops._DEP_ACC, 0, 0.0, contrib), seq=999)
+    win._drain_deposits()
+    np.testing.assert_allclose(
+        np.asarray(win._mail_rows[dst][k], np.float64), base + 1.0,
+        rtol=1e-6)
+    assert np.all(np.isfinite(win._mail_rows[dst][k]))
+    bf.win_free("h.race")

@@ -29,6 +29,9 @@ def _scrubbed_env():
         env.pop(k, None)
     repo = str(TESTS.parent)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # every launch here targets the simulated CPU mesh; don't let children
+    # probe a possibly-wedged accelerator tunnel (multi-minute hang each)
+    env["JAX_PLATFORMS"] = "cpu"
     return env
 
 

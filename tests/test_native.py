@@ -149,3 +149,70 @@ def test_bulk_bytes_roundtrip_and_bounded_take():
             cl.append_bytes("box", b"\x00" * (1 << 30))
         assert cl.get("k.0") == 10  # connection still healthy
         cl.close()
+
+
+def test_append_bytes_tagged_prefixes_records():
+    """kAppendBytesTagged: each record's int64 tag is prefixed to the
+    stored record server-side, and untagged appends interleave on the same
+    key untouched (the window drain's orphan-discard wire contract)."""
+    with native.ControlPlaneServer(world=1, port=0) as srv:
+        cl = native.ControlPlaneClient("127.0.0.1", srv.port, rank=0)
+        tags = [(5 << 24) | 0, (5 << 24) | 1]
+        cl.append_bytes_tagged_many(["tg", "tg"], [b"head", b"cont"], tags)
+        recs = cl.take_bytes("tg")
+        assert [int.from_bytes(r[:8], "little") for r in recs] == tags
+        assert [r[8:] for r in recs] == [b"head", b"cont"]
+        cl.close()
+
+
+def test_take_bytes_many_views_zero_copy_drain():
+    """take_bytes_many_views: record memoryviews alias ONE native reply
+    buffer; contents match the copying take_bytes_many exactly."""
+    with native.ControlPlaneServer(world=1, port=0) as srv:
+        cl = native.ControlPlaneClient("127.0.0.1", srv.port, rank=0)
+        cl.append_bytes("v.0", b"aa")
+        cl.append_bytes("v.0", b"b" * 4096)
+        cl.append_bytes("v.2", b"ccc")
+        batches, owner = cl.take_bytes_many_views(["v.0", "v.1", "v.2"])
+        try:
+            assert [bytes(r) for r in batches[0]] == [b"aa", b"b" * 4096]
+            assert batches[1] == []
+            assert [bytes(r) for r in batches[2]] == [b"ccc"]
+            assert all(isinstance(r, memoryview)
+                       for recs in batches for r in recs)
+        finally:
+            owner.close()
+        # close() invalidates the owner view (backstop against dangling use)
+        assert len(owner.view) == 0
+        cl.close()
+
+
+def test_bounded_inflight_multi_out_no_deadlock():
+    """Regression (ADVICE r5): a bytes batch with tens of thousands of
+    records deadlocked — the server's 12-byte replies filled both socket
+    buffers while the client was still blocked writing payload, parking
+    each side in a write the other would never drain. CallBytesMultiOutV
+    now bounds unread replies at 128 in flight; this record count (50k)
+    reproduced the hang before the fix."""
+    n = 50_000
+    with native.ControlPlaneServer(world=1, port=0) as srv:
+        cl = native.ControlPlaneClient("127.0.0.1", srv.port, rank=0)
+        names = [f"dl.{i % 7}" for i in range(n)]
+        blobs = [b"x" * 16] * n
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(cl.append_bytes_many(names, blobs)),
+            daemon=True)
+        t.start()
+        t.join(timeout=120)
+        assert done, "bytes batch deadlocked (unbounded in-flight replies)"
+        assert len(done[0]) == n and all(r >= 1 for r in done[0])
+        total = 0
+        for k in range(7):
+            while True:
+                recs = cl.take_bytes(f"dl.{k}")
+                if not recs:
+                    break
+                total += len(recs)
+        assert total == n
+        cl.close()
