@@ -30,6 +30,7 @@ a different framework than the mesh); import fails loudly otherwise.
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, List, Sequence
 
 import numpy as np
@@ -50,28 +51,75 @@ if keras.backend.backend() != "jax":  # pragma: no cover - env-dependent
 __all__ = ["broadcast_variables", "DistributedOptimizer"]
 
 
-def _stacked(models: Sequence["keras.Model"]) -> List[np.ndarray]:
-    """[per-owned-rank model] -> per-variable LOCAL rank stacks
-    (positional: keras auto-numbers layer names per replica, so variable
-    PATHS differ across structurally identical models)."""
-    owned = _owned_ranks()
-    if len(models) != len(owned):
-        raise ValueError(
-            f"need one model replica per rank this controller owns "
-            f"({len(owned)}), got {len(models)}")
+class _CommPlan:
+    """Cached stack/scatter plan for one fixed list of model replicas.
+
+    Re-walking ``trainable_variables + non_trainable_variables``,
+    re-validating shapes, and allocating fresh ``np.stack`` outputs on
+    EVERY communicate was a measured slice of the keras frontend's ~53 ms
+    per-step host tax (PERF.md r6 frontend probe). The plan keeps the
+    validated per-replica variable lists and one preallocated stacked
+    buffer per variable, refilled in place each call. Entries evict when
+    any replica is garbage-collected (weakref callbacks). Mutating a
+    model's variable STRUCTURE mid-training (adding layers) is out of
+    contract, as it is for the reference's broadcast hooks."""
+
+    __slots__ = ("per", "shapes", "bufs", "refs")
+
+    def __init__(self, per, shapes, refs) -> None:
+        self.per = per        # per[replica][i] -> keras variable
+        self.shapes = shapes
+        self.bufs: List[np.ndarray] = [None] * len(shapes)
+        self.refs = refs
+
+
+_plan_cache = {}
+
+
+def _comm_plan(models) -> _CommPlan:
+    key = tuple(id(m) for m in models)
+    plan = _plan_cache.get(key)
+    if plan is not None and all(r() is not None for r in plan.refs):
+        return plan
     per = [m.trainable_variables + m.non_trainable_variables for m in models]
     shapes = [tuple(v.shape) for v in per[0]]
     for vs in per[1:]:
         if [tuple(v.shape) for v in vs] != shapes:
             raise ValueError("models must share an identical variable set")
-    return [np.stack([np.asarray(vs[i]) for vs in per])
-            for i in range(len(shapes))]
+    refs = [weakref.ref(m, lambda _r, k=key: _plan_cache.pop(k, None))
+            for m in models]
+    plan = _plan_cache[key] = _CommPlan(per, shapes, refs)
+    return plan
+
+
+def _stacked(models: Sequence["keras.Model"]) -> List[np.ndarray]:
+    """[per-owned-rank model] -> per-variable LOCAL rank stacks
+    (positional: keras auto-numbers layer names per replica, so variable
+    PATHS differ across structurally identical models; plan-cached)."""
+    owned = _owned_ranks()
+    if len(models) != len(owned):
+        raise ValueError(
+            f"need one model replica per rank this controller owns "
+            f"({len(owned)}), got {len(models)}")
+    plan = _comm_plan(models)
+    out = []
+    for i in range(len(plan.shapes)):
+        rows = [np.asarray(vs[i]) for vs in plan.per]
+        buf = plan.bufs[i]
+        if (buf is None or buf.shape != (len(rows),) + rows[0].shape
+                or buf.dtype != rows[0].dtype):
+            buf = plan.bufs[i] = np.empty(
+                (len(rows),) + rows[0].shape, rows[0].dtype)
+        for r, row in enumerate(rows):
+            buf[r] = row
+        out.append(buf)
+    return out
 
 
 def _write_back(models, mixed: List[np.ndarray]) -> None:
-    for r, m in enumerate(models):
-        for i, v in enumerate(m.trainable_variables
-                              + m.non_trainable_variables):
+    plan = _comm_plan(models)
+    for r in range(len(plan.per)):
+        for i, v in enumerate(plan.per[r]):
             v.assign(mixed[i][r])
 
 

@@ -624,16 +624,13 @@ class Window:
         """One published row as a zero-copy array over the native reply.
 
         Returns ``(row, owner)``; the caller folds the row and then
-        ``owner.close()``. The win_get pipeline uses this per source so
-        the next source's stream overlaps the current source's fold,
-        without ``string_at``-copying 100 MB rows on the way through."""
-        cl = _cp.client()
-        owner = cl._bytes_multi_in_raw(cl._OP_GET_BYTES,
-                                       [self._self_key(rank)])
-        (ln,) = struct.unpack_from("<Q", owner.view, 0)
-        self._check_published_len(rank, ln)
-        row = np.frombuffer(owner.view[8:8 + ln], self.dtype).reshape(
-            self.row_shape)
+        ``owner.close()``. Large rows arrive as concurrent byte-range
+        stripes over the connection pool (``get_bytes_view``); the win_get
+        pipeline additionally keeps several sources in flight at once, so
+        the pool stays saturated while earlier sources fold."""
+        view, owner = _cp.client().get_bytes_view(self._self_key(rank))
+        self._check_published_len(rank, len(view))
+        row = np.frombuffer(view, self.dtype).reshape(self.row_shape)
         return row, owner
 
     def _fold_record(self, dst: int, k: int, mode: int,
@@ -664,7 +661,7 @@ class Window:
         is a pure byte copy with no accumulation pass. Accumulate-mode
         stages into a scratch buffer and folds once complete."""
         seq = int.from_bytes(rec[:_DEP_TAG], "little") >> 24
-        mode, has_p, pc, _nchunks = struct.unpack_from("<BBdI", rec, _DEP_TAG)
+        mode, has_p, pc, nchunks = struct.unpack_from("<BBdI", rec, _DEP_TAG)
         if mode == _DEP_PUT:
             target = self._mail_rows[pair[0]][pair[1]].reshape(-1).view(
                 np.uint8)
@@ -673,7 +670,45 @@ class Window:
             expect = self._mail_rows[pair[0]][pair[1]].nbytes
             staging = np.empty(expect, np.uint8)
             target = staging
-        return _PendingDeposit(mode, has_p, pc, seq, target, staging)
+        pend = _PendingDeposit(mode, has_p, pc, seq, nchunks, target, staging)
+        # compact single-record form: a header carrying payload inline
+        body = rec[_DEP_TAG + _DEP_HDR:]
+        if len(body):
+            pend.target[:len(body)] = np.frombuffer(body, np.uint8)
+            pend.hdr_len = pend.got = len(body)
+        return pend
+
+    def _place_chunk(self, pair, pend: "_PendingDeposit", idx: int,
+                     body, expect: int) -> None:
+        """Place one continuation chunk at its deterministic offset.
+
+        Striped senders fan a deposit's chunk records across the
+        connection pool, so chunks may arrive in ANY order; the tag index
+        pins each one's offset — every chunk except the last is exactly
+        the sender's chunk size (learned from whichever non-last chunk
+        arrives first), and the last chunk anchors to the tail. In-order
+        single-stream arrival degenerates to the same math."""
+        blen = len(body)
+        off = -1
+        bad = idx < 1 or idx > pend.nchunks or idx in pend.seen
+        if not bad:
+            if idx == pend.nchunks:
+                off = expect - blen
+            else:
+                if pend.cap is None:
+                    pend.cap = blen
+                off = pend.hdr_len + (idx - 1) * pend.cap
+                bad = blen != pend.cap
+        if bad or off < 0 or off + blen > expect:
+            raise RuntimeError(
+                f"window '{self.name}': deposit chunk {idx} for (rank, "
+                f"slot) {pair} of {blen} bytes does not fit the expected "
+                f"{expect}-byte payload — wire corruption or a mismatched "
+                "window shape across controllers")
+        if blen:
+            pend.target[off:off + blen] = np.frombuffer(body, np.uint8)
+            pend.got += blen
+        pend.seen.add(idx)
 
     def _finish_deposit(self, pair, pend: _PendingDeposit) -> None:
         if pend.mode == _DEP_ACC:
@@ -708,11 +743,17 @@ class Window:
         (wire dtype == mail dtype, no accumulation pass) or an acc-mode
         staging buffer.
 
-        **Orphan discard** (ADVICE r5 medium): every record carries the
-        server-prefixed deposit tag. A continuation chunk whose (seq,
-        index) doesn't extend the key's pending deposit — the tail a
-        win_free/win_fence clear raced past — is discarded instead of
-        being misparsed as a header.
+        **Striped reassembly + orphan discard** (r7): every record carries
+        the server-prefixed deposit tag. Chunks place at their tag-index
+        offset, so a striped origin's out-of-order arrivals (chunk records
+        fanned across the connection pool) reassemble exactly; pendings
+        are keyed per (mailbox key, seq) so interleaved deposits from
+        independent origin namespaces coexist. Orphans — the tail a
+        win_free/win_fence clear raced past — are recognized two ways:
+        a chunk with no drained header (senders append the header before
+        any chunk, so a missing header was eaten, not late), and a pending
+        superseded by a newer deposit counter in its own origin namespace
+        (deposits are fully appended before their successor starts).
 
         ``strict`` (caller holds the rank mutexes AND the job opted in via
         ``BLUEFOG_WIN_STRICT=1``): verify the write/read exclusion actually
@@ -733,14 +774,19 @@ class Window:
         expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
             _win_wire_dtype(self.mail_dtype).itemsize
         touched: set = set()
-        partial: Dict[Tuple[int, int], _PendingDeposit] = {}
+        # Striped origins fan one deposit's chunk records across the
+        # connection pool, so records of ADJACENT deposits (and of
+        # interleaved origins, each in its own tag namespace) can arrive
+        # interleaved: pendings are keyed per (mailbox key, seq).
+        partial: Dict[Tuple[int, int], Dict[int, _PendingDeposit]] = {}
         orphans = 0
         drain_timeout = float(os.environ.get(
             "BLUEFOG_WIN_DRAIN_TIMEOUT", "60"))
 
-        def sweep(poll_pairs):
+        def sweep(poll_pairs, pooled=True):
             poll_names = [self._dep_key(r, k) for r, k in poll_pairs]
-            return (_Prefetch(lambda: cl.take_bytes_many_views(poll_names)),
+            return (_Prefetch(lambda: cl.take_bytes_many_views(
+                        poll_names, pooled=pooled)),
                     poll_pairs)
 
         fetch, fetch_pairs = sweep(pairs)
@@ -749,52 +795,73 @@ class Window:
             cur_pairs, fetch = fetch_pairs, None
             got = any(batches)
             if got:
-                # progress: sweep everything once more, streamed WHILE the
-                # records below fold (an empty extra sweep costs one RTT)
-                fetch, fetch_pairs = sweep(pairs)
+                # Progress: sweep everything once more, streamed WHILE the
+                # records below fold (an empty extra sweep costs one RTT).
+                # Pool the next sweep only when THIS round hauled bulk
+                # bytes: fat backlogs stripe across the connection pool,
+                # while trickle rounds stay on one pipelined connection —
+                # a pooled sweep's extra round-trips would otherwise let a
+                # fast depositor outrun the drain loop indefinitely.
+                round_bytes = sum(len(r) for recs in batches for r in recs)
+                fetch, fetch_pairs = sweep(
+                    pairs,
+                    pooled=round_bytes >= getattr(
+                        cl, "_stripe_min", 1 << 22))
             try:
                 for pair, records in zip(cur_pairs, batches):
                     if not records:
                         continue
                     touched.add(pair)
-                    pend = partial.pop(pair, None)
+                    pend_map = partial.get(pair)
+                    if pend_map is None:
+                        pend_map = partial[pair] = {}
+                    # newest deposit counter seen per origin namespace this
+                    # round — anything older it supersedes is orphaned
+                    ns_max: Dict[int, int] = {}
                     for rec in records:
                         tag = int.from_bytes(rec[:_DEP_TAG], "little")
                         seq, idx = tag >> 24, tag & 0xFFFFFF
-                        body = rec[_DEP_TAG + (_DEP_HDR if idx == 0 else 0):]
+                        ns, ctr = seq >> 32, seq & 0xFFFFFFFF
+                        prev = ns_max.get(ns)
+                        if prev is None or _seq_newer(ctr, prev):
+                            ns_max[ns] = ctr
                         if idx == 0:
-                            if pend is not None:
-                                # structurally impossible from the clear
-                                # race (a clear eats a deposit's PREFIX);
-                                # belt-and-braces for a corrupted peer
+                            if seq in pend_map:
+                                # duplicate header: impossible from the
+                                # clear race; belt-and-braces for a
+                                # corrupted peer
                                 orphans += 1
-                            pend = self._start_deposit(pair, rec)
-                        elif (pend is None or seq != pend.seq
-                                or idx != pend.next_idx):
-                            # orphaned continuation: a win_free/win_fence
-                            # clear consumed this deposit's header + early
-                            # chunks; the tail landed afterwards
-                            orphans += 1
-                            continue
+                            pend = pend_map[seq] = self._start_deposit(
+                                pair, rec)
                         else:
-                            pend.next_idx += 1
-                        blen = len(body)
-                        if pend.got + blen > expect:
-                            raise RuntimeError(
-                                f"window '{self.name}': deposit for (rank, "
-                                f"slot) {pair} carries {pend.got + blen} "
-                                f"bytes, expected {expect} — wire "
-                                "corruption or a mismatched window shape "
-                                "across controllers")
-                        if blen:
-                            pend.target[pend.got:pend.got + blen] = \
-                                np.frombuffer(body, np.uint8)
-                            pend.got += blen
+                            pend = pend_map.get(seq)
+                            if pend is None:
+                                # Orphaned continuation: every sender
+                                # appends a deposit's header before any of
+                                # its chunks reach the server (the striped
+                                # append's phase split pins this), so a
+                                # chunk whose header we never drained means
+                                # a win_free/win_fence clear ate the
+                                # deposit's prefix — discard the tail.
+                                orphans += 1
+                                continue
+                            self._place_chunk(pair, pend,
+                                              idx, rec[_DEP_TAG:], expect)
                         if pend.got == expect:
                             self._finish_deposit(pair, pend)
-                            pend = None
-                    if pend is not None:
-                        partial[pair] = pend
+                            del pend_map[seq]
+                    # GC: per-origin deposit counters are monotonic and a
+                    # deposit is fully appended before its successor starts,
+                    # so a pending superseded by a NEWER counter in its own
+                    # namespace can never complete — its missing records
+                    # were consumed by a concurrent clear.
+                    for seq_o in list(pend_map):
+                        m = ns_max.get(seq_o >> 32)
+                        if m is not None and _seq_newer(m, seq_o & 0xFFFFFFFF):
+                            del pend_map[seq_o]
+                            orphans += 1
+                    if not pend_map:
+                        del partial[pair]
             finally:
                 owner.close()
             if not partial:
@@ -806,12 +873,13 @@ class Window:
             # deposit alive forever (healthy gossip traffic would otherwise
             # reset a shared clock on every round).
             now = time.monotonic()
-            stale = [p for p, pend in partial.items()
-                     if now - pend.t0 > drain_timeout]
+            stale = sorted({p for p, pmap in partial.items()
+                            for pend in pmap.values()
+                            if now - pend.t0 > drain_timeout})
             if stale:
                 raise RuntimeError(
                     f"window '{self.name}': deposit chunk sequence for "
-                    f"(rank, slot) {sorted(stale)} never completed within "
+                    f"(rank, slot) {stale} never completed within "
                     f"{drain_timeout:.0f}s — the origin died mid-deposit "
                     "(BLUEFOG_WIN_DRAIN_TIMEOUT)")
             if not got:
@@ -819,7 +887,7 @@ class Window:
                 # the awaited continuations; don't sweep owned x d_max keys
                 # 200x/s while waiting on one slow origin
                 time.sleep(0.005)
-                fetch, fetch_pairs = sweep(sorted(partial))
+                fetch, fetch_pairs = sweep(sorted(partial), pooled=False)
         if orphans:
             logger.debug(
                 "window '%s': discarded %d orphaned deposit chunk(s) left "
@@ -1026,14 +1094,25 @@ _DEP_TAG = 8  # server-prefixed i64 tag bytes per stored record
 _DEFAULT_MAX_SENT = 16 << 20
 
 
-def _deposit_tags(seq: int, nrec: int) -> List[int]:
+def _deposit_tags(seq: int, nrec: int, origin: int = 0) -> List[int]:
     """Per-record int64 tags for one deposit: ``seq << 24 | record_index``.
 
-    ``seq`` wraps at 39 bits (uniqueness only matters between ADJACENT
-    deposits on one single-writer key); 24 index bits cover rows up to
-    ~1 PB at the 64 KiB chunk floor."""
-    base = (seq & 0x7FFFFFFFFF) << 24
+    The 39-bit seq field namespaces a 32-bit per-origin deposit counter
+    under a 7-bit origin id (``origin << 32 | counter``): the drain's
+    supersession GC compares counters only within one origin's namespace,
+    so interleaved writers (one per controller in the multi-origin stress
+    shape) cannot orphan each other's in-flight deposits. The counter
+    wraps modularly (uniqueness only matters between ADJACENT deposits on
+    one key); 24 index bits cover rows up to ~1 PB at the 64 KiB chunk
+    floor."""
+    base = (((origin & 0x7F) << 32) | (seq & 0xFFFFFFFF)) << 24
     return [base | (i & 0xFFFFFF) for i in range(nrec)]
+
+
+def _seq_newer(a: int, b: int) -> bool:
+    """Modular 32-bit counter comparison: is ``a`` strictly newer than
+    ``b``? (Wrap-safe for the per-origin deposit counters.)"""
+    return a != b and ((a - b) & 0xFFFFFFFF) < (1 << 31)
 
 
 class _Prefetch:
@@ -1073,19 +1152,24 @@ class _PendingDeposit:
     the wire dtype IS the mail dtype, so a put needs no accumulation pass
     at all — or a staging buffer for accumulate-mode, folded once complete.
     This replaces the r5 join-then-frombuffer-then-cast fold (three full
-    copies of every drained byte) with one copy per byte."""
+    copies of every drained byte) with one copy per byte. Chunks land at
+    their tag-index offset (``_place_chunk``), so a striped origin's
+    out-of-order arrivals reassemble exactly; completion is by byte count."""
 
-    __slots__ = ("mode", "has_p", "pc", "seq", "next_idx", "got",
-                 "staging", "target", "t0")
+    __slots__ = ("mode", "has_p", "pc", "seq", "nchunks", "cap", "hdr_len",
+                 "got", "seen", "staging", "target", "t0")
 
     def __init__(self, mode: int, has_p: int, pc: float, seq: int,
-                 target: np.ndarray, staging) -> None:
+                 nchunks: int, target: np.ndarray, staging) -> None:
         self.mode = mode
         self.has_p = has_p
         self.pc = pc
         self.seq = seq
-        self.next_idx = 1  # record 0 (the header) creates this object
+        self.nchunks = nchunks
+        self.cap = None      # sender chunk size, learned from any non-last
+        self.hdr_len = 0     # bytes carried inline by the header record
         self.got = 0
+        self.seen: set = set()  # chunk indices already placed
         self.target = target    # flat uint8 view, len == expected bytes
         self.staging = staging  # acc-mode staging array (None for put)
         self.t0 = time.monotonic()
@@ -1432,8 +1516,9 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                 win._dep_seq += 1
                                 dep_names.extend([key] * len(recs))
                                 dep_blobs.extend(recs)
-                                dep_tags.extend(
-                                    _deposit_tags(win._dep_seq, len(recs)))
+                                dep_tags.extend(_deposit_tags(
+                                    win._dep_seq, len(recs),
+                                    origin=st.process_index))
                                 dep_edge_of.extend(
                                     [(src, dst, k)] * len(recs))
                         # post-send self scaling (push-sum down-weighting)
@@ -1520,19 +1605,26 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                     if any(table[src].get(dst) is not None
                            for dst in win.owned):
                         fold_src(src, win._rows[src])
-                # Remote rows: per-source zero-copy fetches chained through
-                # a prefetch thread, so source i+1 STREAMS while source i
-                # FOLDS (the r5 single bulk read serialized the full
-                # 2x-row stream ahead of any fold work — win_get ran at
-                # 31-39 % of the raw-get transport ceiling).
-                nxt = (_Prefetch(lambda s=remote_srcs[0]:
-                                 win._read_remote_self_view(s))
-                       if remote_srcs else None)
+                # Remote rows: ALL sources issue in flight at once (bounded
+                # by the pool width for memory), each fetched as striped
+                # byte ranges over the connection pool, folding in source
+                # order as they land. The r6 1-deep chain overlapped one
+                # stream with one fold; with the pool the streams
+                # themselves also run concurrently.
+                depth = max(2, getattr(_cp.client(), "streams", 1))
+                fetches: Dict[int, _Prefetch] = {}
+
+                def launch(j):
+                    fetches[j] = _Prefetch(
+                        lambda s=remote_srcs[j]:
+                        win._read_remote_self_view(s))
+
+                for j in range(min(depth, len(remote_srcs))):
+                    launch(j)
                 for j, src in enumerate(remote_srcs):
-                    row, owner = nxt.result()
-                    nxt = (_Prefetch(lambda s=remote_srcs[j + 1]:
-                                     win._read_remote_self_view(s))
-                           if j + 1 < len(remote_srcs) else None)
+                    row, owner = fetches.pop(j).result()
+                    if j + depth < len(remote_srcs):
+                        launch(j + depth)
                     try:
                         fold_src(src, row)
                     finally:

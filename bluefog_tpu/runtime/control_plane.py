@@ -164,19 +164,22 @@ def client() -> ControlPlaneClient:
     return _client
 
 
-def extra_client() -> ControlPlaneClient:
+def extra_client(streams: Optional[int] = None) -> ControlPlaneClient:
     """A NEW dedicated connection to the attached server (caller closes it).
 
     The shared :func:`client` connection serializes calls and can be parked
     for seconds inside a blocking server-side op (window mutex lock,
     barrier). Subsystems that must stay live regardless — the heartbeat
     above all, whose silence marks this controller DEAD — run their traffic
-    over their own connection instead.
+    over their own connection instead. ``streams`` overrides the client's
+    striped-pool width (the microbench's single-stream ceiling probe pins
+    it to 1).
     """
     if _conn_params is None:
         raise RuntimeError("control plane is not attached")
     host, port, rank, secret = _conn_params
-    return ControlPlaneClient(host, port, rank, secret=secret)
+    return ControlPlaneClient(host, port, rank, secret=secret,
+                              streams=streams)
 
 
 def world() -> int:

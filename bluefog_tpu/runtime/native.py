@@ -45,6 +45,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_cp_serve_auth.restype = ctypes.c_void_p
     lib.bf_cp_serve_auth.argtypes = [ctypes.c_int, ctypes.c_int,
                                      ctypes.c_char_p, ctypes.c_int64]
+    lib.bf_cp_serve_auth2.restype = ctypes.c_void_p
+    lib.bf_cp_serve_auth2.argtypes = [ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.c_int]
     lib.bf_cp_server_port.restype = ctypes.c_int
     lib.bf_cp_server_port.argtypes = [ctypes.c_void_p]
     lib.bf_cp_server_stop.restype = None
@@ -54,6 +58,32 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_cp_connect_auth.restype = ctypes.c_void_p
     lib.bf_cp_connect_auth.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                        ctypes.c_int, ctypes.c_char_p]
+    lib.bf_cp_connect_auth2.restype = ctypes.c_void_p
+    lib.bf_cp_connect_auth2.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                        ctypes.c_int, ctypes.c_char_p,
+                                        ctypes.c_int]
+    lib.bf_cp_bytes_len.restype = ctypes.c_int64
+    lib.bf_cp_bytes_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bf_cp_put_bytes_part.restype = ctypes.c_int64
+    lib.bf_cp_put_bytes_part.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.bf_cp_get_bytes_part.restype = ctypes.c_int64
+    lib.bf_cp_get_bytes_part.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
+    lib.bf_cp_put_bytes_striped.restype = ctypes.c_int64
+    lib.bf_cp_put_bytes_striped.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.bf_cp_get_bytes_striped.restype = ctypes.c_int64
+    lib.bf_cp_get_bytes_striped.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+    ]
     for fname in ("bf_cp_barrier", "bf_cp_lock", "bf_cp_unlock", "bf_cp_get"):
         fn = getattr(lib, fname)
         fn.restype = ctypes.c_int64
@@ -182,6 +212,105 @@ class NativeReply:
             pass
 
 
+# -- striped multi-connection transport knobs (r7) ---------------------------
+#
+# The hosted window plane was measured STREAM-bound (PERF.md r6 fold-vs-
+# stream probe: a 102 MB drain folds 4-8x faster than its socket take), so
+# the transport escapes the single-TCP-stream wall the way Horovod-lineage
+# systems do: a pool of BLUEFOG_CP_STREAMS authenticated connections per
+# (client, server) pair, large bodies striped across it, and tunable socket
+# buffers at both ends. BLUEFOG_CP_STREAMS=1 is the strict fallback: no
+# extra connections are ever opened and every byte rides the single
+# connection exactly as before.
+
+def _env_streams() -> int:
+    try:
+        v = int(os.environ.get("BLUEFOG_CP_STREAMS", "4"))
+    except ValueError:
+        return 4
+    return max(1, min(v, 16))
+
+
+def _env_sockbuf_bytes() -> int:
+    # Default 0 = keep the kernel's auto-tuned buffers. Measured on
+    # loopback: pinning SO_SNDBUF/SO_RCVBUF disables Linux's buffer
+    # auto-grow and LOSES ~10-15 % (PERF.md r7); the knob exists for
+    # cross-host DCN paths whose bandwidth-delay product outruns the
+    # auto-tuner's limits.
+    try:
+        mb = float(os.environ.get("BLUEFOG_CP_SOCKBUF_MB", "0"))
+    except ValueError:
+        mb = 0.0
+    return max(0, int(mb * (1 << 20)))
+
+
+def _env_stripe_min_bytes() -> int:
+    try:
+        mb = float(os.environ.get("BLUEFOG_CP_STRIPE_MIN_MB", "4"))
+    except ValueError:
+        mb = 4.0
+    return max(1, int(mb * (1 << 20)))
+
+
+def _blob_len(b) -> int:
+    return len(b) if isinstance(b, (bytes, bytearray)) else \
+        memoryview(b).nbytes
+
+
+def _run_parallel(fns):
+    """Run thunks on worker threads (caller runs the first); returns their
+    results in order, re-raising the first failure. The native calls inside
+    release the GIL, so pool connections genuinely transfer concurrently."""
+    if len(fns) == 1:
+        return [fns[0]()]
+    results = [None] * len(fns)
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = fns[i]()
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True,
+                                name="bf-cp-stripe")
+               for i in range(1, len(fns))]
+    for t in threads:
+        t.start()
+    run(0)
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results
+
+
+class _MultiReply:
+    """Owner over several NativeReply buffers (a pooled multi-key drain).
+
+    Exposes no aggregate ``view`` (records alias the per-connection reply
+    buffers); the attribute exists empty so callers can treat any drain
+    owner uniformly, and ``close()`` invalidates every sub-buffer's views
+    exactly like a single :class:`NativeReply`."""
+
+    view = memoryview(b"")
+
+    def __init__(self, owners) -> None:
+        self._owners = list(owners)
+
+    def close(self) -> None:
+        for o in self._owners:
+            o.close()
+        self._owners = []
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 class ControlPlaneServer:
     """Coordinator side of the scalar control plane (one per job).
 
@@ -194,13 +323,17 @@ class ControlPlaneServer:
     """
 
     def __init__(self, world: int, port: int = 0, secret: str = "",
-                 max_mailbox_bytes: int = 0) -> None:
+                 max_mailbox_bytes: int = 0,
+                 sockbuf_bytes: Optional[int] = None) -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = lib
-        self._h = lib.bf_cp_serve_auth(port, world, secret.encode(),
-                                       int(max_mailbox_bytes))
+        if sockbuf_bytes is None:
+            sockbuf_bytes = _env_sockbuf_bytes()
+        self._h = lib.bf_cp_serve_auth2(port, world, secret.encode(),
+                                        int(max_mailbox_bytes),
+                                        int(sockbuf_bytes))
         if not self._h:
             raise OSError(f"control plane failed to bind port {port}")
         self.port = lib.bf_cp_server_port(self._h)
@@ -219,20 +352,70 @@ class ControlPlaneServer:
 
 
 class ControlPlaneClient:
-    """Per-controller client: mutexes, counters, barriers, scalar KV."""
+    """Per-controller client: mutexes, counters, barriers, scalar KV.
+
+    ``streams`` (default ``BLUEFOG_CP_STREAMS``, 4) sizes the striped
+    connection pool used for large bulk bodies: the primary connection plus
+    ``streams - 1`` extra authenticated connections, opened LAZILY on the
+    first striped transfer (scalar-only clients — heartbeat, short-lived
+    test actors — never pay for them). Each pool connection runs the full
+    mutual HMAC handshake. ``streams=1`` is the strict single-connection
+    fallback: no pool, and every code path below degrades to the exact r6
+    wire behavior.
+    """
 
     def __init__(self, host: str, port: int, rank: int,
-                 secret: str = "") -> None:
+                 secret: str = "", streams: Optional[int] = None,
+                 sockbuf_bytes: Optional[int] = None) -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = lib
-        self._h = lib.bf_cp_connect_auth(host.encode(), port, rank,
-                                         secret.encode())
+        self._conn = (host, port, rank, secret)
+        self._sockbuf = _env_sockbuf_bytes() if sockbuf_bytes is None \
+            else int(sockbuf_bytes)
+        self.streams = _env_streams() if streams is None \
+            else max(1, int(streams))
+        self._stripe_min = _env_stripe_min_bytes()
+        self._extra: list = []       # lazily-opened pool connections
+        self._pool_mu = threading.Lock()
+        self._h = lib.bf_cp_connect_auth2(host.encode(), port, rank,
+                                          secret.encode(), self._sockbuf)
         if not self._h:
             raise OSError(
                 f"control plane connect to {host}:{port} failed"
                 + (" (authentication handshake rejected?)" if secret else ""))
+
+    # -- striped connection pool -------------------------------------------
+
+    def _pool_handles(self) -> list:
+        """All pool connections (primary first), opening extras on demand.
+
+        A failed extra connect degrades the pool width with a log line
+        instead of failing the transfer — the primary connection always
+        works (we are talking to a live server)."""
+        if self.streams <= 1:
+            return [self._h]
+        with self._pool_mu:
+            while len(self._extra) < self.streams - 1:
+                host, port, rank, secret = self._conn
+                h = self._lib.bf_cp_connect_auth2(
+                    host.encode(), port, rank, secret.encode(),
+                    self._sockbuf)
+                if not h:
+                    logger.warning(
+                        "control plane stripe connection %d/%d to %s:%d "
+                        "failed; continuing with a narrower pool",
+                        len(self._extra) + 2, self.streams, host, port)
+                    self.streams = len(self._extra) + 1
+                    break
+                self._extra.append(h)
+            return [self._h] + list(self._extra)
+
+    def _pool_array(self):
+        handles = self._pool_handles()
+        arr = (ctypes.c_void_p * len(handles))(*handles)
+        return arr, len(handles)
 
     def barrier(self, name: str = "default") -> int:
         r = self._lib.bf_cp_barrier(self._h, name.encode())
@@ -365,14 +548,18 @@ class ControlPlaneClient:
     _OP_GET_BYTES = 11
     _OP_APPEND_BYTES_TAGGED = 13
 
-    def _bytes_multi_out(self, op: int, names, blobs, tags=None) -> list:
+    def _bytes_multi_out(self, op: int, names, blobs, tags=None,
+                         handle=None) -> list:
         """Records may be ``bytes`` or any C-contiguous buffer (numpy
         views): payloads are passed by POINTER to the native scatter-gather
-        write, so a 100 MB deposit costs zero Python-side copies."""
+        write, so a 100 MB deposit costs zero Python-side copies.
+        ``handle`` selects a pool connection (default: the primary)."""
         names = list(names)
         blobs = list(blobs)  # may be a generator; it's iterated twice below
         if not names:
             return []
+        if handle is None:
+            handle = self._h
         n = len(names)
         ptrs = (ctypes.c_void_p * n)()
         lens = (ctypes.c_int64 * n)()
@@ -408,25 +595,27 @@ class ControlPlaneClient:
         out = (ctypes.c_int64 * n)()
         if tags is None:
             r = self._lib.bf_cp_bytes_multi_outv(
-                self._h, op, "\n".join(names).encode(), ptrs, lens, out, n)
+                handle, op, "\n".join(names).encode(), ptrs, lens, out, n)
         else:
             tag_arr = (ctypes.c_int64 * n)(*[int(t) for t in tags])
             r = self._lib.bf_cp_bytes_multi_outv_tagged(
-                self._h, op, "\n".join(names).encode(), ptrs, lens,
+                handle, op, "\n".join(names).encode(), ptrs, lens,
                 tag_arr, out, n)
         if r < 0:
             raise OSError("control plane bytes batch failed (connection "
                           "lost or not authenticated)")
         return list(out)
 
-    def _bytes_multi_in_raw(self, op: int, names) -> NativeReply:
+    def _bytes_multi_in_raw(self, op: int, names,
+                            handle=None) -> NativeReply:
         """One pipelined bulk-reply batch; the (u64 len | payload)* reply
         stays in the native buffer, exposed as a zero-copy view."""
         n = len(names)
         out = ctypes.c_void_p()
         out_len = ctypes.c_int64()
         if self._lib.bf_cp_bytes_multi_in(
-                self._h, op, "\n".join(names).encode(), n,
+                self._h if handle is None else handle, op,
+                "\n".join(names).encode(), n,
                 ctypes.byref(out), ctypes.byref(out_len)) < 0:
             raise OSError("control plane bytes batch failed (connection "
                           "lost or not authenticated)")
@@ -460,15 +649,99 @@ class ControlPlaneClient:
         prefixed to the stored record server-side (kAppendBytesTagged).
         The window drain uses the tag — (sequence id, chunk index, chunk
         count) — to discard orphaned continuation chunks after a
-        concurrent clear instead of misparsing them as headers."""
+        concurrent clear instead of misparsing them as headers.
+
+        With a striped pool (``streams > 1``) and a large enough batch,
+        the deposit HEADER records (tag index 0) go out first on the
+        primary connection, then the payload chunk records stripe
+        round-robin across the whole pool and transfer concurrently. The
+        header-before-chunks server arrival order is what lets the drain
+        treat a header-less chunk as a definitively orphaned deposit (a
+        concurrent clear ate its prefix) rather than an early arrival;
+        chunk-vs-chunk order is free because chunk tags carry their index
+        and the drain places them by offset."""
+        names, blobs, tags = list(names), list(blobs), list(tags)
+        if (self.streams > 1 and len(names) > 1
+                and sum(_blob_len(b) for b in blobs) >= self._stripe_min):
+            return self._striped_append_tagged(names, blobs, tags)
         return self._bytes_multi_out(self._OP_APPEND_BYTES_TAGGED, names,
                                      blobs, tags=tags)
 
+    def _striped_append_tagged(self, names, blobs, tags) -> list:
+        op = self._OP_APPEND_BYTES_TAGGED
+        hdr = [i for i, t in enumerate(tags) if (int(t) & 0xFFFFFF) == 0]
+        chunk = [i for i, t in enumerate(tags) if (int(t) & 0xFFFFFF) != 0]
+        out = [0] * len(names)
+
+        def scatter(idxs, replies):
+            for i, r in zip(idxs, replies):
+                out[i] = r
+
+        if hdr:  # phase 1: all headers, appended before any chunk streams
+            scatter(hdr, self._bytes_multi_out(
+                op, [names[i] for i in hdr], [blobs[i] for i in hdr],
+                tags=[tags[i] for i in hdr]))
+        if chunk:  # phase 2: chunks round-robin over the pool, concurrent
+            pool = self._pool_handles()
+            ngroups = min(len(pool), len(chunk))
+            groups = [chunk[g::ngroups] for g in range(ngroups)]
+            replies = _run_parallel([
+                lambda h=pool[g], idxs=groups[g]: self._bytes_multi_out(
+                    op, [names[i] for i in idxs], [blobs[i] for i in idxs],
+                    tags=[tags[i] for i in idxs], handle=h)
+                for g in range(ngroups)])
+            for g in range(ngroups):
+                scatter(groups[g], replies[g])
+        return out
+
     def put_bytes_many(self, names, blobs) -> None:
-        """Pipelined multi-put of bytes slots (batched self publishes)."""
-        for r in self._bytes_multi_out(self._OP_PUT_BYTES, names, blobs):
-            if r < 0:
-                raise OSError("control plane put_bytes_many failed")
+        """Pipelined multi-put of bytes slots (batched self publishes).
+
+        Bodies at or above the stripe threshold transfer as concurrent
+        byte-range stripes over the connection pool (each body saturates
+        the pool in turn); smaller ones ride one pipelined batch on the
+        primary connection, exactly as before."""
+        names, blobs = list(names), list(blobs)
+        small_idx, large_idx = [], []
+        for i, b in enumerate(blobs):
+            (large_idx if self.streams > 1
+             and _blob_len(b) >= self._stripe_min else small_idx).append(i)
+        for i in large_idx:
+            self._put_bytes_striped(names[i], blobs[i])
+        if small_idx:
+            for r in self._bytes_multi_out(
+                    self._OP_PUT_BYTES, [names[i] for i in small_idx],
+                    [blobs[i] for i in small_idx]):
+                if r < 0:
+                    raise OSError("control plane put_bytes_many failed")
+
+    def _put_bytes_striped(self, name: str, blob) -> None:
+        # zero-copy pointer extraction, same discipline as _bytes_multi_out
+        if isinstance(blob, (bytes, bytearray)):
+            keep = ctypes.c_char_p(bytes(blob))
+            ptr = ctypes.cast(keep, ctypes.c_void_p)
+            nbytes = len(blob)
+        else:
+            mv = memoryview(blob).cast("B")
+            if mv.readonly:
+                keep = ctypes.c_char_p(mv.tobytes())
+                ptr = ctypes.cast(keep, ctypes.c_void_p)
+            else:
+                keep = mv
+                ptr = ctypes.c_void_p(ctypes.addressof(
+                    ctypes.c_char.from_buffer(mv)) if mv.nbytes else 0)
+            nbytes = mv.nbytes
+        if nbytes > self._MAX_PAYLOAD:
+            raise ValueError(
+                f"put_bytes: payload of {nbytes} bytes exceeds the "
+                f"{self._MAX_PAYLOAD}-byte per-message ceiling")
+        arr, nh = self._pool_array()
+        r = self._lib.bf_cp_put_bytes_striped(arr, nh, name.encode(),
+                                              ptr, nbytes)
+        del keep
+        if r < 0:
+            raise OSError("control plane striped put_bytes failed "
+                          "(connection lost or not authenticated)")
 
     @staticmethod
     def _parse_take_reply(payload) -> list:
@@ -490,28 +763,59 @@ class ControlPlaneClient:
             out.append(self._parse_take_reply(payload))
         return out
 
-    def take_bytes_many_views(self, names):
+    @staticmethod
+    def _parse_multi_in(payload, n) -> list:
+        out = []
+        off = 0
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<Q", payload, off)
+            off += 8
+            out.append(ControlPlaneClient._parse_take_reply(
+                payload[off:off + ln]))
+            off += ln
+        return out
+
+    def take_bytes_many_views(self, names, pooled: bool = True):
         """Zero-copy multi-drain: ``(per-key record lists, owner)``.
 
-        Records are memoryview slices aliasing ONE native reply buffer —
+        Records are memoryview slices aliasing the native reply buffers —
         a 100+ MB drain is parsed without the full-payload copies
         :meth:`take_bytes_many` pays (``string_at`` + per-record bytes
         slices). The caller must finish consuming every record view and
         then ``owner.close()`` (use as a context manager); this is the
-        hosted window drain's hot path."""
+        hosted window drain's hot path.
+
+        With a striped pool (and ``pooled=True``) the keys split
+        round-robin across the connections and every sub-drain streams
+        concurrently — the win_update per-in-neighbor sweep issues on the
+        whole pool at once instead of serializing source after source.
+        Each key is still drained by exactly one connection per sweep, so
+        per-key record order is preserved. ``pooled=False`` keeps the
+        sweep on one pipelined connection — callers pass it when the
+        expected haul is small (a pooled sweep's extra round-trips and
+        threads cost more than they parallelize there; the window drain
+        adapts per round on the previous round's byte count)."""
         names = list(names)
         if not names:
             return [], NativeReply(self._lib, ctypes.c_void_p(), 0)
-        owner = self._bytes_multi_in_raw(self._OP_TAKE_BYTES, names)
-        payload = owner.view
-        out = []
-        off = 0
-        for _ in range(len(names)):
-            (ln,) = struct.unpack_from("<Q", payload, off)
-            off += 8
-            out.append(self._parse_take_reply(payload[off:off + ln]))
-            off += ln
-        return out, owner
+        pool = self._pool_handles() if pooled and self.streams > 1 \
+            and len(names) > 1 else [self._h]
+        if len(pool) == 1:
+            owner = self._bytes_multi_in_raw(self._OP_TAKE_BYTES, names)
+            return self._parse_multi_in(owner.view, len(names)), owner
+        ngroups = min(len(pool), len(names))
+        groups = [list(range(g, len(names), ngroups))
+                  for g in range(ngroups)]
+        owners = _run_parallel([
+            lambda h=pool[g], idxs=groups[g]: self._bytes_multi_in_raw(
+                self._OP_TAKE_BYTES, [names[i] for i in idxs], handle=h)
+            for g in range(ngroups)])
+        out = [None] * len(names)
+        for g in range(ngroups):
+            for i, recs in zip(groups[g], self._parse_multi_in(
+                    owners[g].view, len(groups[g]))):
+                out[i] = recs
+        return out, _MultiReply(owners)
 
     def get_bytes_many(self, names) -> list:
         """Pipelined multi-read of bytes slots (batched win_get pulls)."""
@@ -533,14 +837,55 @@ class ControlPlaneClient:
         return list(out)
 
     def put_bytes(self, name: str, data: bytes) -> None:
-        """Overwrite the named bytes slot (the 'exposed window' copy)."""
+        """Overwrite the named bytes slot (the 'exposed window' copy).
+        Large bodies stripe across the connection pool (readers only ever
+        observe complete values: stripes assemble server-side and swap in
+        atomically)."""
+        if self.streams > 1 and _blob_len(data) >= self._stripe_min:
+            return self._put_bytes_striped(name, data)
         self._check_payload("put_bytes", data)
         if self._lib.bf_cp_put_bytes(self._h, name.encode(), data,
                                      len(data)) < 0:
             raise OSError("control plane put_bytes failed")
 
+    def bytes_len(self, name: str) -> int:
+        """Current byte length of the named bytes slot (0 when never put)."""
+        r = self._lib.bf_cp_bytes_len(self._h, name.encode())
+        if r < 0:
+            raise OSError("control plane bytes_len failed")
+        return int(r)
+
+    def get_bytes_view(self, name: str):
+        """Read a bytes slot as ``(memoryview, owner)`` with zero Python
+        copies; large bodies are fetched as concurrent byte-range stripes
+        over the pool. The caller consumes the view, then ``owner.close()``
+        (the win_get hot path)."""
+        if self.streams > 1:
+            ln = self.bytes_len(name)
+            if ln >= self._stripe_min:
+                arr, nh = self._pool_array()
+                out = ctypes.c_void_p()
+                out_len = ctypes.c_int64()
+                if self._lib.bf_cp_get_bytes_striped(
+                        arr, nh, name.encode(), ctypes.byref(out),
+                        ctypes.byref(out_len)) < 0:
+                    raise OSError("control plane striped get_bytes failed "
+                                  "(connection lost or value churning)")
+                owner = NativeReply(self._lib, out, out_len.value)
+                return owner.view, owner
+        owner = self._bytes_multi_in_raw(self._OP_GET_BYTES, [name])
+        (ln,) = struct.unpack_from("<Q", owner.view, 0)
+        return owner.view[8:8 + ln], owner
+
     def get_bytes(self, name: str) -> bytes:
         """Read the named bytes slot (empty when never put)."""
+        if self.streams > 1 and \
+                self.bytes_len(name) >= self._stripe_min:
+            view, owner = self.get_bytes_view(name)
+            try:
+                return bytes(view)
+            finally:
+                owner.close()
         out = ctypes.c_void_p()
         out_len = ctypes.c_int64()
         r = self._lib.bf_cp_get_bytes(self._h, name.encode(),
@@ -555,6 +900,10 @@ class ControlPlaneClient:
             self._lib.bf_cp_free(out)
 
     def close(self) -> None:
+        with self._pool_mu:
+            for h in self._extra:
+                self._lib.bf_cp_disconnect(h)
+            self._extra = []
         if self._h:
             self._lib.bf_cp_disconnect(self._h)
             self._h = None

@@ -40,4 +40,29 @@ else:  # jax <= 0.4.x
 # simply doesn't exist on old avals — getattr-with-default handles that).
 typeof = jax.typeof if hasattr(jax, "typeof") else jax.core.get_aval
 
-__all__ = ["shard_map", "typeof"]
+# ``AbstractMesh``: newer releases construct from (axis_sizes, axis_names);
+# 0.4.x takes one shape_tuple of (name, size) pairs — passing the new form
+# there silently lands the names in axis_types and dies inside mesh
+# internals. Dispatch once on the signature.
+from jax.sharding import AbstractMesh as _AbstractMesh  # noqa: E402
+
+_am_params = list(inspect.signature(_AbstractMesh.__init__).parameters)
+if "shape_tuple" in _am_params:  # jax <= 0.4.x
+    def abstract_mesh(axis_sizes, axis_names) -> "_AbstractMesh":
+        return _AbstractMesh(tuple(zip(axis_names, axis_sizes)))
+else:
+    def abstract_mesh(axis_sizes, axis_names) -> "_AbstractMesh":
+        return _AbstractMesh(tuple(axis_sizes), tuple(axis_names))
+
+abstract_mesh.__doc__ = (
+    "AbstractMesh(axis_sizes, axis_names) across the jax API change "
+    "(0.4.x used a single ((name, size), ...) shape_tuple).")
+
+# 0.4.x AOT lowering cannot resolve a device assignment for AbstractMesh
+# arg shardings (`_device_assignment is not implemented`); the shard_map
+# in_specs carry the partitioning into the lowered module regardless, so
+# AOT callers drop the ShapeDtypeStruct shardings there.
+ABSTRACT_MESH_ARG_SHARDINGS = "shape_tuple" not in _am_params
+
+__all__ = ["shard_map", "typeof", "abstract_mesh",
+           "ABSTRACT_MESH_ARG_SHARDINGS"]

@@ -34,6 +34,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -186,6 +187,16 @@ enum Op : uint8_t {
   kBarrier = 1, kLock = 2, kUnlock = 3, kFetchAdd = 4, kPut = 5, kGet = 6,
   kShutdown = 7, kAppendBytes = 8, kTakeBytes = 9, kPutBytes = 10,
   kGetBytes = 11, kBoxBytes = 12, kAppendBytesTagged = 13,
+  // Striped bulk transfers (r7): one logical put/get split into byte ranges
+  // carried CONCURRENTLY over a pool of connections (BLUEFOG_CP_STREAMS),
+  // the single-TCP-stream escape Horovod/BytePS use for large tensors.
+  //   kPutBytesPart: arg = (offset << 32) | total_len. Parts assemble in a
+  //     per-key staging buffer; the completed buffer swaps into bytes_kv
+  //     atomically, so readers never observe a torn value.
+  //   kBytesLen: int reply = current bytes_kv[key] size (a striped reader
+  //     learns the range to fan out before issuing kGetBytesPart reads).
+  //   kGetBytesPart: arg = (offset << 32) | len; bulk reply = that slice.
+  kPutBytesPart = 14, kBytesLen = 15, kGetBytesPart = 16,
 };
 
 // -- SHA-256 / HMAC-SHA256 (self-contained; no OpenSSL in the image) --------
@@ -341,6 +352,15 @@ constexpr uint32_t kMaxMsg = 1u << 30;       // 1 GiB bulk-payload ceiling
 // backlog from a sleeping controller from producing an unbounded reply.
 constexpr size_t kMaxTakeReply = 64u << 20;  // 64 MiB
 
+// Striped-put assembly state: parts land in a staging buffer; the LAST part
+// to finish its copy swaps the buffer into bytes_kv, so concurrent readers
+// only ever see complete values. One writer per key (the transport contract
+// for bytes slots) keeps the out-of-lock memcpy below race-free.
+struct PutStaging {
+  std::string buf;
+  int64_t got = 0;
+};
+
 struct ControlServer {
   int listen_fd = -1;
   int world = 0;
@@ -356,7 +376,11 @@ struct ControlServer {
   std::map<std::string, int64_t> kv;
   std::map<std::string, std::vector<std::string>> mailbox;  // append/take
   std::map<std::string, int64_t> box_bytes;                 // payload bytes
-  std::map<std::string, std::string> bytes_kv;              // put/get bytes
+  // put/get bytes slots. shared_ptr values so a get can stream the bytes
+  // to the socket WITHOUT holding the mutex (and without copying): the
+  // reader pins the value; a concurrent put swaps in a fresh one.
+  std::map<std::string, std::shared_ptr<const std::string>> bytes_kv;
+  std::map<std::string, PutStaging> put_staging;            // striped puts
   std::map<std::string, int> lock_owner;           // key -> rank (or -1)
   std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
   std::map<std::string, int> barrier_count;
@@ -477,7 +501,16 @@ struct ControlServer {
           // orphaned continuation chunks after a concurrent clear. The
           // prefix rides the copy the append makes anyway, so tagging is
           // free on the wire and in server memory (+8 bytes/record).
+          //
+          // The record copy happens OUTSIDE the server mutex: a 16 MB
+          // chunk memcpy under the global lock would serialize every
+          // other connection's handler behind it — on a contended host
+          // that lock hold time IS the transport ceiling (PERF.md r7).
           const size_t extra = (op == kAppendBytesTagged) ? 8 : 0;
+          std::string rec;
+          rec.reserve(dlen + extra);
+          if (extra) rec.append(reinterpret_cast<const char*>(&arg), 8);
+          rec.append(data, dlen);
           std::lock_guard<std::mutex> lk(mu);
           auto& box = mailbox[key];
           int64_t& bytes = box_bytes[key];
@@ -491,10 +524,6 @@ struct ControlServer {
             reply = -2;
             break;
           }
-          std::string rec;
-          rec.reserve(dlen + extra);
-          if (extra) rec.append(reinterpret_cast<const char*>(&arg), 8);
-          rec.append(data, dlen);
           box.emplace_back(std::move(rec));
           bytes += static_cast<int64_t>(dlen + extra);
           reply = static_cast<int64_t>(box.size());
@@ -531,30 +560,126 @@ struct ControlServer {
               }
             }
           }
-          std::string payload;
+          // Stream the reply straight from the taken records (they are
+          // owned by this handler now — no lock needed, and no second
+          // full-payload assembly copy; a 64 MB drain reply costs zero
+          // server-side memcpys beyond the kernel's).
+          uint64_t total = 0;
+          for (const auto& r : records) total += 4 + r.size();
+          uint32_t rlen = static_cast<uint32_t>(total);
+          if (!WriteAll(fd, &rlen, 4)) return CloseFd(fd);
           for (const auto& r : records) {
             uint32_t rl = static_cast<uint32_t>(r.size());
-            payload.append(reinterpret_cast<const char*>(&rl), 4);
-            payload.append(r);
+            if (!WriteAll(fd, &rl, 4) ||
+                (!r.empty() && !WriteAll(fd, r.data(), r.size())))
+              return CloseFd(fd);
           }
-          if (!SendBytesReply(fd, payload)) return CloseFd(fd);
           replied = true;
           break;
         }
         case kPutBytes: {
+          // Copy outside the mutex, swap inside: a 100 MB assign under
+          // the global lock would stall every other handler for its
+          // whole duration (readers still only ever observe complete
+          // values — the pointer swap is atomic under the lock).
+          auto val = std::make_shared<const std::string>(data, dlen);
           std::lock_guard<std::mutex> lk(mu);
-          bytes_kv[key].assign(data, dlen);
+          bytes_kv[key] = std::move(val);
           reply = 1;
           break;
         }
         case kGetBytes: {
-          std::string payload;
+          std::shared_ptr<const std::string> v;
           {
             std::lock_guard<std::mutex> lk(mu);
             auto it = bytes_kv.find(key);
-            if (it != bytes_kv.end()) payload = it->second;
+            if (it != bytes_kv.end()) v = it->second;
           }
-          if (!SendBytesReply(fd, payload)) return CloseFd(fd);
+          // zero-copy reply: stream straight from the pinned value
+          uint32_t rlen = v ? static_cast<uint32_t>(v->size()) : 0;
+          if (!WriteAll(fd, &rlen, 4) ||
+              (rlen && !WriteAll(fd, v->data(), rlen)))
+            return CloseFd(fd);
+          replied = true;
+          break;
+        }
+        case kPutBytesPart: {
+          // One stripe of a striped put: arg = (offset << 32) | total_len.
+          // The payload copy runs OUTSIDE the server mutex so stripes on
+          // parallel connections overlap; safety: the staging buffer is
+          // never resized while same-total stripes are in flight (single
+          // writer per key), and the swap below only fires after every
+          // stripe's copy has been counted in — the last counter is the
+          // copier itself, so no copy can still be running at swap time.
+          uint64_t a = static_cast<uint64_t>(arg);
+          size_t off = static_cast<size_t>(a >> 32);
+          size_t total = static_cast<size_t>(a & 0xFFFFFFFFu);
+          if (off + dlen > total || total > kMaxMsg) {
+            reply = -1;
+            break;
+          }
+          if (total == 0) {
+            std::lock_guard<std::mutex> lk(mu);
+            bytes_kv[key] = std::make_shared<const std::string>();
+            reply = 1;
+            break;
+          }
+          char* dst = nullptr;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            PutStaging& st = put_staging[key];
+            if (st.buf.size() != total) {
+              st.buf.assign(total, '\0');
+              st.got = 0;
+            }
+            dst = &st.buf[0];
+          }
+          if (dlen) std::memcpy(dst + off, data, dlen);
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = put_staging.find(key);
+            if (it != put_staging.end()) {
+              it->second.got += static_cast<int64_t>(dlen);
+              if (it->second.got >= static_cast<int64_t>(total)) {
+                bytes_kv[key] = std::make_shared<const std::string>(
+                    std::move(it->second.buf));
+                put_staging.erase(it);
+              }
+            }
+          }
+          reply = 1;
+          break;
+        }
+        case kBytesLen: {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = bytes_kv.find(key);
+          reply = (it == bytes_kv.end() || !it->second)
+                      ? 0
+                      : static_cast<int64_t>(it->second->size());
+          break;
+        }
+        case kGetBytesPart: {
+          // Ranged read: arg = (offset << 32) | len; reply is the slice
+          // clamped to the stored value (empty when offset is past the
+          // end), streamed zero-copy from the pinned value.
+          uint64_t a = static_cast<uint64_t>(arg);
+          size_t off = static_cast<size_t>(a >> 32);
+          size_t want = static_cast<size_t>(a & 0xFFFFFFFFu);
+          std::shared_ptr<const std::string> v;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto it = bytes_kv.find(key);
+            if (it != bytes_kv.end()) v = it->second;
+          }
+          size_t n = 0;
+          if (v && off < v->size()) {
+            size_t avail = v->size() - off;
+            n = want < avail ? want : avail;
+          }
+          uint32_t rlen = static_cast<uint32_t>(n);
+          if (!WriteAll(fd, &rlen, 4) ||
+              (n && !WriteAll(fd, v->data() + off, n)))
+            return CloseFd(fd);
           replied = true;
           break;
         }
@@ -725,6 +850,25 @@ struct ControlClient {
     return rlen;
   }
 
+  // Bulk-reply call that lands DIRECTLY in the caller's buffer (the striped
+  // kGetBytesPart read path): no malloc, no extra copy — each pool
+  // connection streams its range straight into its slice of the
+  // preallocated result. Returns bytes read, or -1 on wire failure /
+  // oversized reply (the connection is poisoned then; callers treat it as
+  // fatal, like every other -1 here).
+  int64_t CallBytesInto(uint8_t op, const std::string& key, int64_t arg,
+                        void* dst, size_t cap) {
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<char> buf;
+    Encode(&buf, op, key, arg);
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+    uint32_t rlen;
+    if (!ControlServer::ReadAll(fd, &rlen, 4)) return -1;
+    if (rlen > cap) return -1;
+    if (rlen && !ControlServer::ReadAll(fd, dst, rlen)) return -1;
+    return rlen;
+  }
+
   // Pipelined payload-carrying batch (kAppendBytes / kPutBytes): frame all
   // n requests, write them back-to-back, then drain the n int replies. One
   // round-trip's latency for a whole window op's deposits, and large
@@ -878,14 +1022,24 @@ struct ControlClient {
 
 }  // namespace
 
+// Apply SO_SNDBUF/SO_RCVBUF when requested (0 keeps the OS default). Set on
+// the LISTEN socket so accepted connections inherit it; on client sockets
+// before connect so the window scale is negotiated with it in effect.
+static void SetSockBuf(int fd, int bytes) {
+  if (bytes <= 0) return;
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
+}
+
 extern "C" {
 
-void* bf_cp_serve_auth(int port, int world, const char* secret,
-                       int64_t max_mailbox_bytes) {
+void* bf_cp_serve_auth2(int port, int world, const char* secret,
+                        int64_t max_mailbox_bytes, int sockbuf_bytes) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  SetSockBuf(fd, sockbuf_bytes);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
@@ -902,6 +1056,11 @@ void* bf_cp_serve_auth(int port, int world, const char* secret,
   srv->max_box_bytes = max_mailbox_bytes;
   srv->accept_thread = std::thread([srv] { srv->AcceptLoop(); });
   return srv;
+}
+
+void* bf_cp_serve_auth(int port, int world, const char* secret,
+                       int64_t max_mailbox_bytes) {
+  return bf_cp_serve_auth2(port, world, secret, max_mailbox_bytes, 0);
 }
 
 void* bf_cp_serve(int port, int world) {
@@ -940,10 +1099,11 @@ void bf_cp_server_stop(void* handle) {
   delete srv;
 }
 
-void* bf_cp_connect_auth(const char* host, int port, int rank,
-                         const char* secret) {
+void* bf_cp_connect_auth2(const char* host, int port, int rank,
+                          const char* secret, int sockbuf_bytes) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
+  SetSockBuf(fd, sockbuf_bytes);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(port));
@@ -965,6 +1125,11 @@ void* bf_cp_connect_auth(const char* host, int port, int rank,
   cl->fd = fd;
   cl->rank = rank;
   return cl;
+}
+
+void* bf_cp_connect_auth(const char* host, int port, int rank,
+                         const char* secret) {
+  return bf_cp_connect_auth2(host, port, rank, secret, 0);
 }
 
 void* bf_cp_connect(const char* host, int port, int rank) {
@@ -1010,6 +1175,101 @@ int64_t bf_cp_get_bytes(void* h, const char* key, void** out,
                                                    out_len);
 }
 void bf_cp_free(void* p) { std::free(p); }
+
+int64_t bf_cp_bytes_len(void* h, const char* key) {
+  return static_cast<ControlClient*>(h)->Call(kBytesLen, key, 0);
+}
+
+// One stripe of a striped put/get (the Python pool drives one call per
+// connection from its own thread; ctypes releases the GIL, so stripes
+// genuinely overlap). Offsets/lengths pack into the op's i64 arg.
+int64_t bf_cp_put_bytes_part(void* h, const char* key, int64_t offset,
+                             int64_t total, const void* data, int64_t len) {
+  int64_t arg = (offset << 32) | total;
+  return static_cast<ControlClient*>(h)->Call(
+      kPutBytesPart, key, arg, data, static_cast<size_t>(len));
+}
+
+int64_t bf_cp_get_bytes_part(void* h, const char* key, int64_t offset,
+                             int64_t len, void* dst) {
+  int64_t arg = (offset << 32) | len;
+  return static_cast<ControlClient*>(h)->CallBytesInto(
+      kGetBytesPart, key, arg, dst, static_cast<size_t>(len));
+}
+
+// Whole striped transfers driven natively: split the payload into nh
+// contiguous ranges and move them concurrently, one connection per range
+// (std::thread per extra stripe; the caller's thread carries stripe 0).
+// Used for single-key bulk bodies — the raw put_bytes/get_bytes ceiling and
+// the hosted window publish/fetch paths.
+int64_t bf_cp_put_bytes_striped(void** handles, int nh, const char* key,
+                                const void* data, int64_t len) {
+  if (nh <= 0) return -1;
+  if (nh == 1 || len < nh)
+    return bf_cp_put_bytes_part(handles[0], key, 0, len, data, len);
+  int64_t per = (len + nh - 1) / nh;
+  std::vector<std::thread> ts;
+  std::atomic<bool> ok{true};
+  auto run = [&](int i) {
+    int64_t off = per * i;
+    int64_t n = off + per > len ? len - off : per;
+    if (n <= 0) return;
+    if (bf_cp_put_bytes_part(handles[i], key, off, len,
+                             static_cast<const char*>(data) + off, n) < 0)
+      ok.store(false);
+  };
+  for (int i = 1; i < nh; ++i) ts.emplace_back(run, i);
+  run(0);
+  for (auto& t : ts) t.join();
+  return ok.load() ? 1 : -1;
+}
+
+// Like MPI_Get against a concurrently-written window, a striped read racing
+// an unsynchronized same-key writer has no atomicity guarantee across
+// stripes (use the window mutexes for exclusion, as MPI RMA prescribes). A
+// LENGTH change mid-read is detected (a stripe comes back short) and
+// retried a few times; persistent churn returns -1.
+int64_t bf_cp_get_bytes_striped(void** handles, int nh, const char* key,
+                                void** out, int64_t* out_len) {
+  if (nh <= 0) return -1;
+  for (int attempt = 0; attempt < 3; ++attempt) {
+    int64_t total = bf_cp_bytes_len(handles[0], key);
+    if (total < 0) return -1;
+    char* payload = static_cast<char*>(std::malloc(total ? total : 1));
+    if (!payload) return -1;
+    std::atomic<bool> failed{false}, short_read{false};
+    if (total > 0) {
+      int64_t per = (total + nh - 1) / nh;
+      std::vector<std::thread> ts;
+      auto run = [&](int i) {
+        int64_t off = per * i;
+        int64_t n = off + per > total ? total - off : per;
+        if (n <= 0) return;
+        int64_t got =
+            bf_cp_get_bytes_part(handles[i], key, off, n, payload + off);
+        if (got < 0)
+          failed.store(true);
+        else if (got != n)
+          short_read.store(true);  // value shrank mid-read: retry
+      };
+      for (int i = 1; i < nh; ++i) ts.emplace_back(run, i);
+      run(0);
+      for (auto& t : ts) t.join();
+    }
+    if (failed.load()) {
+      std::free(payload);
+      return -1;
+    }
+    if (short_read.load()) {
+      std::free(payload);
+      continue;
+    }
+    *out = payload;
+    *out_len = total;
+    return total;
+  }
+  return -1;
+}
 // Pipelined batch of n payload-carrying ops (kAppendBytes=8 / kPutBytes=10):
 // keys newline-separated, payloads concatenated in `blob` with per-record
 // lengths in `lens`; per-op int replies land in `out`.

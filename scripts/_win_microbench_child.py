@@ -41,6 +41,13 @@ CONFIGS = [
     ("f32_1MB", np.float32, 262_144, 30),
     ("bf16_51MB", ml_dtypes.bfloat16, 25_600_000, 4),
 ]
+# Explicit warmup ops excluded from every timed series (r7, de-noising):
+# the first ops of a kind pay compile + connection-pool + allocator +
+# page-cache costs that r6's medians let leak into win_put (37.9 vs 51.5
+# MB/s run-to-run on identical configs) — measured, the server/client
+# heaps take ~3 full 1.2 GB rounds to reach steady state on the CI box.
+# Timed rounds are steady-state medians.
+WARMUP = 3
 # --quick (CI smoke): tiny rows, 2 rounds — the full op/probe matrix still
 # runs, the numbers just don't mean anything
 if os.environ.get("BLUEFOG_WB_QUICK") == "1":
@@ -48,6 +55,7 @@ if os.environ.get("BLUEFOG_WB_QUICK") == "1":
         ("f32_256KB", np.float32, 65_536, 2),
         ("bf16_32KB", ml_dtypes.bfloat16, 16_384, 2),
     ]
+    WARMUP = 1
 
 
 def barrier():
@@ -101,11 +109,12 @@ def main() -> None:
 
         # -- win_put: 2 remote deposits + 1 self publish per op ------------
         ts = []
-        for _ in range(rounds):
+        for r in range(WARMUP + rounds):
             barrier()
             t0 = time.perf_counter()
             bf.win_put(x, name)
-            ts.append(time.perf_counter() - t0)
+            if r >= WARMUP:
+                ts.append(time.perf_counter() - t0)
             # keep server memory bounded: drain between rounds
             barrier()
             bf.win_update(name)
@@ -116,54 +125,65 @@ def main() -> None:
 
         # -- win_accumulate ------------------------------------------------
         ts = []
-        for _ in range(rounds):
+        for r in range(WARMUP + rounds):
             barrier()
             t0 = time.perf_counter()
             bf.win_accumulate(x, name)
-            ts.append(time.perf_counter() - t0)
+            if r >= WARMUP:
+                ts.append(time.perf_counter() - t0)
             barrier()
             bf.win_update(name)
         report(cl, pid, tag, "win_accumulate", ts, 3 * row_bytes)
 
         # -- win_update with 2 pending deposits per slot -------------------
         ts = []
-        for _ in range(rounds):
+        for r in range(WARMUP + rounds):
             bf.win_put(x, name)
             barrier()  # all deposits on the server before the drain
             t0 = time.perf_counter()
             bf.win_update(name)
-            ts.append(time.perf_counter() - t0)
+            if r >= WARMUP:
+                ts.append(time.perf_counter() - t0)
             barrier()
         report(cl, pid, tag, "win_update", ts, 2 * row_bytes)
 
         # -- win_get: pull 2 published remote rows -------------------------
         ts = []
-        for _ in range(rounds):
+        for r in range(WARMUP + rounds):
             barrier()
             t0 = time.perf_counter()
             bf.win_get(name)
-            ts.append(time.perf_counter() - t0)
+            if r >= WARMUP:
+                ts.append(time.perf_counter() - t0)
         report(cl, pid, tag, "win_get", ts, 2 * row_bytes)
 
         barrier()
         bf.win_free(name)
 
-        # -- transport ceiling: raw put_bytes/get_bytes of one row ---------
+        # -- transport ceiling: raw put_bytes/get_bytes of one row, at the
+        # full striped pool (the default client) AND pinned to ONE stream
+        # (a dedicated streams=1 client) — the r7 raw-ceiling probe, so a
+        # transport regression in either regime shows up in the same run.
         blob = x[0].tobytes()
-        ts = []
-        for _ in range(rounds):
-            barrier()
-            t0 = time.perf_counter()
-            cl.put_bytes(f"wb.raw.{pid}", blob)
-            ts.append(time.perf_counter() - t0)
-        report(cl, pid, tag, "raw_put_bytes", ts, row_bytes)
-        ts = []
-        for _ in range(rounds):
-            barrier()
-            t0 = time.perf_counter()
-            cl.get_bytes(f"wb.raw.{pid}")
-            ts.append(time.perf_counter() - t0)
-        report(cl, pid, tag, "raw_get_bytes", ts, row_bytes)
+        cl1 = control_plane.extra_client(streams=1)
+        for label, c in (("", cl), ("_1s", cl1)):
+            ts = []
+            for r in range(WARMUP + rounds):
+                barrier()
+                t0 = time.perf_counter()
+                c.put_bytes(f"wb.raw.{pid}", blob)
+                if r >= WARMUP:
+                    ts.append(time.perf_counter() - t0)
+            report(cl, pid, tag, f"raw_put_bytes{label}", ts, row_bytes)
+            ts = []
+            for r in range(WARMUP + rounds):
+                barrier()
+                t0 = time.perf_counter()
+                c.get_bytes(f"wb.raw.{pid}")
+                if r >= WARMUP:
+                    ts.append(time.perf_counter() - t0)
+            report(cl, pid, tag, f"raw_get_bytes{label}", ts, row_bytes)
+        cl1.close()
         cl.put_bytes(f"wb.raw.{pid}", b"")
 
         # -- fold-vs-stream isolation (r6): the same 2-deposit drain load,

@@ -6,16 +6,23 @@ scripts/_win_microbench_child.py in each, and relays controller 0's JSON
 result lines. Measures per-op latency and MB/s for win_put /
 win_accumulate / win_update / win_get on ResNet-sized (102 MB), small
 (1 MB), and bf16 windows, plus the raw put_bytes/get_bytes transport
-ceiling the numbers should be judged against.
+ceiling the numbers should be judged against — measured BOTH at the full
+striped connection pool (``raw_put_bytes``/``raw_get_bytes``, the default
+client) and pinned to one stream (``raw_put_bytes_1s``/``raw_get_bytes_1s``),
+so the striping win and either regime's regressions are visible in the
+same run. Every timed series is preceded by explicit warmup rounds
+(excluded from the medians): the first ops of a kind pay allocator +
+page-cache + pool-connect costs that otherwise masquerade as transport
+time (r6's win_put run-to-run swing).
 
 Also prints a fold-vs-stream isolation line per config: the same drained
 bytes timed as (a) the socket take alone and (b) the numpy fold alone, so
 the drain pipeline's overlap headroom is a measured number, not a guess.
 
 Usage:  python scripts/win_microbench.py [--quick]
-  --quick: tiny windows, 2 rounds — seconds instead of minutes; exercised
-           by the CI smoke test (tests/test_benchmark_smoke.py), numbers
-           are NOT meaningful for PERF.md.
+  --quick: tiny windows, 2 rounds, 1 warmup — seconds instead of minutes;
+           exercised by the CI smoke test (tests/test_benchmark_smoke.py),
+           numbers are NOT meaningful for PERF.md.
 """
 
 import argparse

@@ -53,7 +53,10 @@ def test_win_microbench_quick():
     """scripts/win_microbench.py --quick: the 4-controller hosted-plane
     drain/get pipeline (put, accumulate, pipelined update drain, win_get,
     fold-vs-stream probe) runs end to end at tiny sizes — the new drain
-    paths are CI-exercised, not hand-run only."""
+    paths are CI-exercised, not hand-run only. The r7 raw-ceiling probe
+    rows (raw put/get at the full striped pool AND pinned to one stream)
+    must be present with positive throughput, so a striped-transport
+    regression surfaces in-tree rather than only in manual PERF.md runs."""
     out = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "win_microbench.py"),
          "--quick"],
@@ -61,10 +64,15 @@ def test_win_microbench_quick():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "WIN_MICROBENCH_OK" in out.stdout, out.stdout + out.stderr
-    ops = {json.loads(l)["op"] for l in out.stdout.splitlines()
-           if l.startswith("{")}
+    rows = [json.loads(l) for l in out.stdout.splitlines()
+            if l.startswith("{")]
+    ops = {r["op"] for r in rows}
     assert {"win_put", "win_update", "win_get", "drain_stream",
-            "drain_fold"} <= ops, out.stdout
+            "drain_fold", "raw_put_bytes", "raw_get_bytes",
+            "raw_put_bytes_1s", "raw_get_bytes_1s"} <= ops, out.stdout
+    for r in rows:
+        if r["op"].startswith("raw_"):
+            assert r["mbps"] and r["mbps"] > 0, r
 
 
 @pytest.mark.slow

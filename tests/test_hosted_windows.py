@@ -459,6 +459,126 @@ def test_clear_discards_orphaned_continuation_chunks(bf_hosted, monkeypatch):
     bf.win_free("h.orph")
 
 
+def test_out_of_order_chunk_reassembly(bf_hosted, monkeypatch):
+    """r7 striped wire: chunk records of one deposit may arrive in ANY
+    order (they fan across the connection pool); the drain places each at
+    its tag-index offset and folds the reassembled payload exactly. Only
+    the header-before-chunks invariant is guaranteed by senders."""
+    monkeypatch.setenv("BLUEFOG_MAX_WIN_SENT_LENGTH", str(1 << 16))
+    elems = 40_000  # 160 KB f32 -> header + 3 chunks
+    x = jnp.zeros((8, elems), jnp.float32)
+    assert bf.win_create(x, "h.ooo", zero_init=True)
+    win = win_ops._get_window("h.ooo")
+    dst, src = 0, sorted(win.in_neighbors[0])[0]
+    k = win.layout.slot_of[dst][src]
+    key = f"w.h.ooo.dep.{dst}.{k}"
+    cl = cp.client()
+    contrib = np.arange(elems, dtype=np.float32)
+    recs = win_ops._pack_deposit(win_ops._DEP_ACC, 0, 0.0, contrib)
+    tags = win_ops._deposit_tags(5, len(recs))
+    assert len(recs) == 4
+    # header first (the sender invariant), then the chunks REVERSED —
+    # the last chunk lands before the drain has seen any full-size one
+    order = [0, 3, 2, 1]
+    cl.append_bytes_tagged_many([key] * len(order),
+                                [recs[i] for i in order],
+                                [tags[i] for i in order])
+    win._drain_deposits()
+    np.testing.assert_allclose(
+        np.asarray(win._mail_rows[dst][k]), contrib, rtol=1e-6)
+    bf.win_free("h.ooo")
+
+
+def test_multi_origin_striped_deposit_stress(bf_hosted, monkeypatch):
+    """r7 striped transport: TWO origins (each with its own striped
+    connection pool) hammer ONE mailbox key with chunked deposits whose
+    records fan out-of-order across the pool, concurrently with
+    ``win_update`` drains and ``win_fence`` clears from the owner. Every
+    deposited unit of mass must fold exactly once — a torn or misparsed
+    record would break the count or raise — for 20 consecutive rounds.
+
+    The origins tag their deposits in distinct namespaces
+    (``_deposit_tags(origin=...)``), so the drain's supersession GC must
+    not orphan one origin's in-flight deposit on seeing the other's."""
+    import threading
+
+    monkeypatch.setenv("BLUEFOG_MAX_WIN_SENT_LENGTH", str(1 << 16))
+    monkeypatch.setenv("BLUEFOG_CP_STRIPE_MIN_MB", "0.0625")  # 64 KiB
+    monkeypatch.setenv("BLUEFOG_CP_STREAMS", "4")
+    elems = 80_000  # 320 KB f32 -> header + 5 chunks, striped 4 ways
+    x = jnp.zeros((8, elems), jnp.float32)
+    assert bf.win_create(x, "h.multi", zero_init=True)
+    win = win_ops._get_window("h.multi")
+    dst, src = 0, sorted(win.in_neighbors[0])[0]
+    k = win.layout.slot_of[dst][src]
+    key = f"w.h.multi.dep.{dst}.{k}"
+    contrib = np.ones(elems, np.float32)
+    ROUNDS, DEPS = 20, 3
+    nw = {r: {s: 1.0 for s in win.in_neighbors[r]} for r in range(8)}
+    errors = []
+    collected = 0.0
+    starts = [threading.Event(), threading.Event()]
+    done = threading.Event()
+    acks = [threading.Event(), threading.Event()]
+
+    def origin_loop(i):
+        cl = cp.extra_client()
+        try:
+            assert cl.streams == 4
+            seq = 0
+            while not done.is_set():
+                if not starts[i].wait(0.1):
+                    continue
+                starts[i].clear()
+                for _ in range(DEPS):
+                    recs = win_ops._pack_deposit(
+                        win_ops._DEP_ACC, 0, 0.0, contrib)
+                    seq += 1
+                    cl.append_bytes_tagged_many(
+                        [key] * len(recs), recs,
+                        win_ops._deposit_tags(seq, len(recs),
+                                              origin=i + 1))
+                acks[i].set()
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+        finally:
+            cl.close()
+
+    threads = [threading.Thread(target=origin_loop, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for rnd in range(ROUNDS):
+            for i in range(2):
+                acks[i].clear()
+                starts[i].set()
+            # drains and a fence race the striped in-flight deposits
+            out = bf.win_update("h.multi", self_weight=0.0,
+                                neighbor_weights=nw, reset=True, clone=True)
+            collected += float(np.asarray(out, np.float64).sum())
+            bf.win_fence("h.multi")
+            out = bf.win_update("h.multi", self_weight=0.0,
+                                neighbor_weights=nw, reset=True, clone=True)
+            collected += float(np.asarray(out, np.float64).sum())
+            for i in range(2):
+                assert acks[i].wait(60), f"origin {i} stalled (round {rnd})"
+            assert not errors, errors
+    finally:
+        done.set()
+        for t in threads:
+            t.join(30)
+    assert not errors, errors
+    # final collect picks up whatever the in-loop drains missed
+    out = bf.win_update("h.multi", self_weight=0.0, neighbor_weights=nw,
+                        reset=True, clone=True)
+    collected += float(np.asarray(out, np.float64).sum())
+    # exactly once: 2 origins x ROUNDS x DEPS deposits of `elems` ones
+    np.testing.assert_allclose(collected, 2 * ROUNDS * DEPS * elems,
+                               rtol=1e-6)
+    bf.win_free("h.multi")
+
+
 def test_concurrent_clear_during_deposit_stress(bf_hosted, monkeypatch):
     """Advisory races must not crash: hammer a mailbox key with chunked
     deposits (sent in two halves to widen the race window) while the main
