@@ -48,8 +48,10 @@ from .runtime.state import (
 # handles
 from .runtime.handles import poll, synchronize, wait
 
-# failure detection / coordinated shutdown (multi-controller)
-from .runtime.heartbeat import dead_controllers, shutdown_requested
+# failure detection / coordinated shutdown / fault tolerance
+# (multi-controller; see docs/fault_tolerance.md)
+from .runtime.heartbeat import dead_controllers, dead_ranks, shutdown_requested
+from .runtime.native import PeerLostError
 
 # timeline
 from .runtime.timeline import (

@@ -113,6 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="python executable to run on remote hosts")
     p.add_argument("--simulate", type=int, default=None, metavar="N",
                    help="simulate an N-device CPU mesh (development)")
+    p.add_argument("--chaos", type=str, default=None, metavar="SPEC",
+                   help="arm deterministic control-plane fault injection in "
+                        "every launched process (exports BLUEFOG_CP_FAULT; "
+                        "spec e.g. 'drop_after=37,delay_ms=50,trunc=1,"
+                        "seed=7' — see docs/fault_tolerance.md). Testing "
+                        "only: never set on a production job")
     p.add_argument("--timeline-filename", type=str, default=None,
                    help="enable the timeline profiler, writing to this prefix")
     p.add_argument("--verbose", action="store_true",
@@ -248,6 +254,8 @@ def _fanout(args) -> int:
             out += ["--timeline-filename", args.timeline_filename]
         if args.verbose:
             out += ["--verbose"]
+        if args.chaos:
+            out += ["--chaos", args.chaos]
         return out + ["--"] + args.command
 
     procs: List[subprocess.Popen] = []
@@ -354,6 +362,12 @@ def main(argv=None) -> int:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
     if args.verbose:
         env["BLUEFOG_LOG_LEVEL"] = "debug"
+    if args.chaos:
+        # validate NOW so a typo'd spec fails the launch, not (silently,
+        # as a warning) deep inside every child's native-runtime load
+        from .runtime.native import parse_fault_spec
+        parse_fault_spec(args.chaos)
+        env["BLUEFOG_CP_FAULT"] = args.chaos
     if args.simulate:
         # Respect an explicit operator pin (JAX_PLATFORMS=cpu keeps a dev
         # box off a flaky accelerator tunnel: an unset value makes every

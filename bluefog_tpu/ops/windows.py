@@ -304,10 +304,23 @@ class _ControlPlaneWinHost:
         # thread arriving mid-acquire waits here (equivalent to waiting on
         # the server) instead of seeing depth>0 and entering the
         # "mutex-protected" region before the lock is actually granted.
+        from ..runtime.native import PeerLostError
+
         with self._mu_gate(rank):
             depth = self._mu_depth.get(rank, 0)
             if depth == 0:
-                self._cl.lock(f"{self._pre}.mu.{rank}")
+                try:
+                    self._cl.lock(f"{self._pre}.mu.{rank}")
+                except PeerLostError as exc:
+                    # typed + attributed: the caller (window optimizers'
+                    # self-healing retry, or user code) learns WHICH rank's
+                    # mutex had a dead holder; the lock itself was left
+                    # free, so a retried acquire succeeds.
+                    raise PeerLostError(
+                        f"window mutex for rank {rank}: holder died "
+                        f"mid-hold ({exc.args[0] if exc.args else exc}); "
+                        "re-acquire to continue on the shrunken topology",
+                        dead=exc.dead) from exc
             self._mu_depth[rank] = depth + 1
 
     def mutex_release(self, rank: int) -> None:
@@ -315,6 +328,8 @@ class _ControlPlaneWinHost:
         # between the depth write and the server unlock (the server lock is
         # re-entrant per controller, so it would be granted instantly and
         # then released out from under the new holder).
+        from ..runtime.native import PeerLostError
+
         with self._mu_gate(rank):
             depth = self._mu_depth.get(rank, 0) - 1
             if depth < 0:
@@ -322,7 +337,22 @@ class _ControlPlaneWinHost:
                                    "times than acquired")
             self._mu_depth[rank] = depth
             if depth == 0:
-                self._cl.unlock(f"{self._pre}.mu.{rank}")
+                try:
+                    self._cl.unlock(f"{self._pre}.mu.{rank}")
+                except PeerLostError as exc:
+                    # The lock was force-released OUT FROM UNDER this
+                    # holder (lease expiry, or our connection dropped and
+                    # transparently reconnected mid-hold): the exclusion
+                    # this critical section assumed may have been broken.
+                    # Release paths run in finally blocks — raising here
+                    # would mask the section's actual result — so warn
+                    # loudly instead; the data-plane protocols tolerate
+                    # the advisory race (module header) and the next
+                    # acquire starts a clean epoch.
+                    logger.warning(
+                        "window mutex for rank %d was force-released while "
+                        "held (%s): exclusion may have been broken for the "
+                        "section just completed", rank, exc)
 
     def op_mutex_ranks(self, touched) -> List[int]:
         # Owner-partitioned: each controller locks only the touched ranks it
@@ -1382,8 +1412,24 @@ def _bump_host_state(win: Window, table: Dict[int, Dict[int, float]],
 
 def _acquire(win: Window, ranks, require_mutex: bool):
     if require_mutex:
-        for r in win.host.op_mutex_ranks(ranks):
+        _acquire_all(win, win.host.op_mutex_ranks(ranks))
+
+
+def _acquire_all(win: Window, ranks) -> None:
+    """Acquire in order, releasing everything on a mid-sequence failure
+    (a dead holder's PeerLostError must not leak the earlier mutexes)."""
+    acquired = []
+    try:
+        for r in ranks:
             win.host.mutex_acquire(r)
+            acquired.append(r)
+    except BaseException:
+        for r in reversed(acquired):
+            try:
+                win.host.mutex_release(r)
+            except Exception:  # noqa: BLE001 — unwind must not mask
+                pass
+        raise
 
 
 def _release(win: Window, ranks, require_mutex: bool):
@@ -1456,8 +1502,7 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
     # target's mutex exactly like MPI_Win_lock on the target window. Sorted
     # order keeps concurrent origins deadlock-free.
     if require_mutex:
-        for r in touched:
-            win.host.mutex_acquire(r)
+        _acquire_all(win, touched)
     try:
         with timeline_context(win.name, activity), win.state_mu:
             use_p = st.win_ops_with_associated_p
@@ -1897,8 +1942,7 @@ def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
         # lock only OWNED ranks (the reference's win_update locks the local
         # window; remote ranks' updates are their owners' job)
         if require_mutex:
-            for r in win.owned:
-                win.host.mutex_acquire(r)
+            _acquire_all(win, win.owned)
         win.state_mu.acquire()
         try:
             win._drain_deposits(strict=require_mutex)
@@ -2045,8 +2089,22 @@ class win_mutex:
         self._ranks = sorted(set(ranks))
 
     def __enter__(self):
-        for r in self._ranks:
-            self._win.host.mutex_acquire(r)
+        # Exception-safe multi-acquire: a PeerLostError (dead holder) on
+        # the k-th rank must not leak the k-1 already-held mutexes — the
+        # self-healing retry (optimizers) re-enters this context, and a
+        # leaked depth count would pin those locks for the process's life.
+        acquired = []
+        try:
+            for r in self._ranks:
+                self._win.host.mutex_acquire(r)
+                acquired.append(r)
+        except BaseException:
+            for r in reversed(acquired):
+                try:
+                    self._win.host.mutex_release(r)
+                except Exception:  # noqa: BLE001 — unwind must not mask
+                    pass
+            raise
         return self
 
     def __exit__(self, *exc):

@@ -73,6 +73,8 @@ from .ops import fusion as _fusion
 from .ops import windows as _windows
 from .ops.neighbors import _dynamic_weight_matrix, _static_weight_matrix
 from .ops.plan import CombinePlan, spmd_combine
+from .runtime.logging import logger
+from .runtime.native import PeerLostError
 from .runtime.state import _global_state
 from .runtime.timeline import timeline_context
 from .utils.compat import shard_map
@@ -493,6 +495,58 @@ class DistributedShardedAllreduceOptimizer(_FusedOptimizer):
 # Window (asynchronous gossip) optimizers
 # ---------------------------------------------------------------------------
 
+def _live_neighbor_sets(win, dead):
+    """(live_out, live_in) neighbor maps with dead ranks excluded."""
+    n = win.size
+    return ({r: [d for d in win.out_neighbors[r] if d not in dead]
+             for r in range(n)},
+            {r: [s for s in win.in_neighbors[r] if s not in dead]
+             for r in range(n)})
+
+
+def _healed_recv_weights(win, dead, self_weight, neighbor_weights):
+    """Combine weights over the LIVE in-neighbor sets (self-healing gossip).
+
+    Defaults (both None) recompute the uniform ``1/(live_indegree + 1)``
+    average, so each survivor still forms a convex combination — the
+    shrunken-graph analog of win_update's own default. User-supplied
+    weights keep their shape: dead sources drop out and the remaining
+    entries (self included) rescale by one factor so each rank's total
+    weight is preserved (column renormalization, the same rule as
+    ``topology_util.prune_dead_ranks``)."""
+    from .ops.neighbors import _per_rank
+
+    n = win.size
+    _, live_in = _live_neighbor_sets(win, dead)
+    if self_weight is None and neighbor_weights is None:
+        u = {r: 1.0 / (len(live_in[r]) + 1) for r in range(n)}
+        return u, {r: {s: u[r] for s in live_in[r]} for r in range(n)}
+    sw = _per_rank(self_weight, n, "self_weight")
+    nw_table = _windows._edge_weights(neighbor_weights, win.in_neighbors,
+                                      1.0, "neighbor_weights", n)
+    out_sw, out_nw = {}, {}
+    for r in range(n):
+        total = float(sw[r]) + sum(nw_table[r].values())
+        live = {s: w for s, w in nw_table[r].items() if s not in dead}
+        live_total = float(sw[r]) + sum(live.values())
+        scale = total / live_total if live_total > 0 else 1.0
+        out_sw[r] = float(sw[r]) * scale
+        out_nw[r] = {s: w * scale for s, w in live.items()}
+    return out_sw, out_nw
+
+
+def _healed_send_table(win, dead, dst_weights):
+    """Send weights with dead destinations dropped (no rescale: put-style
+    send weights are per-edge multipliers, not a distributed mass)."""
+    n = win.size
+    live_out, _ = _live_neighbor_sets(win, dead)
+    if dst_weights is None:
+        return {r: {d: 1.0 for d in live_out[r]} for r in range(n)}
+    table = _windows._edge_weights(dst_weights, win.out_neighbors, 1.0,
+                                   "dst_weights", n)
+    return {r: {d: w for d, w in table[r].items() if d not in dead}
+            for r in range(n)}
+
 class _WindowOptimizer(_FusedOptimizer):
     """Local fused update + host-scheduled window gossip.
 
@@ -579,14 +633,31 @@ class _WindowOptimizer(_FusedOptimizer):
     def _gossip(self, buffers):  # packed [n, total] buffers -> mixed buffers
         raise NotImplementedError
 
-    def _gossip_peers(self, win, owned):
+    def _dead_ranks(self) -> set:
+        """Mesh ranks hosted by dead controllers, consulted EVERY gossip
+        step (self-healing topology): the window strategies drop these
+        from their edge sets and renormalize, so a SIGKILLed peer shrinks
+        the graph within one heartbeat timeout instead of stalling the
+        survivors. Only meaningful on the hosted plane — the compiled
+        collective plane needs every controller dispatching anyway."""
+        win = _windows._get_window(self._win_names[0])
+        if not win.hosted:
+            return set()
+        from .runtime.heartbeat import dead_ranks
+
+        return dead_ranks()
+
+    def _gossip_peers(self, win, owned, dead=frozenset()):
         """Remote ranks whose mutexes this controller's gossip ops lock
         (superset of every inner op's lock set — the hoisted acquisition
         must cover them all or the inner ops would acquire out of global
-        sorted order). Put-family ops lock write destinations."""
-        return {d for s in owned for d in win.out_neighbors[s]}
+        sorted order). Put-family ops lock write destinations; dead ranks
+        are excluded — the healed edge tables never touch them, and
+        skipping their mutexes avoids pointless server lock rounds."""
+        return {d for s in owned for d in win.out_neighbors[s]
+                if d not in dead}
 
-    def _hoisted_mutex(self, name):
+    def _hoisted_mutex(self, name, dead=frozenset()):
         """One mutex acquisition for the whole put+update pair.
 
         The inner ops still pass ``require_mutex=True``; their acquires are
@@ -600,7 +671,7 @@ class _WindowOptimizer(_FusedOptimizer):
             ranks = range(win.size)
         else:
             owned = set(win.owned)
-            ranks = sorted(owned | self._gossip_peers(win, owned))
+            ranks = sorted(owned | self._gossip_peers(win, owned, dead))
         return _windows.win_mutex(name, ranks=ranks)
 
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
@@ -625,10 +696,25 @@ class _WindowOptimizer(_FusedOptimizer):
                     for idxs, spec in zip(self._groups, self._specs)
                 ]
             if self._fused_pack:
-                # single window: one mutex acquisition spans the whole
-                # put+update pair (inner acquires are local depth bumps)
-                with self._hoisted_mutex(self._win_names[0]):
-                    mixed = self._gossip(packed)
+                # Single window: one mutex acquisition spans the whole
+                # put+update pair (inner acquires are local depth bumps).
+                # A PeerLostError here comes from the hoisted acquire —
+                # BEFORE any data op, so retrying is side-effect-free: the
+                # dead holder's lock was force-released server-side, and
+                # _gossip recomputes its edge tables against the (now
+                # updated) dead set, continuing on the shrunken graph.
+                for attempt in (0, 1):
+                    try:
+                        with self._hoisted_mutex(self._win_names[0],
+                                                 self._dead_ranks()):
+                            mixed = self._gossip(packed)
+                        break
+                    except PeerLostError as exc:
+                        if attempt:
+                            raise
+                        logger.warning(
+                            "gossip step hit a dead peer (%s); retrying "
+                            "once on the self-healed topology", exc)
             else:
                 mixed = self._gossip(packed)
             with timeline_context(self.name, "UNPACK"):
@@ -654,17 +740,28 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
         self.neighbor_weights = None
 
     def _gossip(self, leaves):
+        # consult the failure detector EVERY step: dead neighbors drop out
+        # of the send and combine tables, weights renormalize over the
+        # live sets, and the survivors keep gossiping on the shrunken graph
+        dead = self._dead_ranks()
+        dst_weights, self_weight = self.dst_weights, self.self_weight
+        neighbor_weights = self.neighbor_weights
+        if dead:
+            win = _windows._get_window(self._win_names[0])
+            dst_weights = _healed_send_table(win, dead, dst_weights)
+            self_weight, neighbor_weights = _healed_recv_weights(
+                win, dead, self_weight, neighbor_weights)
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             # donate_source: the packed fusion buffer is dead after the
             # put — the compiled exchange reuses it for the self value
             # (with the default all-ones self weight, a pure alias)
-            _windows.win_put(leaf, nm, dst_weights=self.dst_weights,
+            _windows.win_put(leaf, nm, dst_weights=dst_weights,
                              require_mutex=self.require_mutex,
                              donate_source=True)
             out.append(_windows.win_update(
-                nm, self_weight=self.self_weight,
-                neighbor_weights=self.neighbor_weights,
+                nm, self_weight=self_weight,
+                neighbor_weights=neighbor_weights,
                 require_mutex=self.require_mutex))
         return out
 
@@ -679,20 +776,42 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
         self.self_weight = None
         self.neighbor_weights = None
 
-    def _gossip_peers(self, win, owned):
+    def _gossip_peers(self, win, owned, dead=frozenset()):
         # a get locks the SOURCE ranks it reads (the in-neighbors)
-        return {s for d in owned for s in win.in_neighbors[d]}
+        return {s for d in owned for s in win.in_neighbors[d]
+                if s not in dead}
 
     def _gossip(self, leaves):
         st = _global_state()
+        dead = self._dead_ranks()
+        src_weights, self_weight = self.src_weights, self.self_weight
+        neighbor_weights = self.neighbor_weights
+        if dead:
+            win = _windows._get_window(self._win_names[0])
+            # pull only from LIVE sources (a dead peer's published tensor
+            # goes stale, and at re-publish races it could tear mass) and
+            # renormalize the combine over the live in-sets
+            _, live_in = _live_neighbor_sets(win, dead)
+            if src_weights is None:
+                src_weights = {r: {s: 1.0 for s in live_in[r]}
+                               for r in range(win.size)}
+            else:
+                table = _windows._edge_weights(
+                    src_weights, win.in_neighbors, 1.0, "src_weights",
+                    win.size)
+                src_weights = {r: {s: w for s, w in table[r].items()
+                                   if s not in dead}
+                               for r in range(win.size)}
+            self_weight, neighbor_weights = _healed_recv_weights(
+                win, dead, self_weight, neighbor_weights)
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             st.windows[nm].self_value = jnp.asarray(leaf)  # publish
-            _windows.win_get(nm, src_weights=self.src_weights,
+            _windows.win_get(nm, src_weights=src_weights,
                              require_mutex=self.require_mutex)
             out.append(_windows.win_update(
-                nm, self_weight=self.self_weight,
-                neighbor_weights=self.neighbor_weights,
+                nm, self_weight=self_weight,
+                neighbor_weights=neighbor_weights,
                 require_mutex=self.require_mutex))
         return out
 
@@ -714,10 +833,6 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         st = _global_state()
         self._prior_associated_p = st.win_ops_with_associated_p
         _windows.turn_on_win_ops_with_associated_p()
-        self._outdeg = {
-            r: len(topology_util.out_neighbor_ranks(st.topology, r))
-            for r in range(st.size)
-        }
 
     def _restore_flags(self) -> None:
         _global_state().win_ops_with_associated_p = self._prior_associated_p
@@ -727,12 +842,18 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         n = st.size
         # Column-stochastic weights: each rank splits mass 1/(outdeg+1)
         # between itself and every out-neighbor (optimizers.py:700-717).
-        sw = {r: 1.0 / (self._outdeg[r] + 1) for r in range(n)}
-        dw = {
-            r: {dst: 1.0 / (self._outdeg[r] + 1)
-                for dst in topology_util.out_neighbor_ranks(st.topology, r)}
+        # Self-healing: dead destinations drop out and mass splits over
+        # 1/(live_outdeg+1) instead — still column-stochastic over the
+        # live set BY CONSTRUCTION, so push-sum's total mass (and the
+        # de-biasing p mass) stays conserved on the shrunken graph.
+        dead = self._dead_ranks()
+        out_nbrs = {
+            r: [d for d in topology_util.out_neighbor_ranks(st.topology, r)
+                if d not in dead]
             for r in range(n)
         }
+        sw = {r: 1.0 / (len(out_nbrs[r]) + 1) for r in range(n)}
+        dw = {r: {dst: sw[r] for dst in out_nbrs[r]} for r in range(n)}
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             win = st.windows[nm]

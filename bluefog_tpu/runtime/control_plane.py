@@ -134,10 +134,25 @@ def attach() -> Optional[ControlPlaneClient]:
                 last = exc
                 time.sleep(0.2)
         if _client is None:
-            logger.warning("control plane connect failed (%s); staying local", last)
             if _server is not None:
                 _server.stop()
                 _server = None
+            if world > 1:
+                # A multi-process job degrading to world-of-one would train
+                # silently wrong answers (each partition averaging with
+                # itself): window scalars, mutexes, heartbeats, and the
+                # hosted data plane would all be process-local while the
+                # job believes it is coordinating. Fail loudly instead —
+                # the soft local fallback below is only for forced
+                # single-controller runs (world == 1: tests, external
+                # actors), where "local" IS globally consistent.
+                raise RuntimeError(
+                    f"control plane connect to {host}:{port} failed after "
+                    "BLUEFOG_CP_CONNECT_TIMEOUT with a declared world of "
+                    f"{world} processes (rank {rank}): refusing to degrade "
+                    "a multi-controller job to local-only coordination. "
+                    f"Last error: {last}")
+            logger.warning("control plane connect failed (%s); staying local", last)
             return None
         _world = world
         _conn_params = (host, port, rank, secret)

@@ -209,10 +209,17 @@ def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
         "no peer is reported dead — the op may be slow, the job "
         "overloaded, or a peer controller may not have dispatched the "
         "same op (see enable_topo_check / the stall watchdog)")
-    raise RuntimeError(
-        f"synchronize('{name}', handle {handle}) exceeded the "
-        f"{timeout:.1f}s deadline after {time.monotonic() - t0:.1f}s in "
-        f"flight: {diagnosis}")
+    msg = (f"synchronize('{name}', handle {handle}) exceeded the "
+           f"{timeout:.1f}s deadline after {time.monotonic() - t0:.1f}s in "
+           f"flight: {diagnosis}")
+    if dead:
+        # typed: callers distinguish "peer is gone, degrade the topology"
+        # (PeerLostError, a RuntimeError subclass so existing handlers
+        # keep working) from a plain slow-op timeout
+        from .native import PeerLostError
+
+        raise PeerLostError(msg)
+    raise RuntimeError(msg)
 
 
 def wait(handle: int, timeout: Optional[float] = None) -> Any:

@@ -239,3 +239,29 @@ def dead_controllers() -> set:
 
     mon = _global_state().peer_monitor
     return mon.dead_peers() if mon is not None else set()
+
+
+def dead_ranks() -> set:
+    """Mesh ranks whose hosting controller's heartbeat has gone silent.
+
+    The rank-level projection of :func:`dead_controllers`: every rank whose
+    device shard lives on a dead controller process. This is the set the
+    self-healing gossip layer consults each step — window optimizers drop
+    these ranks from their send/recv edge sets and renormalize averaging
+    weights, so survivors keep training on the shrunken graph instead of
+    depositing into (and waiting on) a corpse's mailboxes (cf. AD-PSGD /
+    SGP: decentralized averaging tolerates vertex removal as long as the
+    live subgraph stays connected). Empty in single-controller jobs.
+    """
+    from . import control_plane as _cp
+    from .state import _global_state
+
+    st = _global_state()
+    mon = st.peer_monitor
+    if mon is None:
+        return set()
+    dead = mon.dead_peers()
+    if not dead:
+        return set()
+    return {r for pidx in dead
+            for r in _cp.owned_ranks(st.devices, pidx)}

@@ -29,6 +29,47 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+class PeerLostError(RuntimeError):
+    """A blocking control-plane primitive was woken because a peer died.
+
+    Raised instead of hanging when a lock/mutex holder's connection closed
+    (or its lease expired), a barrier's bounded wait hit its deadline, or a
+    critical section was force-broken mid-hold. ``dead`` carries the
+    heartbeat monitor's dead-controller set at raise time (it may still be
+    empty when the server noticed the death before a heartbeat timeout
+    elapsed). The contract is documented in docs/fault_tolerance.md.
+    """
+
+    def __init__(self, message: str, dead=()) -> None:
+        self.dead = set(dead)
+        if self.dead:
+            message += (f" [dead controller(s) {sorted(self.dead)} per "
+                        "bf.dead_controllers()]")
+        super().__init__(message)
+
+
+def _dead_controller_set() -> set:
+    """The heartbeat monitor's current dead set (empty when unavailable).
+
+    Imported lazily: heartbeat -> control_plane -> native is the module
+    load order, so a top-level import here would be circular."""
+    try:
+        from .heartbeat import dead_controllers
+
+        return dead_controllers()
+    except Exception:  # noqa: BLE001 — raise-path helper must not mask
+        return set()
+
+
+def _peer_lost(message: str) -> PeerLostError:
+    return PeerLostError(message, dead=_dead_controller_set())
+
+
+# Status codes shared with csrc/bf_runtime.cc: -1 wire failure, -2 mailbox
+# byte cap, -3 dead holder / deadline on a blocking primitive.
+_DEAD_HOLDER = -3
+
+
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_timeline_open.restype = ctypes.c_void_p
     lib.bf_timeline_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -131,7 +172,97 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.bf_cp_disconnect.restype = None
     lib.bf_cp_disconnect.argtypes = [ctypes.c_void_p]
+    # fault injection + dead-connection hooks (r8 fault tolerance)
+    lib.bf_cp_fault.restype = None
+    lib.bf_cp_fault.argtypes = [ctypes.c_longlong, ctypes.c_int,
+                                ctypes.c_int, ctypes.c_longlong]
+    lib.bf_cp_fault_drops.restype = ctypes.c_longlong
+    lib.bf_cp_fault_drops.argtypes = []
+    lib.bf_cp_fault_ops.restype = ctypes.c_longlong
+    lib.bf_cp_fault_ops.argtypes = []
+    lib.bf_cp_server_drop_conns.restype = None
+    lib.bf_cp_server_drop_conns.argtypes = [ctypes.c_void_p]
     return lib
+
+
+# -- deterministic fault injection (BLUEFOG_CP_FAULT) -------------------------
+#
+# Spec grammar (comma-separated key=value, all integers, any subset):
+#   drop_after=N   kill the client connection on every Nth control-plane op
+#                  (alternating request-lost / reply-lost, the two classes
+#                  the reconnect + dedup machinery must survive); 0 = off
+#   delay_ms=M     sleep M ms inside every client op before the reply read
+#                  (deterministic slow-peer emulation)
+#   trunc=1        request-lost drops first write HALF the frame, so the
+#                  server sees a truncated message, not a clean close
+#   seed=S         shifts which ops the drop counter fires on
+#
+# OFF unless BLUEFOG_CP_FAULT is set (or a test arms it explicitly): the
+# production path pays one relaxed atomic load per op, nothing else — the
+# chaos suite asserts this default (tests/test_chaos.py).
+
+def parse_fault_spec(spec: str) -> dict:
+    out = {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0}
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        key, sep, val = item.partition("=")
+        key = key.strip()
+        if not sep or key not in out:
+            raise ValueError(
+                f"BLUEFOG_CP_FAULT: bad entry {item!r} (grammar: "
+                "drop_after=N,delay_ms=M,trunc=0|1,seed=S)")
+        out[key] = int(val.strip())
+    return out
+
+
+def fault_arm(spec=None, **overrides) -> dict:
+    """Arm the native fault injector from a spec string / dict / kwargs.
+
+    Resets the op and drop counters so injected drop points are
+    reproducible run to run. Returns the effective spec."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    cfg = parse_fault_spec(spec) if isinstance(spec, str) else \
+        dict(spec or {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0})
+    cfg.update(overrides)
+    lib.bf_cp_fault(int(cfg.get("drop_after", 0)),
+                    int(cfg.get("delay_ms", 0)),
+                    int(cfg.get("trunc", 0)), int(cfg.get("seed", 0)))
+    return cfg
+
+
+def fault_disarm() -> None:
+    """Turn injection off (counters reset)."""
+    lib = load()
+    if lib is not None:
+        lib.bf_cp_fault(0, 0, 0, 0)
+
+
+def fault_stats() -> dict:
+    """{'ops': client ops seen, 'drops': connections killed} since arm."""
+    lib = load()
+    if lib is None:
+        return {"ops": 0, "drops": 0}
+    return {"ops": int(lib.bf_cp_fault_ops()),
+            "drops": int(lib.bf_cp_fault_drops())}
+
+
+def _arm_fault_from_env(lib) -> None:
+    spec = os.environ.get("BLUEFOG_CP_FAULT")
+    if not spec:
+        return
+    try:
+        cfg = parse_fault_spec(spec)
+    except ValueError as exc:
+        logger.warning("ignoring BLUEFOG_CP_FAULT (%s)", exc)
+        return
+    lib.bf_cp_fault(cfg["drop_after"], cfg["delay_ms"], cfg["trunc"],
+                    cfg["seed"])
+    logger.warning("control-plane fault injection ARMED: %s "
+                   "(BLUEFOG_CP_FAULT — never set this in production)", cfg)
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -171,6 +302,8 @@ def load() -> Optional[ctypes.CDLL]:
         except OSError as exc:
             logger.info("native runtime load failed (%s)", exc)
             _lib = None
+        if _lib is not None:
+            _arm_fault_from_env(_lib)
         return _lib
 
 
@@ -343,6 +476,14 @@ class ControlPlaneServer:
             self._lib.bf_cp_server_stop(self._h)
             self._h = None
 
+    def drop_connections(self) -> None:
+        """Fault-injection kill hook: hard-drop every live client
+        connection while the server keeps running — what a network
+        partition or peer restart looks like from the clients' side.
+        Clients with retries enabled reconnect transparently."""
+        if self._h:
+            self._lib.bf_cp_server_drop_conns(self._h)
+
     def __enter__(self):
         return self
 
@@ -419,18 +560,37 @@ class ControlPlaneClient:
 
     def barrier(self, name: str = "default") -> int:
         r = self._lib.bf_cp_barrier(self._h, name.encode())
+        if r == _DEAD_HOLDER:
+            raise _peer_lost(
+                f"barrier '{name}' abandoned: a participant never arrived "
+                "within BLUEFOG_CP_BARRIER_TIMEOUT (peer crashed or "
+                "partitioned)")
         if r < 0:
             raise OSError("control plane barrier failed (connection lost "
                           "or not authenticated)")
         return r
 
     def lock(self, name: str) -> None:
-        if self._lib.bf_cp_lock(self._h, name.encode()) < 0:
+        r = self._lib.bf_cp_lock(self._h, name.encode())
+        if r == _DEAD_HOLDER:
+            # the lock was left FREE: after handling the error a fresh
+            # acquire succeeds — see docs/fault_tolerance.md
+            raise _peer_lost(
+                f"lock '{name}': the holder died while we waited (its "
+                "connection closed or its BLUEFOG_CP_LOCK_LEASE expired); "
+                "the lock was force-released")
+        if r < 0:
             raise OSError("control plane lock failed (connection lost "
                           "or not authenticated)")
 
     def unlock(self, name: str) -> None:
-        if self._lib.bf_cp_unlock(self._h, name.encode()) < 0:
+        r = self._lib.bf_cp_unlock(self._h, name.encode())
+        if r == _DEAD_HOLDER:
+            raise _peer_lost(
+                f"unlock '{name}': this client no longer held the lock — "
+                "it was force-released mid-hold (lease expiry or a "
+                "connection drop), so the critical section was broken")
+        if r < 0:
             raise OSError("control plane unlock failed (connection lost "
                           "or not authenticated)")
 

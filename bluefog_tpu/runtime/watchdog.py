@@ -47,6 +47,13 @@ class StallWatchdog:
                 pending = handles.outstanding()
             except Exception:  # never let observability kill the process
                 continue
+            # Prune warned entries for handles that completed or were
+            # swept/evicted: without this the set grows one int per stalled-
+            # then-finished handle for the LIFE of the job (long runs leak).
+            # A handle that leaves the outstanding set and stalls again
+            # later (e.g. re-registered by a timed-out synchronize) warns
+            # again — it progressed in between, so the new stall is news.
+            self._warned.intersection_update(pending)
             stalled = {
                 h: (name, age)
                 for h, (name, age) in pending.items()

@@ -27,6 +27,8 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <algorithm>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -197,7 +199,72 @@ enum Op : uint8_t {
   //     learns the range to fan out before issuing kGetBytesPart reads).
   //   kGetBytesPart: arg = (offset << 32) | len; bulk reply = that slice.
   kPutBytesPart = 14, kBytesLen = 15, kGetBytesPart = 16,
+  // Op-sequence preamble (r8, fault tolerance): a reply-less annotation the
+  // client writes immediately before a NON-IDEMPOTENT op (or pipelined
+  // batch): key = 8 raw bytes of the client's stable id, arg = batch
+  // sequence number, data = u32 op count. The server dedups the following
+  // `count` ops per (client, seq): a request retried after a lost reply is
+  // answered from the recorded reply instead of being applied twice (the
+  // reconnecting transport's exactly-once contract for fetch_add / append /
+  // take / unlock / barrier / striped-put parts).
+  kSeqPre = 17,
 };
+
+// Reply status codes shared with the Python layer (runtime/native.py):
+// -1 = wire failure, -2 = mailbox byte cap. kDeadHolderReply wakes a
+// blocked lock/barrier waiter whose holder/peer died (connection closed or
+// lease expired) or whose bounded wait hit its deadline; Python surfaces it
+// as PeerLostError instead of hanging forever.
+constexpr int64_t kDeadHolderReply = -3;
+
+double EnvSeconds(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  double x = std::strtod(v, &end);
+  return end == v ? dflt : x;
+}
+
+long long EnvInt(const char* name, long long dflt) {
+  const char* v = std::getenv(name);
+  if (!v || !*v) return dflt;
+  char* end = nullptr;
+  long long x = std::strtoll(v, &end, 10);
+  return end == v ? dflt : x;
+}
+
+// -- deterministic fault injection (BLUEFOG_CP_FAULT) -----------------------
+//
+// Armed from Python (runtime/native.py parses the spec) via bf_cp_fault();
+// OFF unless armed — the counters below are the only cost on the default
+// path (one relaxed atomic load per client op). Drops trigger on a global
+// client-op counter, alternating deterministically between
+// request-never-arrives (shutdown before the frame completes, optionally
+// truncated mid-frame) and reply-lost (shutdown after a complete send) —
+// the two failure classes the reconnect + dedup machinery must survive.
+std::atomic<long long> g_fault_drop_after{0};
+std::atomic<int> g_fault_delay_ms{0};
+std::atomic<int> g_fault_trunc{0};
+std::atomic<long long> g_fault_seed{0};
+std::atomic<long long> g_fault_ops{0};
+std::atomic<long long> g_fault_drops{0};
+
+// 0 = no fault this op, 1 = drop before the request completes,
+// 2 = request delivered but the reply is lost.
+int FaultNext() {
+  long long da = g_fault_drop_after.load(std::memory_order_relaxed);
+  if (da <= 0) return 0;
+  long long n = g_fault_ops.fetch_add(1) + 1;
+  if ((n + g_fault_seed.load(std::memory_order_relaxed)) % da != 0) return 0;
+  g_fault_drops.fetch_add(1);
+  return (((n + g_fault_seed.load(std::memory_order_relaxed)) / da) % 2 == 0)
+             ? 2 : 1;
+}
+
+void FaultDelay() {
+  int ms = g_fault_delay_ms.load(std::memory_order_relaxed);
+  if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
 
 // -- SHA-256 / HMAC-SHA256 (self-contained; no OpenSSL in the image) --------
 //
@@ -361,14 +428,45 @@ struct PutStaging {
   int64_t got = 0;
 };
 
+// A held lock: owner rank + the connection that acquired it (force-released
+// when that connection closes — the kernel closes a SIGKILLed process's
+// sockets, so a dead holder's locks free within one RTT of the crash) + a
+// lease as the backstop for wedged-but-connected holders. `epoch` bumps on
+// every force-release so blocked waiters can tell a dead-holder wake from a
+// normal handoff and surface it (kDeadHolderReply -> PeerLostError).
+struct LockInfo {
+  int rank = -1;
+  int fd = -1;
+  int64_t epoch = 0;
+  std::chrono::steady_clock::time_point expiry{};
+};
+
+// Per-client dedup state for the reconnecting transport: the recorded
+// replies of the client's most recent kSeqPre-annotated batch. A retry
+// resends the whole batch under the same seq; already-applied ops replay
+// from here (`ints`/`bulks` indexed by in-batch position), the remainder
+// executes and appends. `inflight` marks an op a (possibly dead) handler is
+// still executing, so a fast retry on a fresh connection waits for its
+// recording instead of double-applying. Memory is bounded to ONE batch per
+// client: arming a new seq resets the entry.
+struct DedupEntry {
+  uint64_t seq = ~0ull;
+  std::vector<int64_t> ints;
+  std::vector<std::string> bulks;
+  std::vector<uint8_t> is_bulk;
+  uint32_t inflight = 0xFFFFFFFFu;
+};
+
 struct ControlServer {
   int listen_fd = -1;
   int world = 0;
   std::string secret;          // empty = unauthenticated (single-host dev)
   int64_t max_box_bytes = 0;   // per-mailbox byte cap; 0 = unlimited
+  double lock_lease_sec = 60.0;     // BLUEFOG_CP_LOCK_LEASE (0 = no lease)
+  double barrier_timeout_sec = 600; // BLUEFOG_CP_BARRIER_TIMEOUT
   std::thread accept_thread;
-  std::vector<std::thread> handlers;
-  std::vector<int> handler_fds;
+  std::vector<int> handler_fds;    // live connections only (pruned on close)
+  int active_handlers = 0;         // guarded by mu; handlers are detached
   std::atomic<bool> stopping{false};
 
   std::mutex mu;
@@ -381,9 +479,38 @@ struct ControlServer {
   // reader pins the value; a concurrent put swaps in a fresh one.
   std::map<std::string, std::shared_ptr<const std::string>> bytes_kv;
   std::map<std::string, PutStaging> put_staging;            // striped puts
-  std::map<std::string, int> lock_owner;           // key -> rank (or -1)
+  std::map<std::string, LockInfo> locks;
+  std::map<uint64_t, DedupEntry> dedup;            // client id -> last batch
   std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
   std::map<std::string, int> barrier_count;
+
+  // Has the peer closed its end? Used by blocked lock/barrier waiters: the
+  // protocol is strictly request-reply with one outstanding request per
+  // connection, so readable-or-EOF while WE owe the reply can only mean the
+  // connection died — the waiter abandons its wait (un-counting any barrier
+  // arrival) instead of holding server state for a ghost.
+  static bool PeerClosed(int fd) {
+    char b;
+    return ::recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT) == 0;
+  }
+
+  // Force-release every lock held via `fd` (caller holds mu): the epoch
+  // bump is what tells current waiters the holder died rather than
+  // unlocked. Called when a connection closes for ANY reason — a crashed
+  // peer, a fault-injected drop, or a clean client close while holding
+  // (holder gone is holder gone).
+  void ReleaseLocksOf(int fd) {
+    bool released = false;
+    for (auto& it : locks) {
+      if (it.second.fd == fd && it.second.rank != -1) {
+        it.second.rank = -1;
+        it.second.fd = -1;
+        ++it.second.epoch;
+        released = true;
+      }
+    }
+    if (released) cv.notify_all();
+  }
 
   // Mutual challenge-response before any op is served: the server proves it
   // holds the secret too (a client must not leak window tensors to a rogue
@@ -414,23 +541,27 @@ struct ControlServer {
     return true;
   }
 
-  void Handle(int fd) {
-    if (!Handshake(fd)) {
-      ::close(fd);
-      return;
-    }
+  // The per-connection request loop. Early `return` on ANY wire failure or
+  // abandoned wait: Handle() below owns the close + lock-force-release +
+  // registry cleanup, so no exit path can leak a held lock or a listed fd.
+  void HandleLoop(int fd) {
+    // dedup context armed by a kSeqPre preamble: the next `ded_left` ops
+    // belong to batch (ded_cid, ded_seq), replayed/recorded per in-batch
+    // index `ded_idx` (see DedupEntry).
+    uint64_t ded_cid = 0, ded_seq = 0;
+    uint32_t ded_left = 0, ded_idx = 0;
     for (;;) {
       uint32_t len;
-      if (!ReadAll(fd, &len, 4)) break;
-      if (len < 15 || len > kMaxMsg) break;
+      if (!ReadAll(fd, &len, 4)) return;
+      if (len < 15 || len > kMaxMsg) return;
       std::vector<char> buf(len);
-      if (!ReadAll(fd, buf.data(), len)) break;
+      if (!ReadAll(fd, buf.data(), len)) return;
       uint8_t op = buf[0];
       int32_t rank;
       std::memcpy(&rank, buf.data() + 1, 4);
       uint16_t klen;
       std::memcpy(&klen, buf.data() + 5, 2);
-      if (7u + klen + 8u > len) break;
+      if (7u + klen + 8u > len) return;
       std::string key(buf.data() + 7, klen);
       int64_t arg;
       std::memcpy(&arg, buf.data() + 7 + klen, 8);
@@ -439,6 +570,99 @@ struct ControlServer {
       int64_t reply = 0;
       bool quit = false;
       bool replied = false;
+      bool conn_abort = false;
+
+      if (op == kSeqPre) {
+        // reply-less annotation: arm dedup for the following `count` ops
+        if (klen == 8) {
+          std::memcpy(&ded_cid, key.data(), 8);
+          ded_seq = static_cast<uint64_t>(arg);
+          uint32_t count = 1;
+          if (dlen >= 4) std::memcpy(&count, data, 4);
+          ded_left = count;
+          ded_idx = 0;
+        }
+        continue;
+      }
+      const bool ded = ded_left > 0;
+      bool ded_recorded = false;
+
+      auto ded_record = [&](int64_t v, const std::string* bulk) {
+        std::lock_guard<std::mutex> lk(mu);
+        DedupEntry& e = dedup[ded_cid];
+        if (e.seq == ded_seq && e.ints.size() == ded_idx) {
+          e.ints.push_back(v);
+          e.is_bulk.push_back(bulk ? 1 : 0);
+          e.bulks.emplace_back(bulk ? *bulk : std::string());
+          e.inflight = 0xFFFFFFFFu;
+          cv.notify_all();
+        }
+      };
+      auto ded_abort = [&]() {
+        std::lock_guard<std::mutex> lk(mu);
+        DedupEntry& e = dedup[ded_cid];
+        if (e.seq == ded_seq && e.inflight == ded_idx) {
+          e.inflight = 0xFFFFFFFFu;
+          cv.notify_all();
+        }
+      };
+
+      if (ded) {
+        // replay-or-arm: an op already recorded under (cid, seq, idx) is
+        // answered from the record WITHOUT re-applying (the retried
+        // request after a lost reply); an op a previous connection's
+        // handler is still executing is awaited, then replayed.
+        bool replay = false;
+        int64_t replay_int = 0;
+        std::string replay_bulk;
+        bool replay_is_bulk = false;
+        {
+          std::unique_lock<std::mutex> lk(mu);
+          DedupEntry& e = dedup[ded_cid];
+          if (e.seq != ded_seq) {
+            e.seq = ded_seq;
+            e.ints.clear();
+            e.bulks.clear();
+            e.is_bulk.clear();
+            e.inflight = 0xFFFFFFFFu;
+          }
+          for (;;) {
+            if (ded_idx < e.ints.size()) {
+              replay = true;
+              replay_is_bulk = e.is_bulk[ded_idx] != 0;
+              if (replay_is_bulk) replay_bulk = e.bulks[ded_idx];
+              else replay_int = e.ints[ded_idx];
+              break;
+            }
+            if (e.inflight == ded_idx && !stopping.load()) {
+              cv.wait_for(lk, std::chrono::milliseconds(200));
+              continue;
+            }
+            e.inflight = ded_idx;  // we execute it
+            break;
+          }
+        }
+        if (replay) {
+          bool ok;
+          if (replay_is_bulk) {
+            uint32_t rlen = static_cast<uint32_t>(replay_bulk.size());
+            ok = WriteAll(fd, &rlen, 4) &&
+                 (replay_bulk.empty() ||
+                  WriteAll(fd, replay_bulk.data(), replay_bulk.size()));
+          } else {
+            uint32_t rlen = 8;
+            char outb[12];
+            std::memcpy(outb, &rlen, 4);
+            std::memcpy(outb + 4, &replay_int, 8);
+            ok = WriteAll(fd, outb, 12);
+          }
+          ++ded_idx;
+          --ded_left;
+          if (!ok) return;
+          continue;
+        }
+      }
+
       switch (op) {
         case kBarrier: {
           std::unique_lock<std::mutex> lk(mu);
@@ -447,32 +671,107 @@ struct ControlServer {
             barrier_count[key] = 0;
             barrier_gen[key] = gen + 1;
             cv.notify_all();
+            reply = barrier_gen[key];
           } else {
-            cv.wait(lk, [&] {
-              return stopping.load() || barrier_gen[key] != gen;
-            });
+            // Bounded wait (BLUEFOG_CP_BARRIER_TIMEOUT): a dead peer must
+            // not park this handler forever — on expiry the arrival is
+            // withdrawn and the waiter wakes with kDeadHolderReply
+            // (Python: PeerLostError naming bf.dead_controllers()). A
+            // waiter whose OWN client vanished withdraws silently so its
+            // ghost arrival cannot complete a barrier for a peer that
+            // will retry the op on a fresh connection.
+            auto deadline = std::chrono::steady_clock::now() +
+                std::chrono::duration_cast<
+                    std::chrono::steady_clock::duration>(
+                    std::chrono::duration<double>(barrier_timeout_sec));
+            reply = kDeadHolderReply;
+            for (;;) {
+              if (stopping.load() || barrier_gen[key] != gen) {
+                reply = barrier_gen[key];
+                break;
+              }
+              if (std::chrono::steady_clock::now() >= deadline) {
+                --barrier_count[key];
+                break;
+              }
+              cv.wait_for(lk, std::chrono::milliseconds(200));
+              if (barrier_gen[key] == gen && !stopping.load()) {
+                lk.unlock();
+                bool closed = PeerClosed(fd);
+                lk.lock();
+                if (closed && barrier_gen[key] == gen) {
+                  --barrier_count[key];
+                  conn_abort = true;
+                  break;
+                }
+              }
+            }
           }
-          reply = barrier_gen[key];
           break;
         }
         case kLock: {
           std::unique_lock<std::mutex> lk(mu);
-          cv.wait(lk, [&] {
-            auto it = lock_owner.find(key);
-            return stopping.load() ||
-                   it == lock_owner.end() || it->second == -1 ||
-                   it->second == rank;  // re-entrant per rank
-          });
-          lock_owner[key] = rank;
-          reply = 1;
+          LockInfo& L = locks[key];
+          const int64_t start_epoch = L.epoch;
+          for (;;) {
+            if (stopping.load()) {
+              reply = 1;  // server dying: never block teardown
+              break;
+            }
+            if (L.rank == -1 || L.rank == rank) {
+              if (L.epoch != start_epoch) {
+                // force-released while we waited: the holder's connection
+                // closed or its lease expired. Don't silently enter the
+                // possibly-torn critical section — wake with the dead-
+                // holder status (lock left free; a fresh acquire works).
+                reply = kDeadHolderReply;
+                break;
+              }
+              L.rank = rank;  // grant (re-entrant per rank)
+              L.fd = fd;
+              if (lock_lease_sec > 0)
+                L.expiry = std::chrono::steady_clock::now() +
+                    std::chrono::duration_cast<
+                        std::chrono::steady_clock::duration>(
+                        std::chrono::duration<double>(lock_lease_sec));
+              reply = 1;
+              break;
+            }
+            if (lock_lease_sec > 0 &&
+                std::chrono::steady_clock::now() >= L.expiry) {
+              // lease backstop: holder connected but wedged past its lease
+              L.rank = -1;
+              L.fd = -1;
+              ++L.epoch;
+              cv.notify_all();
+              reply = kDeadHolderReply;
+              break;
+            }
+            cv.wait_for(lk, std::chrono::milliseconds(200));
+            lk.unlock();
+            bool closed = PeerClosed(fd);
+            lk.lock();
+            if (closed) {
+              conn_abort = true;  // our own client vanished mid-wait
+              break;
+            }
+          }
           break;
         }
         case kUnlock: {
           std::lock_guard<std::mutex> lk(mu);
-          auto it = lock_owner.find(key);
-          if (it != lock_owner.end() && it->second == rank) it->second = -1;
-          cv.notify_all();
-          reply = 1;
+          auto it = locks.find(key);
+          if (it != locks.end() && it->second.rank == rank) {
+            it->second.rank = -1;
+            it->second.fd = -1;
+            cv.notify_all();
+            reply = 1;
+          } else {
+            // not ours (anymore): the lease expired or a drop force-
+            // released it mid-hold — the critical section was broken;
+            // tell the caller instead of silently succeeding
+            reply = kDeadHolderReply;
+          }
           break;
         }
         case kFetchAdd: {
@@ -560,19 +859,40 @@ struct ControlServer {
               }
             }
           }
+          uint64_t total = 0;
+          for (const auto& r : records) total += 4 + r.size();
+          uint32_t rlen = static_cast<uint32_t>(total);
+          if (ded) {
+            // Dedup'd drains assemble the reply once so a retry after a
+            // lost reply replays the SAME records instead of losing them
+            // (mass conservation under connection drops). One extra
+            // memcpy of the drained bytes vs the streaming path below;
+            // BLUEFOG_CP_RETRIES=0 restores the copy-free wire exactly.
+            std::string body;
+            body.reserve(total);
+            for (const auto& r : records) {
+              uint32_t rl = static_cast<uint32_t>(r.size());
+              body.append(reinterpret_cast<const char*>(&rl), 4);
+              body.append(r);
+            }
+            ded_record(static_cast<int64_t>(records.size()), &body);
+            ded_recorded = true;
+            if (!WriteAll(fd, &rlen, 4) ||
+                (!body.empty() && !WriteAll(fd, body.data(), body.size())))
+              return;
+            replied = true;
+            break;
+          }
           // Stream the reply straight from the taken records (they are
           // owned by this handler now — no lock needed, and no second
           // full-payload assembly copy; a 64 MB drain reply costs zero
           // server-side memcpys beyond the kernel's).
-          uint64_t total = 0;
-          for (const auto& r : records) total += 4 + r.size();
-          uint32_t rlen = static_cast<uint32_t>(total);
-          if (!WriteAll(fd, &rlen, 4)) return CloseFd(fd);
+          if (!WriteAll(fd, &rlen, 4)) return;
           for (const auto& r : records) {
             uint32_t rl = static_cast<uint32_t>(r.size());
             if (!WriteAll(fd, &rl, 4) ||
                 (!r.empty() && !WriteAll(fd, r.data(), r.size())))
-              return CloseFd(fd);
+              return;
           }
           replied = true;
           break;
@@ -599,7 +919,7 @@ struct ControlServer {
           uint32_t rlen = v ? static_cast<uint32_t>(v->size()) : 0;
           if (!WriteAll(fd, &rlen, 4) ||
               (rlen && !WriteAll(fd, v->data(), rlen)))
-            return CloseFd(fd);
+            return;
           replied = true;
           break;
         }
@@ -679,7 +999,7 @@ struct ControlServer {
           uint32_t rlen = static_cast<uint32_t>(n);
           if (!WriteAll(fd, &rlen, 4) ||
               (n && !WriteAll(fd, v->data() + off, n)))
-            return CloseFd(fd);
+            return;
           replied = true;
           break;
         }
@@ -701,23 +1021,56 @@ struct ControlServer {
         default:
           break;
       }
+      if (conn_abort) {
+        // abandoned wait (our client's connection is gone): leave no
+        // dedup in-flight marker behind — the retry must re-execute
+        if (ded) ded_abort();
+        return;
+      }
       if (!replied) {
+        // record BEFORE the reply write: a reply lost on the wire must
+        // find its value here when the client retries
+        if (ded) {
+          ded_record(reply, nullptr);
+          ded_recorded = true;
+        }
         uint32_t rlen = 8;
         char out[12];
         std::memcpy(out, &rlen, 4);
         std::memcpy(out + 4, &reply, 8);
-        if (!WriteAll(fd, out, 12)) break;
+        if (!WriteAll(fd, out, 12)) return;
+      } else if (ded && !ded_recorded) {
+        ded_abort();  // idempotent bulk op under a batch preamble
+      }
+      if (ded) {
+        ++ded_idx;
+        --ded_left;
       }
       if (quit) {
         stopping.store(true);
         cv.notify_all();
-        break;
+        return;
       }
     }
-    ::close(fd);
   }
 
-  static void CloseFd(int fd) { ::close(fd); }
+  void Handle(int fd) {
+    if (Handshake(fd)) HandleLoop(fd);
+    // Single cleanup point for EVERY exit path: force-release the locks
+    // this connection held (epoch bump wakes + flags waiters), prune the
+    // fd from the live registry, and let stop() know we are gone. The fd
+    // closes INSIDE the locked section, after the lock scan — were it
+    // closed first, a new connection could recycle the number and acquire
+    // a lock this scan would then wrongly force-release.
+    std::lock_guard<std::mutex> lk(mu);
+    ReleaseLocksOf(fd);
+    handler_fds.erase(
+        std::remove(handler_fds.begin(), handler_fds.end(), fd),
+        handler_fds.end());
+    ::close(fd);
+    --active_handlers;
+    cv.notify_all();
+  }
 
   static bool SendBytesReply(int fd, const std::string& payload) {
     uint32_t rlen = static_cast<uint32_t>(payload.size());
@@ -759,7 +1112,11 @@ struct ControlServer {
         break;
       }
       handler_fds.push_back(fd);
-      handlers.emplace_back([this, fd] { Handle(fd); });
+      ++active_handlers;
+      // Detached: the reconnecting transport churns connections, and a
+      // joinable-thread-per-connection vector would grow for the job's
+      // lifetime. stop() instead waits on active_handlers == 0.
+      std::thread([this, fd] { Handle(fd); }).detach();
     }
   }
 };
@@ -768,6 +1125,70 @@ struct ControlClient {
   int fd = -1;
   int rank = 0;
   std::mutex mu;
+  // Reconnect state (r8): enough to redial + re-handshake transparently.
+  std::string host;
+  int port = 0;
+  std::string secret;
+  int sockbuf = 0;
+  uint64_t cid = 0;       // stable dedup identity across reconnects
+  uint64_t next_seq = 1;  // batch sequence counter (guarded by mu)
+  int retries = 3;        // BLUEFOG_CP_RETRIES (0 disables reconnects)
+  int backoff_ms = 50;    // BLUEFOG_CP_BACKOFF_MS, doubling, capped at 2 s
+
+  // Ops whose effect must be applied exactly once: a retry after a lost
+  // reply goes out under a kSeqPre annotation so the server can replay the
+  // recorded reply instead of re-applying. Everything else (get/put/
+  // bytes_len/ranged get/put_bytes/lock) is idempotent and retries raw —
+  // a redundant lock re-grant is absorbed by per-rank re-entrancy, and a
+  // dropped connection's locks were force-released server-side anyway.
+  static bool IsDedupOp(uint8_t op) {
+    switch (op) {
+      case kBarrier:
+      case kUnlock:
+      case kFetchAdd:
+      case kAppendBytes:
+      case kAppendBytesTagged:
+      case kTakeBytes:
+      case kPutBytesPart:
+        return true;
+      default:
+        return false;
+    }
+  }
+
+  void EncodePre(std::vector<char>* buf, uint64_t seq, uint32_t count) {
+    std::string key(reinterpret_cast<const char*>(&cid), 8);
+    Encode(buf, kSeqPre, key, static_cast<int64_t>(seq), &count, 4);
+  }
+
+  uint64_t AllocSeq(uint8_t op) {
+    return (retries > 0 && IsDedupOp(op)) ? next_seq++ : 0;
+  }
+
+  // Send the (already framed) request bytes, honoring the armed fault
+  // injector: fault 1 kills the connection before the request completes
+  // (optionally after a deliberate half-frame write), fault 2 delivers it
+  // but loses the reply. Both surface as a wire failure to the caller, so
+  // the reconnect + dedup path is exercised exactly as by a real drop.
+  bool SendFault(const std::vector<char>& buf, int fault) {
+    if (fault == 1) {
+      if (g_fault_trunc.load(std::memory_order_relaxed) && buf.size() > 8)
+        ControlServer::WriteAll(fd, buf.data(), buf.size() / 2);
+      ::shutdown(fd, SHUT_RDWR);
+      return false;
+    }
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return false;
+    if (fault == 2) {
+      ::shutdown(fd, SHUT_RDWR);
+      return false;
+    }
+    return true;
+  }
+
+  // Redial + re-handshake after a wire failure, with capped exponential
+  // backoff. Caller holds mu. Returns false when this attempt's dial
+  // failed (the retry loop decides whether to try again).
+  bool Reconnect(int attempt);
 
   // Client half of ControlServer::Handshake (mutual): prove we hold the
   // secret, then verify the server's proof over OUR nonce so window bytes
@@ -820,53 +1241,71 @@ struct ControlClient {
   int64_t Call(uint8_t op, const std::string& key, int64_t arg,
                const void* data = nullptr, size_t dlen = 0) {
     std::lock_guard<std::mutex> lk(mu);
-    std::vector<char> buf;
-    Encode(&buf, op, key, arg, data, dlen);
-    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
-    int64_t reply;
-    if (!ReadReply(&reply)) return -1;
-    return reply;
+    const uint64_t seq = AllocSeq(op);
+    for (int attempt = 0;; ++attempt) {
+      std::vector<char> buf;
+      if (seq) EncodePre(&buf, seq, 1);
+      Encode(&buf, op, key, arg, data, dlen);
+      if (SendFault(buf, FaultNext())) {
+        FaultDelay();
+        int64_t reply;
+        if (ReadReply(&reply)) return reply;
+      }
+      if (attempt >= retries || !Reconnect(attempt)) return -1;
+    }
   }
 
   // Bulk-reply call (take_bytes / get_bytes): returns a malloc'd payload the
   // caller frees with bf_cp_free; length via *out_len; -1 on wire failure.
+  // take_bytes is non-idempotent (the drain consumes records): it rides the
+  // dedup preamble so a retried take replays the server-recorded reply.
   int64_t CallBytes(uint8_t op, const std::string& key, void** out,
                     int64_t* out_len) {
     std::lock_guard<std::mutex> lk(mu);
-    std::vector<char> buf;
-    Encode(&buf, op, key, 0);
-    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
-    uint32_t rlen;
-    if (!ControlServer::ReadAll(fd, &rlen, 4)) return -1;
-    if (rlen > kMaxMsg) return -1;
-    char* payload = static_cast<char*>(std::malloc(rlen ? rlen : 1));
-    if (!payload) return -1;
-    if (rlen && !ControlServer::ReadAll(fd, payload, rlen)) {
-      std::free(payload);
-      return -1;
+    const uint64_t seq = AllocSeq(op);
+    for (int attempt = 0;; ++attempt) {
+      std::vector<char> buf;
+      if (seq) EncodePre(&buf, seq, 1);
+      Encode(&buf, op, key, 0);
+      if (SendFault(buf, FaultNext())) {
+        FaultDelay();
+        uint32_t rlen;
+        if (ControlServer::ReadAll(fd, &rlen, 4) && rlen <= kMaxMsg) {
+          char* payload = static_cast<char*>(std::malloc(rlen ? rlen : 1));
+          if (!payload) return -1;
+          if (!rlen || ControlServer::ReadAll(fd, payload, rlen)) {
+            *out = payload;
+            *out_len = rlen;
+            return rlen;
+          }
+          std::free(payload);
+        }
+      }
+      if (attempt >= retries || !Reconnect(attempt)) return -1;
     }
-    *out = payload;
-    *out_len = rlen;
-    return rlen;
   }
 
   // Bulk-reply call that lands DIRECTLY in the caller's buffer (the striped
   // kGetBytesPart read path): no malloc, no extra copy — each pool
   // connection streams its range straight into its slice of the
   // preallocated result. Returns bytes read, or -1 on wire failure /
-  // oversized reply (the connection is poisoned then; callers treat it as
-  // fatal, like every other -1 here).
+  // oversized reply. Ranged reads are idempotent: plain retry.
   int64_t CallBytesInto(uint8_t op, const std::string& key, int64_t arg,
                         void* dst, size_t cap) {
     std::lock_guard<std::mutex> lk(mu);
-    std::vector<char> buf;
-    Encode(&buf, op, key, arg);
-    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
-    uint32_t rlen;
-    if (!ControlServer::ReadAll(fd, &rlen, 4)) return -1;
-    if (rlen > cap) return -1;
-    if (rlen && !ControlServer::ReadAll(fd, dst, rlen)) return -1;
-    return rlen;
+    for (int attempt = 0;; ++attempt) {
+      std::vector<char> buf;
+      Encode(&buf, op, key, arg);
+      if (SendFault(buf, FaultNext())) {
+        FaultDelay();
+        uint32_t rlen;
+        if (ControlServer::ReadAll(fd, &rlen, 4)) {
+          if (rlen > cap) return -1;  // oversized: a real protocol error
+          if (!rlen || ControlServer::ReadAll(fd, dst, rlen)) return rlen;
+        }
+      }
+      if (attempt >= retries || !Reconnect(attempt)) return -1;
+    }
   }
 
   // Pipelined payload-carrying batch (kAppendBytes / kPutBytes): frame all
@@ -893,56 +1332,78 @@ struct ControlClient {
                              const void* const* datas, const int64_t* lens,
                              const int64_t* args, int64_t* out, int n) {
     std::lock_guard<std::mutex> lk(mu);
-    const char* p = keys_nl;
-    // Small records coalesce into one send buffer (fewer syscalls); large
-    // ones are written directly from the source to skip the memcpy.
-    constexpr size_t kCoalesce = 4u << 20;
-    constexpr int kMaxInflight = 128;
-    std::vector<char> buf;
-    int replies_read = 0;
-    auto drain_to = [&](int target) -> bool {
-      for (; replies_read < target; ++replies_read) {
-        int64_t reply;
-        if (!ReadReply(&reply)) return false;
-        if (out) out[replies_read] = reply;
-      }
-      return true;
-    };
-    for (int i = 0; i < n; ++i) {
-      const char* e = std::strchr(p, '\n');
-      std::string key = e ? std::string(p, e - p) : std::string(p);
-      size_t dlen = static_cast<size_t>(lens[i]);
-      int64_t arg = args ? args[i] : lens[i];
-      if (dlen <= kCoalesce) {
-        Encode(&buf, op, key, arg, datas[i], dlen);
-      } else {
-        Encode(&buf, op, key, arg);  // header only, then stream payload
-        // fix the frame length to include the payload we stream below
-        uint32_t flen;
-        size_t hdr = 4 + 1 + 4 + 2 + key.size() + 8;
-        std::memcpy(&flen, buf.data() + buf.size() - hdr, 4);
-        flen += static_cast<uint32_t>(dlen);
-        std::memcpy(buf.data() + buf.size() - hdr, &flen, 4);
-        if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
-        buf.clear();
-        if (!ControlServer::WriteAll(fd, datas[i], dlen)) return -1;
-      }
-      p = e ? e + 1 : p + key.size();
-      if (i + 1 - replies_read > kMaxInflight) {
-        // flush coalesced frames first: a reply only arrives once its
-        // request has actually reached the server
-        if (!buf.empty()) {
-          if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
-          buf.clear();
+    // One dedup seq covers the WHOLE batch (count = n): on a wire failure
+    // the entire batch is resent under the same seq, the server replays
+    // the already-applied prefix from its recording, and only the
+    // remainder executes — no append is ever double-applied.
+    const uint64_t seq = AllocSeq(op);
+    auto attempt = [&](int fault) -> bool {
+      const char* p = keys_nl;
+      // Small records coalesce into one send buffer (fewer syscalls);
+      // large ones are written directly from the source to skip the memcpy.
+      constexpr size_t kCoalesce = 4u << 20;
+      constexpr int kMaxInflight = 128;
+      std::vector<char> buf;
+      bool first_send = true;
+      auto send = [&](const std::vector<char>& b) -> bool {
+        if (first_send) {
+          first_send = false;
+          return SendFault(b, fault);
         }
-        if (!drain_to(i + 1 - kMaxInflight)) return -1;
+        return ControlServer::WriteAll(fd, b.data(), b.size());
+      };
+      if (seq) EncodePre(&buf, seq, static_cast<uint32_t>(n));
+      int replies_read = 0;
+      bool delayed = false;
+      auto drain_to = [&](int target) -> bool {
+        if (!delayed) {
+          delayed = true;
+          FaultDelay();
+        }
+        for (; replies_read < target; ++replies_read) {
+          int64_t reply;
+          if (!ReadReply(&reply)) return false;
+          if (out) out[replies_read] = reply;
+        }
+        return true;
+      };
+      for (int i = 0; i < n; ++i) {
+        const char* e = std::strchr(p, '\n');
+        std::string key = e ? std::string(p, e - p) : std::string(p);
+        size_t dlen = static_cast<size_t>(lens[i]);
+        int64_t arg = args ? args[i] : lens[i];
+        if (dlen <= kCoalesce) {
+          Encode(&buf, op, key, arg, datas[i], dlen);
+        } else {
+          Encode(&buf, op, key, arg);  // header only, then stream payload
+          // fix the frame length to include the payload we stream below
+          uint32_t flen;
+          size_t hdr = 4 + 1 + 4 + 2 + key.size() + 8;
+          std::memcpy(&flen, buf.data() + buf.size() - hdr, 4);
+          flen += static_cast<uint32_t>(dlen);
+          std::memcpy(buf.data() + buf.size() - hdr, &flen, 4);
+          if (!send(buf)) return false;
+          buf.clear();
+          if (!ControlServer::WriteAll(fd, datas[i], dlen)) return false;
+        }
+        p = e ? e + 1 : p + key.size();
+        if (i + 1 - replies_read > kMaxInflight) {
+          // flush coalesced frames first: a reply only arrives once its
+          // request has actually reached the server
+          if (!buf.empty()) {
+            if (!send(buf)) return false;
+            buf.clear();
+          }
+          if (!drain_to(i + 1 - kMaxInflight)) return false;
+        }
       }
+      if (!buf.empty() && !send(buf)) return false;
+      return drain_to(n);
+    };
+    for (int a = 0;; ++a) {
+      if (attempt(FaultNext())) return n;
+      if (a >= retries || !Reconnect(a)) return -1;
     }
-    if (!buf.empty() &&
-        !ControlServer::WriteAll(fd, buf.data(), buf.size()))
-      return -1;
-    if (!drain_to(n)) return -1;
-    return n;
   }
 
   // Pipelined bulk-reply batch (kTakeBytes / kGetBytes): one round-trip for
@@ -951,49 +1412,58 @@ struct ControlClient {
   int64_t CallBytesMultiIn(uint8_t op, const char* keys_nl, int n, void** out,
                            int64_t* out_len) {
     std::lock_guard<std::mutex> lk(mu);
-    std::vector<char> buf;
-    const char* p = keys_nl;
-    for (int i = 0; i < n; ++i) {
-      const char* e = std::strchr(p, '\n');
-      std::string key = e ? std::string(p, e - p) : std::string(p);
-      Encode(&buf, op, key, 0);
-      p = e ? e + 1 : p + key.size();
-    }
-    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
-    // Grow the result with realloc doubling and read replies straight into
-    // it: no shadow buffer, so a 100 MB drain holds 100-ish MB once, not
-    // twice (this is the bulk data plane being optimized).
-    size_t cap = 1 << 16, used = 0;
-    char* payload = static_cast<char*>(std::malloc(cap));
-    if (!payload) return -1;
-    for (int i = 0; i < n; ++i) {
-      uint32_t rlen;
-      if (!ControlServer::ReadAll(fd, &rlen, 4) || rlen > kMaxMsg) {
-        std::free(payload);
-        return -1;
+    const uint64_t seq = AllocSeq(op);  // multi-take: batch-level dedup
+    auto attempt = [&](int fault) -> bool {
+      std::vector<char> buf;
+      if (seq) EncodePre(&buf, seq, static_cast<uint32_t>(n));
+      const char* p = keys_nl;
+      for (int i = 0; i < n; ++i) {
+        const char* e = std::strchr(p, '\n');
+        std::string key = e ? std::string(p, e - p) : std::string(p);
+        Encode(&buf, op, key, 0);
+        p = e ? e + 1 : p + key.size();
       }
-      size_t need = used + 8 + rlen;
-      if (need > cap) {
-        while (cap < need) cap *= 2;
-        char* grown = static_cast<char*>(std::realloc(payload, cap));
-        if (!grown) {
+      if (!SendFault(buf, fault)) return false;
+      FaultDelay();
+      // Grow the result with realloc doubling and read replies straight
+      // into it: no shadow buffer, so a 100 MB drain holds 100-ish MB
+      // once, not twice (this is the bulk data plane being optimized).
+      size_t cap = 1 << 16, used = 0;
+      char* payload = static_cast<char*>(std::malloc(cap));
+      if (!payload) return false;
+      for (int i = 0; i < n; ++i) {
+        uint32_t rlen;
+        if (!ControlServer::ReadAll(fd, &rlen, 4) || rlen > kMaxMsg) {
           std::free(payload);
-          return -1;
+          return false;
         }
-        payload = grown;
+        size_t need = used + 8 + rlen;
+        if (need > cap) {
+          while (cap < need) cap *= 2;
+          char* grown = static_cast<char*>(std::realloc(payload, cap));
+          if (!grown) {
+            std::free(payload);
+            return false;
+          }
+          payload = grown;
+        }
+        uint64_t rl64 = rlen;
+        std::memcpy(payload + used, &rl64, 8);
+        used += 8;
+        if (rlen && !ControlServer::ReadAll(fd, payload + used, rlen)) {
+          std::free(payload);
+          return false;
+        }
+        used += rlen;
       }
-      uint64_t rl64 = rlen;
-      std::memcpy(payload + used, &rl64, 8);
-      used += 8;
-      if (rlen && !ControlServer::ReadAll(fd, payload + used, rlen)) {
-        std::free(payload);
-        return -1;
-      }
-      used += rlen;
+      *out = payload;
+      *out_len = static_cast<int64_t>(used);
+      return true;
+    };
+    for (int a = 0;; ++a) {
+      if (attempt(FaultNext())) return n;
+      if (a >= retries || !Reconnect(a)) return -1;
     }
-    *out = payload;
-    *out_len = static_cast<int64_t>(used);
-    return n;
   }
 
   // Pipelined batch: send every request, then drain every reply. The server
@@ -1002,21 +1472,30 @@ struct ControlClient {
   int64_t CallMulti(uint8_t op, const char* keys_nl, const int64_t* args,
                     int64_t* out, int n) {
     std::lock_guard<std::mutex> lk(mu);
-    std::vector<char> buf;
-    const char* p = keys_nl;
-    for (int i = 0; i < n; ++i) {
-      const char* e = std::strchr(p, '\n');
-      std::string key = e ? std::string(p, e - p) : std::string(p);
-      Encode(&buf, op, key, args ? args[i] : 0);
-      p = e ? e + 1 : p + key.size();
+    const uint64_t seq = AllocSeq(op);  // fetch_add_many: batch-level dedup
+    auto attempt = [&](int fault) -> bool {
+      std::vector<char> buf;
+      if (seq) EncodePre(&buf, seq, static_cast<uint32_t>(n));
+      const char* p = keys_nl;
+      for (int i = 0; i < n; ++i) {
+        const char* e = std::strchr(p, '\n');
+        std::string key = e ? std::string(p, e - p) : std::string(p);
+        Encode(&buf, op, key, args ? args[i] : 0);
+        p = e ? e + 1 : p + key.size();
+      }
+      if (!SendFault(buf, fault)) return false;
+      FaultDelay();
+      for (int i = 0; i < n; ++i) {
+        int64_t reply;
+        if (!ReadReply(&reply)) return false;
+        if (out) out[i] = reply;
+      }
+      return true;
+    };
+    for (int a = 0;; ++a) {
+      if (attempt(FaultNext())) return n;
+      if (a >= retries || !Reconnect(a)) return -1;
     }
-    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
-    for (int i = 0; i < n; ++i) {
-      int64_t reply;
-      if (!ReadReply(&reply)) return -1;
-      if (out) out[i] = reply;
-    }
-    return n;
   }
 };
 
@@ -1031,7 +1510,68 @@ static void SetSockBuf(int fd, int bytes) {
   ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
 }
 
+namespace {
+
+// Dial + TCP_NODELAY + mutual HMAC handshake; -1 on any failure. The one
+// connect path shared by first connects and transparent reconnects, so a
+// rebuilt stream is exactly as authenticated as the original.
+int DialAndHandshake(const std::string& host, int port,
+                     const std::string& secret, int sockbuf) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  SetSockBuf(fd, sockbuf);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (!ControlClient::Handshake(fd, secret)) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+bool ControlClient::Reconnect(int attempt) {
+  if (retries <= 0 || host.empty()) return false;
+  if (fd >= 0) {
+    ::close(fd);
+    fd = -1;
+  }
+  long long ms = static_cast<long long>(backoff_ms)
+                 << (attempt < 6 ? attempt : 6);
+  if (ms > 2000) ms = 2000;
+  if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+  int nfd = DialAndHandshake(host, port, secret, sockbuf);
+  if (nfd < 0) return false;
+  fd = nfd;
+  return true;
+}
+
+}  // namespace
+
 extern "C" {
+
+// Arm / disarm the deterministic fault injector (BLUEFOG_CP_FAULT; see
+// runtime/native.py for the spec grammar). drop_after <= 0 disarms drops;
+// counters reset on every call so a test's drop points are reproducible.
+void bf_cp_fault(long long drop_after, int delay_ms, int trunc,
+                 long long seed) {
+  g_fault_drop_after.store(drop_after);
+  g_fault_delay_ms.store(delay_ms);
+  g_fault_trunc.store(trunc);
+  g_fault_seed.store(seed);
+  g_fault_ops.store(0);
+  g_fault_drops.store(0);
+}
+
+long long bf_cp_fault_drops(void) { return g_fault_drops.load(); }
+long long bf_cp_fault_ops(void) { return g_fault_ops.load(); }
 
 void* bf_cp_serve_auth2(int port, int world, const char* secret,
                         int64_t max_mailbox_bytes, int sockbuf_bytes) {
@@ -1054,6 +1594,11 @@ void* bf_cp_serve_auth2(int port, int world, const char* secret,
   srv->world = world;
   srv->secret = secret ? secret : "";
   srv->max_box_bytes = max_mailbox_bytes;
+  // Leases/deadlines for the blocking primitives (docs/fault_tolerance.md):
+  // bound every server-side wait so a dead peer can never park a handler —
+  // or a healthy client — forever.
+  srv->lock_lease_sec = EnvSeconds("BLUEFOG_CP_LOCK_LEASE", 60.0);
+  srv->barrier_timeout_sec = EnvSeconds("BLUEFOG_CP_BARRIER_TIMEOUT", 600.0);
   srv->accept_thread = std::thread([srv] { srv->AcceptLoop(); });
   return srv;
 }
@@ -1085,45 +1630,57 @@ void bf_cp_server_stop(void* handle) {
   ::close(srv->listen_fd);
   srv->accept_thread.join();
   // Wake every blocked handler (recv returns 0 after shutdown; cv waiters
-  // see `stopping`), then JOIN them all before freeing the server — each
-  // handler closes its own fd on exit, so no fd is closed twice and no
-  // thread can touch freed state.
-  std::vector<std::thread> hs;
+  // see `stopping`), then wait for the detached handlers to drain before
+  // freeing the server. A handler wedged past the grace (e.g. mid-write to
+  // a jammed peer) leaks the server object instead of risking a
+  // use-after-free under it.
   {
-    std::lock_guard<std::mutex> lk(srv->mu);
+    std::unique_lock<std::mutex> lk(srv->mu);
     for (int fd : srv->handler_fds) ::shutdown(fd, SHUT_RDWR);
-    hs.swap(srv->handlers);
+    if (!srv->cv.wait_for(lk, std::chrono::seconds(10),
+                          [&] { return srv->active_handlers == 0; }))
+      return;  // deliberate leak: a live handler still references *srv
   }
-  for (auto& t : hs)
-    if (t.joinable()) t.join();
   delete srv;
+}
+
+// Fault-injection kill hook: hard-drop every live client connection (the
+// server keeps running). Clients observe exactly what a network partition /
+// peer restart looks like and must transparently reconnect.
+void bf_cp_server_drop_conns(void* handle) {
+  auto* srv = static_cast<ControlServer*>(handle);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  for (int fd : srv->handler_fds) ::shutdown(fd, SHUT_RDWR);
 }
 
 void* bf_cp_connect_auth2(const char* host, int port, int rank,
                           const char* secret, int sockbuf_bytes) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  std::string h = host ? host : "";
+  std::string s = secret ? secret : "";
+  int fd = DialAndHandshake(h, port, s, sockbuf_bytes);
   if (fd < 0) return nullptr;
-  SetSockBuf(fd, sockbuf_bytes);
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons(static_cast<uint16_t>(port));
-  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
-    ::close(fd);
-    return nullptr;
-  }
-  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
-    ::close(fd);
-    return nullptr;
-  }
-  int one = 1;
-  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  if (!ControlClient::Handshake(fd, secret ? secret : "")) {
-    ::close(fd);
-    return nullptr;
-  }
   auto* cl = new ControlClient();
   cl->fd = fd;
   cl->rank = rank;
+  cl->host = h;
+  cl->port = port;
+  cl->secret = s;
+  cl->sockbuf = sockbuf_bytes;
+  cl->retries = static_cast<int>(EnvInt("BLUEFOG_CP_RETRIES", 3));
+  if (cl->retries < 0) cl->retries = 0;
+  cl->backoff_ms = static_cast<int>(EnvInt("BLUEFOG_CP_BACKOFF_MS", 50));
+  if (cl->backoff_ms < 0) cl->backoff_ms = 0;
+  // Stable dedup identity: survives reconnects for this client object.
+  // urandom keeps ids from colliding across processes; the fallback mixes
+  // pid + a process-local counter (collisions would only weaken dedup
+  // between two clients of one buggy entropy-less host).
+  uint8_t idb[8];
+  if (RandomBytes(idb, 8)) {
+    std::memcpy(&cl->cid, idb, 8);
+  } else {
+    static std::atomic<uint64_t> ctr{1};
+    cl->cid = (static_cast<uint64_t>(::getpid()) << 32) ^ ctr.fetch_add(1);
+  }
   return cl;
 }
 

@@ -1,0 +1,89 @@
+"""Child for the kill-peer-mid-gossip self-healing test (ISSUE r8).
+
+Four controllers, two devices each, running a real window-optimizer gossip
+loop (DistributedWinPutOptimizer over the hosted plane). Controller 3 is
+hard-killed mid-loop — possibly while holding window mutexes and with
+deposits in flight. Survivors must keep completing bounded gossip steps:
+the optimizer consults the heartbeat dead set each step, drops ranks
+{6, 7} from its edge tables, renormalizes the averaging weights, and the
+leased lock layer force-releases anything the corpse held (a blocked
+acquire surfaces PeerLostError, which the optimizer retries once on the
+shrunken topology).
+"""
+
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+
+N = 8
+DEAD_PID = 3
+
+
+def main() -> None:
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert bf.size() == N, bf.size()
+
+    def loss_fn(params, batch):
+        return jnp.sum((params["w"] - 3.0) ** 2)
+
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.05), loss_fn=loss_fn)
+    state = opt.init({"w": jnp.zeros((4,), jnp.float32)})
+    batch = bf.replicate(jnp.zeros((1,), jnp.float32))
+
+    for _ in range(3):
+        state, _ = opt.step(state, batch)
+    print(f"HEALTHY {pid}", flush=True)
+
+    if pid == DEAD_PID:
+        os._exit(17)  # silent SIGKILL shape: no announce, no atexit
+
+    detected = False
+    post_detect_steps = 0
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and post_detect_steps < 3:
+        t0 = time.monotonic()
+        state, _ = opt.step(state, batch)
+        step_s = time.monotonic() - t0
+        if not detected and bf.dead_controllers() == {DEAD_PID}:
+            detected = True
+            assert bf.dead_ranks() == {6, 7}, bf.dead_ranks()
+            print(f"DEAD_DETECTED {pid}", flush=True)
+        if detected:
+            post_detect_steps += 1
+            # bounded: a step on the healed topology must not wait on the
+            # corpse (no unbounded lock/barrier/drain)
+            assert step_s < 30, f"post-detection step took {step_s:.1f}s"
+    if post_detect_steps < 3:
+        print(f"SURVIVOR_TIMEOUT {pid}", flush=True)
+        os._exit(3)
+    for shard in state.params["w"].addressable_shards:
+        assert np.isfinite(np.asarray(shard.data)).all()
+    print(f"SURVIVOR_STEPS_OK {pid}", flush=True)
+
+    # Survivor rendezvous (see _quad_fault_child.py): process 0 hosts both
+    # the jax coordinator and the control-plane server, so it must leave
+    # last; graceful teardown barriers would block on the corpse.
+    from bluefog_tpu.runtime import control_plane
+    cl = control_plane.client()
+    cl.put(f"gf.done.{pid}", 1)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(cl.get(f"gf.done.{i}") for i in range(3)):
+            break
+        time.sleep(0.05)
+    print(f"CHILD_OK {pid}", flush=True)
+    if pid == 0:
+        time.sleep(2.0)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

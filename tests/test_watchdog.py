@@ -78,6 +78,43 @@ def test_watchdog_warns_once_per_stalled_handle():
     handles.synchronize(h)  # cleanup (plain object: block_until_ready no-op)
 
 
+def test_warned_set_pruned_after_completion():
+    """ISSUE r8 satellite: the once-warned set must not grow for the life
+    of the job — entries for handles that completed (or were swept) are
+    pruned, and a handle that re-enters the outstanding set after
+    progressing warns again."""
+    cap = _Capture()
+    logger.addHandler(cap)
+    never = _NeverReady()
+    h = handles.allocate("op.leaky", never)
+    wd = StallWatchdog(warning_sec=0.05, cycle_ms=1.0)
+    try:
+        wd.start()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and h not in wd._warned:
+            time.sleep(0.1)
+        assert h in wd._warned
+        # completing the op must eventually prune its warned entry
+        never.is_ready = lambda: True
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and h in wd._warned:
+            time.sleep(0.1)
+        assert h not in wd._warned, "completed handle leaked in _warned"
+        # a fresh stall of a RE-REGISTERED handle id warns again: simulate
+        # the timed-out-synchronize path by re-allocating stalled work
+        h2 = handles.allocate("op.leaky2", _NeverReady())
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not any(
+                "op.leaky2" in r.getMessage() for r in cap.records):
+            time.sleep(0.1)
+        assert any("op.leaky2" in r.getMessage() for r in cap.records)
+        handles.synchronize(h2)
+    finally:
+        wd.stop()
+        logger.removeHandler(cap)
+    handles.synchronize(h)
+
+
 def test_poll_and_synchronize_contract():
     h = handles.allocate("op.x", _Ready())
     assert handles.poll(h) is True
