@@ -48,10 +48,16 @@ from .runtime.state import (
 # handles
 from .runtime.handles import poll, synchronize, wait
 
-# failure detection / coordinated shutdown / fault tolerance
-# (multi-controller; see docs/fault_tolerance.md)
-from .runtime.heartbeat import dead_controllers, dead_ranks, shutdown_requested
-from .runtime.native import PeerLostError
+# failure detection / coordinated shutdown / fault tolerance / elastic
+# membership (multi-controller; see docs/fault_tolerance.md)
+from .runtime.heartbeat import (
+    dead_controllers,
+    dead_ranks,
+    membership_epoch,
+    shutdown_requested,
+    suspect_controllers,
+)
+from .runtime.native import PeerLostError, StaleIncarnationError
 
 # timeline
 from .runtime.timeline import (

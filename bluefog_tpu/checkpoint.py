@@ -16,7 +16,9 @@ addressable arrays.
 from __future__ import annotations
 
 import atexit
+import json
 import os
+import zlib
 from typing import Optional
 
 import jax
@@ -65,6 +67,104 @@ def _as_tree(state: TrainState, step: int):
     }
 
 
+# World-identity sidecar written NEXT TO the orbax directory (not inside
+# it: the async save path renames a temp dir onto `path` at commit time, so
+# a file planted inside the final path would break the rename). JSON so
+# orbax's pytree handler never has to round-trip strings.
+_META_SUFFIX = ".bf_meta.json"
+
+
+def _meta_path(path: str) -> str:
+    return os.path.abspath(path) + _META_SUFFIX
+
+
+def _topology_crc(st) -> Optional[int]:
+    try:
+        from . import topology as topology_util
+
+        W = topology_util.weight_matrix(st.topology)
+        return int(zlib.crc32(np.ascontiguousarray(W).tobytes()))
+    except Exception:  # noqa: BLE001 — meta is best-effort
+        return None
+
+
+def _runtime_meta(step: int) -> dict:
+    """World identity at save time: world size, topology fingerprint, and
+    membership epoch — what `restore` checks so a checkpoint cannot be
+    silently resumed onto a DIFFERENT world (ISSUE r9 satellite)."""
+    meta = {"step": int(step)}
+    st = _global_state()
+    if st.initialized:
+        meta["world"] = int(st.size)
+        meta["process_count"] = int(st.process_count)
+        crc = _topology_crc(st)
+        if crc is not None:
+            meta["topology_crc"] = crc
+        try:
+            from .runtime.heartbeat import membership_epoch
+
+            meta["membership_epoch"] = int(membership_epoch())
+        except Exception:  # noqa: BLE001
+            pass
+    return meta
+
+
+def _write_meta(path: str, step: int) -> None:
+    try:
+        with open(_meta_path(path), "w") as f:
+            json.dump(_runtime_meta(step), f)
+    except OSError as exc:
+        logger.warning("checkpoint meta sidecar write failed (%s)", exc)
+
+
+def read_meta(path: str) -> Optional[dict]:
+    """The checkpoint's world-identity sidecar, or None (pre-r9 or lost)."""
+    try:
+        with open(_meta_path(path)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _check_meta(path: str, strict: bool) -> None:
+    meta = read_meta(path)
+    st = _global_state()
+    if meta is None or not st.initialized:
+        return
+    mismatches = []
+    if "world" in meta and int(meta["world"]) != st.size:
+        mismatches.append(
+            f"world size {meta['world']} (saved) vs {st.size} (current)")
+    crc = _topology_crc(st)
+    if "topology_crc" in meta and crc is not None and \
+            int(meta["topology_crc"]) != crc:
+        mismatches.append(
+            "topology fingerprint differs (the combine matrix changed "
+            "since the save)")
+    if not mismatches:
+        return
+    msg = (f"checkpoint {path} was saved on a different world: "
+           + "; ".join(mismatches)
+           + ". Decentralized state is rank-stacked — resuming it onto a "
+           "mismatched world silently mis-assigns per-rank parameters.")
+    if strict:
+        raise RuntimeError(msg)
+    logger.warning("%s Resuming anyway (pass strict=True to refuse).", msg)
+
+
+def latest_path(directory: str) -> Optional[str]:
+    """Newest checkpoint directory under ``directory`` (by mtime), or None.
+
+    The elastic-rejoin fallback uses this to find the freshest local state
+    when no live in-neighbor can serve a transfer."""
+    try:
+        entries = [os.path.join(directory, e) for e in os.listdir(directory)]
+    except OSError:
+        return None
+    dirs = [e for e in entries if os.path.isdir(e)]
+    return max(dirs, key=os.path.getmtime) if dirs else None
+
+
 def save(path: str, state: TrainState, step: int = 0, *, force: bool = True) -> str:
     """Write a checkpoint directory at ``path`` (overwrites when ``force``)."""
     if not _HAVE_ORBAX:
@@ -74,6 +174,7 @@ def save(path: str, state: TrainState, step: int = 0, *, force: bool = True) -> 
     path = os.path.abspath(path)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(path, _as_tree(state, step), force=force)
+    _write_meta(path, step)
     logger.info("checkpoint saved to %s (step %d)", path, step)
     return path
 
@@ -104,6 +205,9 @@ def save_async(path: str, state: TrainState, step: int = 0, *,
         _async_ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
     path = os.path.abspath(path)
     _async_ckptr.save(path, _as_tree(state, step), force=force)
+    # the sidecar holds host-side values known NOW; writing it immediately
+    # is safe because it lives next to the orbax dir, not inside it
+    _write_meta(path, step)
     logger.info("async checkpoint started to %s (step %d)", path, step)
     return path
 
@@ -114,7 +218,8 @@ def wait_pending() -> None:
         _async_ckptr.wait_until_finished()
 
 
-def restore(path: str, template: Optional[TrainState] = None):
+def restore(path: str, template: Optional[TrainState] = None,
+            strict: bool = False):
     """Load ``(TrainState, step)`` from ``path``.
 
     With ``template`` (a TrainState of the right structure, e.g. from
@@ -122,12 +227,19 @@ def restore(path: str, template: Optional[TrainState] = None):
     resuming directly onto the mesh. Without it, arrays come back as
     host-replicated values and should be re-placed via
     :func:`bluefog_tpu.shard_rank_stacked`.
+
+    The world-identity sidecar (world size + topology fingerprint,
+    recorded by ``save``/``save_async``) is checked against the current
+    runtime: a mismatch WARNS by default and raises with ``strict=True`` —
+    a rank-stacked checkpoint resumed onto a different world silently
+    mis-assigns per-rank state.
     """
     if not _HAVE_ORBAX:
         raise RuntimeError("orbax-checkpoint is not available")
     _check_multicontroller_backend()
     wait_pending()  # an in-flight async save may target this very path
     path = os.path.abspath(path)
+    _check_meta(path, strict)
     with ocp.PyTreeCheckpointer() as ckptr:
         if template is not None:
             item = _as_tree(template, 0)

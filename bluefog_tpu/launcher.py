@@ -113,6 +113,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="python executable to run on remote hosts")
     p.add_argument("--simulate", type=int, default=None, metavar="N",
                    help="simulate an N-device CPU mesh (development)")
+    p.add_argument("--elastic", nargs="?", const=3, type=int, default=None,
+                   metavar="MAX_RESTARTS",
+                   help="supervise children elastically: a crashed rank is "
+                        "respawned with BLUEFOG_INCARNATION bumped (the "
+                        "control plane fences its zombie and the rank "
+                        "rejoins through quarantined state transfer, see "
+                        "docs/fault_tolerance.md), up to MAX_RESTARTS per "
+                        "rank (default 3) with exponential backoff. A "
+                        "terminal failure propagates only when a rank's "
+                        "restart budget is exhausted or the surviving "
+                        "world would drop below --min-world")
+    p.add_argument("--min-world", type=int, default=1, metavar="M",
+                   help="with --elastic: kill the whole job once fewer "
+                        "than M ranks could keep running (default 1)")
     p.add_argument("--chaos", type=str, default=None, metavar="SPEC",
                    help="arm deterministic control-plane fault injection in "
                         "every launched process (exports BLUEFOG_CP_FAULT; "
@@ -193,6 +207,71 @@ def _free_port() -> int:
 _FORWARD_ENV_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_")
 
 
+def _supervise_elastic(procs, spawn, base_inc: int, budget: int,
+                       min_world: int) -> List[int]:
+    """Elastic child supervision (`bfrun --elastic`).
+
+    A crashed rank is respawned in place with ``BLUEFOG_INCARNATION``
+    bumped — the control plane then fences the crash's zombie connections
+    and the rank rejoins through quarantined state transfer
+    (docs/fault_tolerance.md, "Rejoin & fencing"). Respawns back off
+    exponentially (0.5 s doubling, capped at 10 s) and are bounded by
+    ``budget`` per rank. A terminal failure propagates only when a rank's
+    budget is exhausted (its code lands in the returned list; the job
+    keeps running for the survivors) or the surviving world would drop
+    below ``min_world`` (the whole job is torn down). Returns per-rank
+    terminal exit codes (None for ranks still running at a min-world
+    teardown — the caller's cleanup terminates and aggregates them).
+    """
+    total = len(procs)
+    restarts = [0] * total
+    incs = [base_inc] * total
+    final: List = [None] * total     # terminal exit code per rank
+    respawn_at = [0.0] * total       # backoff deadline for pending respawns
+    pending = set()
+    while True:
+        now = time.time()
+        for i in range(total):
+            if final[i] is not None:
+                continue
+            if i in pending:
+                if now >= respawn_at[i]:
+                    pending.discard(i)
+                    incs[i] += 1
+                    procs[i] = spawn(i, incs[i])
+                continue
+            c = procs[i].poll()
+            if c is None:
+                continue
+            if c == 0:
+                final[i] = 0
+            elif restarts[i] < budget:
+                restarts[i] += 1
+                delay = min(0.5 * (2 ** (restarts[i] - 1)), 10.0)
+                print(
+                    f"bfrun: rank {i} exited with {c}; respawning as "
+                    f"incarnation {incs[i] + 1} in {delay:.1f}s "
+                    f"(restart {restarts[i]}/{budget})", file=sys.stderr)
+                respawn_at[i] = now + delay
+                pending.add(i)
+            else:
+                final[i] = c
+                print(
+                    f"bfrun: rank {i} exited with {c} and exhausted its "
+                    f"restart budget ({budget}); marking it failed",
+                    file=sys.stderr)
+        failed = sum(1 for c in final if c not in (None, 0))
+        if failed and total - failed < min_world:
+            print(
+                f"bfrun: surviving world {total - failed} dropped below "
+                f"--min-world {min_world}; terminating the job",
+                file=sys.stderr)
+            return [c for c in final if c is not None]
+        if all(c is not None for c in final):
+            return final
+        time.sleep(0.1)
+
+
 def _fanout(args) -> int:
     """Drive the whole job from this one shell: launch every process, stream
     its output, aggregate exit codes, kill-all on Ctrl-C or first failure."""
@@ -258,65 +337,83 @@ def _fanout(args) -> int:
             out += ["--chaos", args.chaos]
         return out + ["--"] + args.command
 
-    procs: List[subprocess.Popen] = []
-    pid = 0
+    # slot index -> host (stable across respawns in elastic mode)
+    slot_host = [h for h, s in entries for _ in range(s)]
+    base_inc = 0
     try:
-        for host, slots in entries:
-            for _ in range(slots):
-                if _is_local(host):
-                    procs.append(subprocess.Popen(
-                        [sys.executable] + child_args(pid)))
-                else:
-                    # NEVER put the job secret on the remote command line —
-                    # /proc/<pid>/cmdline is world-readable, so any local
-                    # user on a shared node could read it and pass the HMAC
-                    # handshake. It travels over ssh stdin instead (echo
-                    # off: -tt allocates a pty that would otherwise echo
-                    # the line into captured output).
-                    exports = " ".join(
-                        f"{k}={shlex.quote(v)}"
-                        for k, v in os.environ.items()
-                        if (k.startswith(_FORWARD_ENV_PREFIXES)
-                            or k == "PYTHONPATH")
-                        and k != "BLUEFOG_CP_SECRET")
-                    secret = os.environ.get("BLUEFOG_CP_SECRET", "")
-                    # '&&' so a missing remote workdir fails loudly instead
-                    # of becoming an opaque ModuleNotFoundError later.
-                    # The ready marker closes a race: until the remote stty
-                    # runs, the pty's ECHO flag is still on, so a secret
-                    # written at Popen time could be echoed back into the
-                    # launcher's captured output. Write it only after the
-                    # remote confirms echo is off.
-                    remote = ("stty -echo 2>/dev/null; "
-                              f"printf '{_SECRET_READY}\\n'; "
-                              "IFS= read -r BLUEFOG_CP_SECRET; "
-                              "export BLUEFOG_CP_SECRET; "
-                              f"cd {shlex.quote(os.getcwd())} && "
-                              f"env {exports} {args.remote_python} "
-                              + shlex.join(child_args(pid)))
-                    # -tt: a pty ties the remote process to the connection,
-                    # so kill-all on the ssh client actually kills the job
-                    # on the host (and forwards Ctrl-C)
-                    p = subprocess.Popen(
-                        ["ssh", "-tt", "-o", "BatchMode=yes",
-                         "-p", str(args.ssh_port), host, remote],
-                        stdin=subprocess.PIPE, stdout=subprocess.PIPE)
-                    threading.Thread(
-                        target=_send_secret_when_ready,
-                        args=(p, secret, host), daemon=True).start()
-                    procs.append(p)
-                pid += 1
+        base_inc = max(0, int(os.environ.get("BLUEFOG_INCARNATION", "0")
+                              or 0))
+    except ValueError:
+        pass
 
-        # first failure kills the job (mpirun semantics); otherwise wait all
-        while True:
-            codes = [p.poll() for p in procs]
-            failed = [c for c in codes if c not in (None, 0)]
-            if failed or all(c is not None for c in codes):
-                break
-            time.sleep(0.1)
-        # codes at loop exit are authoritative: processes still running get
-        # terminated below, and their -SIGTERM must not mask the real failure
-        own_exit = [c for c in codes if c is not None]
+    def spawn(pid: int, inc: int) -> subprocess.Popen:
+        host = slot_host[pid]
+        if _is_local(host):
+            env = dict(os.environ)
+            env["BLUEFOG_INCARNATION"] = str(inc)
+            return subprocess.Popen([sys.executable] + child_args(pid),
+                                    env=env)
+        # NEVER put the job secret on the remote command line —
+        # /proc/<pid>/cmdline is world-readable, so any local
+        # user on a shared node could read it and pass the HMAC
+        # handshake. It travels over ssh stdin instead (echo
+        # off: -tt allocates a pty that would otherwise echo
+        # the line into captured output).
+        exports = " ".join(
+            f"{k}={shlex.quote(v)}"
+            for k, v in os.environ.items()
+            if (k.startswith(_FORWARD_ENV_PREFIXES)
+                or k == "PYTHONPATH")
+            and k not in ("BLUEFOG_CP_SECRET", "BLUEFOG_INCARNATION"))
+        exports += f" BLUEFOG_INCARNATION={inc}"
+        secret = os.environ.get("BLUEFOG_CP_SECRET", "")
+        # '&&' so a missing remote workdir fails loudly instead
+        # of becoming an opaque ModuleNotFoundError later.
+        # The ready marker closes a race: until the remote stty
+        # runs, the pty's ECHO flag is still on, so a secret
+        # written at Popen time could be echoed back into the
+        # launcher's captured output. Write it only after the
+        # remote confirms echo is off.
+        remote = ("stty -echo 2>/dev/null; "
+                  f"printf '{_SECRET_READY}\\n'; "
+                  "IFS= read -r BLUEFOG_CP_SECRET; "
+                  "export BLUEFOG_CP_SECRET; "
+                  f"cd {shlex.quote(os.getcwd())} && "
+                  f"env {exports} {args.remote_python} "
+                  + shlex.join(child_args(pid)))
+        # -tt: a pty ties the remote process to the connection,
+        # so kill-all on the ssh client actually kills the job
+        # on the host (and forwards Ctrl-C)
+        p = subprocess.Popen(
+            ["ssh", "-tt", "-o", "BatchMode=yes",
+             "-p", str(args.ssh_port), host, remote],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE)
+        threading.Thread(
+            target=_send_secret_when_ready,
+            args=(p, secret, host), daemon=True).start()
+        return p
+
+    procs: List[subprocess.Popen] = []
+    try:
+        for pid in range(total):
+            procs.append(spawn(pid, base_inc))
+
+        if args.elastic is not None:
+            own_exit = _supervise_elastic(
+                procs, spawn, base_inc, max(0, args.elastic),
+                max(1, args.min_world))
+        else:
+            # first failure kills the job (mpirun semantics); else wait all
+            while True:
+                codes = [p.poll() for p in procs]
+                failed = [c for c in codes if c not in (None, 0)]
+                if failed or all(c is not None for c in codes):
+                    break
+                time.sleep(0.1)
+            # codes at loop exit are authoritative: processes still running
+            # get terminated below, and their -SIGTERM must not mask the
+            # real failure
+            own_exit = [c for c in codes if c is not None]
     except KeyboardInterrupt:
         for p in procs:
             if p.poll() is None:

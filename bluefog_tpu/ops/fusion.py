@@ -77,6 +77,32 @@ def unpack(buffer, spec: PackSpec):
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
+def unpack_row(row: np.ndarray, spec: PackSpec) -> List[np.ndarray]:
+    """Host-side unpack of ONE rank's flat [total] row into per-leaf arrays.
+
+    The elastic-rejoin state transfer moves a single rank's packed window
+    row between controllers as host bytes; a jitted :func:`unpack` would
+    need every controller to dispatch the same program — exactly what a
+    one-sided rejoin cannot ask for — so this unpacks with numpy only.
+    """
+    row = np.asarray(row).reshape(-1)
+    out: List[np.ndarray] = []
+    for shape, dtype, off, size in zip(spec.shapes, spec.dtypes, spec.offsets,
+                                       spec.sizes):
+        out.append(np.asarray(row[off:off + size]).reshape(shape).astype(
+            np.dtype(dtype)))
+    return out
+
+
+def pack_row(leaf_rows: Sequence, spec: PackSpec) -> np.ndarray:
+    """Host-side inverse of :func:`unpack_row`: per-leaf arrays for ONE
+    rank -> that rank's flat [total] packed row (buffer dtype)."""
+    bt = np.dtype(spec.buffer_dtype)
+    return np.concatenate([
+        np.asarray(x).reshape(-1).astype(bt) for x in leaf_rows
+    ]) if leaf_rows else np.zeros((0,), bt)
+
+
 @functools.lru_cache(maxsize=512)
 def _pack_compiled(spec: PackSpec):
     return jax.jit(lambda tree: pack(tree, spec))

@@ -168,6 +168,16 @@ class _ControlPlaneWinHost:
         self.owned = set(owned)
         self._cl = _cp.client()
         self._pre = f"w.{name}"
+        # A quarantined rejoiner starts with ZERO push-sum mass: its old
+        # mass died with its previous incarnation, and minting a fresh p=1
+        # here would inflate the job's total — the donor mass split
+        # (optimizers._PushSumRejoin) installs its share instead. It also
+        # must not barrier (the aligned-creation flush below): survivors
+        # are mid-loop and will never arrive.
+        from ..runtime.heartbeat import quarantine_pending
+
+        rejoining = quarantine_pending()
+        p_init = 0.0 if rejoining else 1.0
         # The server lock is re-entrant per client rank but NOT
         # recursion-counted (first unlock fully releases, csrc/bf_runtime.cc
         # kUnlock). Count recursion locally so a require_mutex op nested in a
@@ -181,11 +191,12 @@ class _ControlPlaneWinHost:
         self._mu_gates: Dict[int, threading.Lock] = {}
         self._mu_depth_lock = threading.Lock()
         for dst in self.owned:
-            _cp.put_float(self._cl, f"{self._pre}.p.{dst}", 1.0)
+            _cp.put_float(self._cl, f"{self._pre}.p.{dst}", p_init)
             for k in range(d_max):
                 self._cl.put(f"{self._pre}.v.{dst}.{k}", 0)
                 _cp.put_float(self._cl, f"{self._pre}.m.{dst}.{k}", 0.0)
-        self.flush()
+        if not rejoining:
+            self.flush()
 
     def bump_version(self, dst: int, k: int, force: bool = False) -> None:
         # ``force``: origin-side bump in the hosted (one-sided) plane — slot
@@ -535,8 +546,14 @@ class Window:
                     for r in self.owned}
             self._publish_selves(self.owned)
             # creation is aligned across controllers (like MPI_Win_create);
-            # data-plane OPS afterwards never barrier — that's the point
-            self.host.flush()
+            # data-plane OPS afterwards never barrier — that's the point.
+            # EXCEPT for a quarantined rejoiner: the survivors are mid-loop
+            # and will never arrive at a creation barrier — its window
+            # joins one-sidedly and state transfer replaces the rows anyway.
+            from ..runtime.heartbeat import quarantine_pending
+
+            if not quarantine_pending():
+                self.host.flush()
         else:
             sh = NamedSharding(st.mesh, P("rank"))
             if isinstance(tensor, jax.Array):
@@ -960,6 +977,32 @@ class Window:
                           [b""] * len(self.owned))
         if aligned:
             self.host.flush()
+
+    # -- elastic rejoin support (hosted plane; ISSUE r9) -------------------
+
+    def read_published_row(self, rank: int):
+        """One rank's published window tensor, or None when absent or
+        mis-sized (its controller never published, or is itself dead and
+        its slot was cleared). The rejoin state transfer reads a donor's
+        row through this — the same striped get_bytes transport win_get
+        rides, reused as-is."""
+        raw = _cp.client().get_bytes(self._self_key(rank))
+        expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
+            self.dtype.itemsize
+        if len(raw) != expect:
+            return None
+        return np.frombuffer(raw, self.dtype).reshape(self.row_shape).copy()
+
+    def install_row(self, rank: int, row) -> None:
+        """Owner-write one OWNED rank's window row and publish it (the
+        rejoiner installing transferred state; also the donor's half after
+        a push-sum mass split)."""
+        if rank not in self.owned:
+            raise ValueError(f"install_row: rank {rank} is not owned here")
+        with self.state_mu:
+            self._rows[rank] = np.ascontiguousarray(row).astype(
+                self.dtype, copy=False).copy()
+            self._publish_selves([rank])
 
     # -- compiled programs -------------------------------------------------
 

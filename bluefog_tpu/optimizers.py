@@ -56,6 +56,8 @@ or ``loss_fn(params, model_state, batch) -> (loss, (model_state, aux))`` with
 from __future__ import annotations
 
 import contextlib
+import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -73,6 +75,8 @@ from .ops import fusion as _fusion
 from .ops import windows as _windows
 from .ops.neighbors import _dynamic_weight_matrix, _static_weight_matrix
 from .ops.plan import CombinePlan, spmd_combine
+from .runtime import control_plane as _cp
+from .runtime import heartbeat as _hb
 from .runtime.logging import logger
 from .runtime.native import PeerLostError
 from .runtime.state import _global_state
@@ -585,6 +589,12 @@ class _WindowOptimizer(_FusedOptimizer):
         self._win_names: list = []
         self._treedef = None
         self.require_mutex = True
+        # Elastic-membership bookkeeping (r9): healed edge tables are
+        # rebuilt only when the dead set actually CHANGES — the membership
+        # epoch (a local mirror, no server round-trip) gates both the
+        # rebuild and the donor-side rejoin-request scan.
+        self._healed_cache: Dict[frozenset, tuple] = {}
+        self._serve_epoch: Optional[int] = None
 
     def init(self, params, model_state=None) -> TrainState:
         state = super().init(params, model_state)
@@ -608,6 +618,22 @@ class _WindowOptimizer(_FusedOptimizer):
             packed = _fusion.pack_jit([leaves[i] for i in idxs], spec)
             if not _windows.win_create(packed, nm, zero_init=self._zero_init):
                 raise RuntimeError(f"window {nm} already exists")
+        from .runtime import heartbeat as _hb
+
+        if _hb.quarantine_pending():
+            win0 = _windows._get_window(self._win_names[0])
+            if win0.hosted:
+                # Quarantined rejoin: adopt current state from a live
+                # in-neighbor (striped win_get transport) — or the newest
+                # local checkpoint — BEFORE the first step, then publish
+                # quarantine completion so survivors re-admit this rank.
+                state = self._rejoin_state_transfer(state)
+            else:
+                logger.warning(
+                    "rejoin: collective-plane windows cannot transfer "
+                    "state one-sidedly (every controller dispatches every "
+                    "program); completing quarantine with fresh state")
+            _hb.complete_quarantine()
         return state
 
     def free(self) -> None:
@@ -632,6 +658,179 @@ class _WindowOptimizer(_FusedOptimizer):
 
     def _gossip(self, buffers):  # packed [n, total] buffers -> mixed buffers
         raise NotImplementedError
+
+    # -- elastic rejoin: quarantined state transfer (ISSUE r9) -------------
+    #
+    # A respawned rank attaches with a bumped incarnation (its zombie is
+    # fenced server-side) and lands here from init(): QUARANTINED — visible
+    # in membership, excluded from averaging — until it adopts current
+    # state. The transfer is a striped read of the donor's published packed
+    # window row (the r7 win_get transport, reused as-is) plus the donor
+    # controller's step counter; push-sum overrides `_transfer_rank` with a
+    # cooperative MASS SPLIT so total mass is exactly conserved. Fallback:
+    # the newest local orbax checkpoint (BLUEFOG_CHECKPOINT_DIR); last
+    # resort: fresh parameters with an ERROR log.
+
+    def _step_counter_key(self, pid: int) -> str:
+        return f"bf.opt.{self._prefix}.step.{pid}"
+
+    def _publish_step_counter(self) -> None:
+        """One cheap KV put per gossip step: a future rejoiner adopts the
+        donor controller's counter so local-SGD communication cadence
+        (num_steps_per_communication) stays aligned after the transfer."""
+        try:
+            _cp.client().put(
+                self._step_counter_key(_global_state().process_index),
+                self._counter)
+        except (OSError, RuntimeError):
+            pass
+
+    def _serve_rejoin_requests(self) -> None:
+        """Donor-side hook, run once per membership-epoch change (base
+        strategies transfer one-sidedly — only push-sum needs donor
+        cooperation, see its override)."""
+
+    def _donor_candidates(self, win, rank):
+        """Live-donor candidates for `rank`'s state: its in-neighbors on
+        other controllers, in sorted order (a donor must be remote — this
+        controller's own rows died with the previous incarnation)."""
+        owned = set(win.owned)
+        return [s for s in win.in_neighbors[rank] if s not in owned]
+
+    def _transfer_rank(self, rank: int, donor: int, deadline: float) -> bool:
+        """Adopt `donor`'s published window rows as `rank`'s state —
+        one-sided, under the donor's window mutexes so a concurrent
+        win_update publish cannot tear the read."""
+        from .runtime.native import PeerLostError
+
+        rows = []
+        for nm in self._win_names:
+            win = _windows._get_window(nm)
+            try:
+                with _windows.win_mutex(nm, ranks=[donor]):
+                    row = win.read_published_row(donor)
+            except (PeerLostError, OSError):
+                return False
+            if row is None:
+                return False
+            rows.append(row)
+        for nm, row in zip(self._win_names, rows):
+            _windows._get_window(nm).install_row(rank, row)
+        return True
+
+    def _rejoin_state_transfer(self, state: TrainState) -> TrainState:
+        st = _global_state()
+        win0 = _windows._get_window(self._win_names[0])
+        owned = sorted(win0.owned)
+        timeout = float(os.environ.get("BLUEFOG_CP_QUARANTINE_TIMEOUT",
+                                       "120"))
+        deadline = time.monotonic() + timeout
+        donors: Dict[int, int] = {}
+        for r in owned:
+            for d in self._donor_candidates(win0, r):
+                if self._transfer_rank(r, d, deadline):
+                    donors[r] = d
+                    break
+            if r not in donors:
+                break
+        if len(donors) == len(owned):
+            # adopt the (max) donor-controller step counter so the
+            # communication cadence realigns
+            try:
+                cl = _cp.client()
+                pids = {getattr(st.devices[d], "process_index", 0)
+                        for d in donors.values()}
+                steps = [int(cl.get(self._step_counter_key(p)))
+                         for p in pids]
+                if steps:
+                    self._counter = max(self._counter, max(steps))
+            except (OSError, RuntimeError):
+                pass
+            logger.warning(
+                "rejoin: window state transferred from live in-neighbors "
+                "%s (step counter -> %d)", donors, self._counter)
+            return self._adopt_window_rows(state)
+        restored = self._restore_from_checkpoint(state)
+        if restored is not None:
+            state, step = restored
+            self._counter = int(step)
+            logger.warning(
+                "rejoin: no live in-neighbor served state transfer; "
+                "restored the newest local checkpoint (step %d)", step)
+            return state
+        logger.error(
+            "rejoin: no live donor and no checkpoint "
+            "(BLUEFOG_CHECKPOINT_DIR unset/empty) — continuing from FRESH "
+            "parameters; this rank re-enters averaging with "
+            "initialization-time values")
+        return state
+
+    def _adopt_window_rows(self, state: TrainState) -> TrainState:
+        """Rebuild state.params' owned rows from the windows' current rows
+        (host-side unpack: a one-sided rejoin cannot dispatch a collective
+        unpack program)."""
+        st = _global_state()
+        leaves = jax.tree_util.tree_flatten(state.params)[0]
+        out = list(leaves)
+        for nm, idxs, spec in zip(self._win_names, self._groups,
+                                  self._specs):
+            win = _windows._get_window(nm)
+            rows = {r: _fusion.unpack_row(self._window_row_to_params(win, r),
+                                          spec)
+                    for r in win.owned}
+            for j, i in enumerate(idxs):
+                leaf = leaves[i]
+                shape = tuple(leaf.shape)
+                sh = leaf.sharding
+                per_rank = {r: rows[r][j] for r in rows}
+                if len(per_rank) == shape[0]:
+                    out[i] = jax.device_put(
+                        np.stack([per_rank[r] for r in range(shape[0])]),
+                        sh)
+                else:
+                    shards = [
+                        jax.device_put(per_rank[r][None], st.devices[r])
+                        for r in sorted(per_rank)
+                    ]
+                    out[i] = jax.make_array_from_single_device_arrays(
+                        shape, sh, shards)
+        params = jax.tree_util.tree_unflatten(self._treedef, out)
+        return TrainState(params, state.opt_state, state.model_state)
+
+    def _window_row_to_params(self, win, rank: int) -> np.ndarray:
+        """Window row -> parameter row (identity; push-sum de-biases)."""
+        return win._rows[rank]
+
+    def _restore_from_checkpoint(self, state: TrainState):
+        ckdir = os.environ.get("BLUEFOG_CHECKPOINT_DIR")
+        if not ckdir or not os.path.isdir(ckdir):
+            return None
+        from . import checkpoint as _ckpt
+
+        path = _ckpt.latest_path(ckdir)
+        if path is None:
+            return None
+        try:
+            new_state, step = _ckpt.restore(path, template=state)
+        except Exception as exc:  # noqa: BLE001 — fall through to fresh
+            logger.error("rejoin: checkpoint restore from %s failed (%s)",
+                         path, exc)
+            return None
+        self._reseed_windows(new_state)
+        return new_state, step
+
+    def _reseed_windows(self, state: TrainState) -> None:
+        """Re-publish the windows' owned rows from restored parameters
+        (host-side pack — see _adopt_window_rows for why no jit)."""
+        leaves = jax.tree_util.tree_flatten(state.params)[0]
+        for nm, idxs, spec in zip(self._win_names, self._groups,
+                                  self._specs):
+            win = _windows._get_window(nm)
+            per_leaf_rows = [_windows._owned_rows(leaves[i], win.owned)
+                             for i in idxs]
+            for r in win.owned:
+                win.install_row(r, _fusion.pack_row(
+                    [rows[r] for rows in per_leaf_rows], spec))
 
     def _dead_ranks(self) -> set:
         """Mesh ranks hosted by dead controllers, consulted EVERY gossip
@@ -681,6 +880,12 @@ class _WindowOptimizer(_FusedOptimizer):
             state, metrics = self._local_step(state, batch)
             if not do_comm:
                 return state, metrics
+            if _windows._get_window(self._win_names[0]).hosted:
+                # donor-side rejoin protocol + step-counter publish: one
+                # epoch compare (local mirror) and one KV put per gossip
+                # step — the serve scan itself only runs on epoch change
+                self._serve_rejoin_requests()
+                self._publish_step_counter()
             leaves = jax.tree_util.tree_flatten(state.params)[0]
             # PACK/UNPACK sub-spans: fusion-buffer copy time, the analog
             # of the reference's MEMCPY_IN/OUT_FUSION_BUFFER activities
@@ -740,17 +945,31 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
         self.neighbor_weights = None
 
     def _gossip(self, leaves):
-        # consult the failure detector EVERY step: dead neighbors drop out
-        # of the send and combine tables, weights renormalize over the
-        # live sets, and the survivors keep gossiping on the shrunken graph
+        # consult the failure detector EVERY step (a cheap in-memory set):
+        # dead neighbors drop out of the send and combine tables, weights
+        # renormalize over the live sets, and the survivors keep gossiping
+        # on the shrunken graph. The healed tables themselves are REBUILT
+        # only when membership changes (cached per dead set — the epoch
+        # bump on join/leave/re-admission is what moves it), not re-derived
+        # every step.
         dead = self._dead_ranks()
         dst_weights, self_weight = self.dst_weights, self.self_weight
         neighbor_weights = self.neighbor_weights
         if dead:
             win = _windows._get_window(self._win_names[0])
-            dst_weights = _healed_send_table(win, dead, dst_weights)
-            self_weight, neighbor_weights = _healed_recv_weights(
-                win, dead, self_weight, neighbor_weights)
+            custom = (dst_weights is not None or self_weight is not None
+                      or neighbor_weights is not None)
+            key = ("put", frozenset(dead))
+            cached = None if custom else self._healed_cache.get(key)
+            if cached is None:
+                sw, nw = _healed_recv_weights(win, dead, self_weight,
+                                              neighbor_weights)
+                cached = (_healed_send_table(win, dead, dst_weights), sw, nw)
+                if not custom:
+                    if len(self._healed_cache) > 16:
+                        self._healed_cache.clear()
+                    self._healed_cache[key] = cached
+            dst_weights, self_weight, neighbor_weights = cached
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             # donate_source: the packed fusion buffer is dead after the
@@ -788,22 +1007,33 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
         neighbor_weights = self.neighbor_weights
         if dead:
             win = _windows._get_window(self._win_names[0])
-            # pull only from LIVE sources (a dead peer's published tensor
-            # goes stale, and at re-publish races it could tear mass) and
-            # renormalize the combine over the live in-sets
-            _, live_in = _live_neighbor_sets(win, dead)
-            if src_weights is None:
-                src_weights = {r: {s: 1.0 for s in live_in[r]}
-                               for r in range(win.size)}
-            else:
-                table = _windows._edge_weights(
-                    src_weights, win.in_neighbors, 1.0, "src_weights",
-                    win.size)
-                src_weights = {r: {s: w for s, w in table[r].items()
-                                   if s not in dead}
-                               for r in range(win.size)}
-            self_weight, neighbor_weights = _healed_recv_weights(
-                win, dead, self_weight, neighbor_weights)
+            custom = (src_weights is not None or self_weight is not None
+                      or neighbor_weights is not None)
+            key = ("get", frozenset(dead))
+            cached = None if custom else self._healed_cache.get(key)
+            if cached is None:
+                # pull only from LIVE sources (a dead peer's published
+                # tensor goes stale, and at re-publish races it could tear
+                # mass) and renormalize the combine over the live in-sets
+                _, live_in = _live_neighbor_sets(win, dead)
+                if src_weights is None:
+                    srcw = {r: {s: 1.0 for s in live_in[r]}
+                            for r in range(win.size)}
+                else:
+                    table = _windows._edge_weights(
+                        src_weights, win.in_neighbors, 1.0, "src_weights",
+                        win.size)
+                    srcw = {r: {s: w for s, w in table[r].items()
+                                if s not in dead}
+                            for r in range(win.size)}
+                sw, nw = _healed_recv_weights(win, dead, self_weight,
+                                              neighbor_weights)
+                cached = (srcw, sw, nw)
+                if not custom:
+                    if len(self._healed_cache) > 16:
+                        self._healed_cache.clear()
+                    self._healed_cache[key] = cached
+            src_weights, self_weight, neighbor_weights = cached
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             st.windows[nm].self_value = jnp.asarray(leaf)  # publish
@@ -845,15 +1075,26 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         # Self-healing: dead destinations drop out and mass splits over
         # 1/(live_outdeg+1) instead — still column-stochastic over the
         # live set BY CONSTRUCTION, so push-sum's total mass (and the
-        # de-biasing p mass) stays conserved on the shrunken graph.
+        # de-biasing p mass) stays conserved on the shrunken graph. The
+        # tables are cached per dead set (rebuilt only on membership
+        # change, not re-derived every step).
         dead = self._dead_ranks()
-        out_nbrs = {
-            r: [d for d in topology_util.out_neighbor_ranks(st.topology, r)
-                if d not in dead]
-            for r in range(n)
-        }
-        sw = {r: 1.0 / (len(out_nbrs[r]) + 1) for r in range(n)}
-        dw = {r: {dst: sw[r] for dst in out_nbrs[r]} for r in range(n)}
+        key = frozenset(dead)
+        cached = self._healed_cache.get(key)
+        if cached is None:
+            out_nbrs = {
+                r: [d for d in
+                    topology_util.out_neighbor_ranks(st.topology, r)
+                    if d not in dead]
+                for r in range(n)
+            }
+            sw = {r: 1.0 / (len(out_nbrs[r]) + 1) for r in range(n)}
+            dw = {r: {dst: sw[r] for dst in out_nbrs[r]} for r in range(n)}
+            if len(self._healed_cache) > 16:
+                self._healed_cache.clear()
+            self._healed_cache[key] = (sw, dw)
+        else:
+            sw, dw = cached
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             win = st.windows[nm]
@@ -871,6 +1112,110 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
             out.append(collected / np.asarray(p_new, collected.dtype).reshape(
                 (n,) + (1,) * (collected.ndim - 1)))
         return out
+
+    # -- elastic rejoin with exact mass conservation -----------------------
+    #
+    # A one-sided copy cannot conserve push-sum mass: copying a donor's
+    # (numerator, p) duplicates its mass, and minting fresh p=1 inflates
+    # the total. The rejoiner instead REQUESTS a split: the donor's
+    # controller — at its next step's serve scan, gated on the membership
+    # epoch the rejoiner bumps after posting the request — halves its own
+    # numerator row and p under the rank mutex (exact in IEEE arithmetic),
+    # republishes, and parks the other half under transfer keys the
+    # rejoiner installs. Total mass is bit-exactly unchanged, and both
+    # parties' de-biased parameters x = num/p are the donor's.
+
+    def _window_row_to_params(self, win, rank: int) -> np.ndarray:
+        p = win.host.read_p()[rank]
+        if p <= 0:
+            return win._rows[rank]
+        return (win._rows[rank].astype(np.float64) / p).astype(win.dtype)
+
+    def _transfer_rank(self, rank: int, donor: int, deadline: float) -> bool:
+        cl = _cp.client()
+        for nm in self._win_names:
+            cl.put(f"w.{nm}.msreq.{rank}", donor + 1)
+        # poke the donors' serve scans (they only run on epoch change)
+        _cp.bump_membership_epoch()
+        done_keys = [f"w.{nm}.msdone.{rank}" for nm in self._win_names]
+        # bounded per-donor wait: leave budget for the remaining candidates
+        wait_until = min(deadline, time.monotonic() + max(
+            5.0, (deadline - time.monotonic()) / 2.0))
+        served = False
+        while time.monotonic() < wait_until:
+            try:
+                if all(cl.get(k) for k in done_keys):
+                    served = True
+                    break
+            except OSError:
+                break
+            time.sleep(0.05)
+        if not served:
+            for nm in self._win_names:  # withdraw; try the next donor
+                cl.put(f"w.{nm}.msreq.{rank}", 0)
+            return False
+        for nm in self._win_names:
+            win = _windows._get_window(nm)
+            raw = cl.get_bytes(f"w.{nm}.xfer.{rank}")
+            expect = int(np.prod(win.row_shape, dtype=np.int64)) * \
+                win.dtype.itemsize
+            if len(raw) != expect:
+                return False
+            row = np.frombuffer(raw, win.dtype).reshape(win.row_shape)
+            win.install_row(rank, row)
+            win.host.write_p_entries(
+                {rank: _cp.get_float(cl, f"w.{nm}.xferp.{rank}")})
+            cl.put(f"w.{nm}.msdone.{rank}", 0)
+            cl.put_bytes(f"w.{nm}.xfer.{rank}", b"")
+        return True
+
+    def _serve_rejoin_requests(self) -> None:
+        ep = _hb.membership_epoch()
+        if ep == self._serve_epoch:
+            return
+        self._serve_epoch = ep
+        cl = _cp.client()
+        for nm in self._win_names:
+            win = _windows._get_window(nm)
+            try:
+                reqs = cl.get_many(
+                    [f"w.{nm}.msreq.{r}" for r in range(win.size)])
+            except (OSError, RuntimeError):
+                return
+            for r, req in enumerate(reqs):
+                d = int(req) - 1
+                if req <= 0 or d not in win.owned:
+                    continue
+                with _windows.win_mutex(nm, ranks=[d]), win.state_mu:
+                    # exact split: *0.5 is an exponent decrement — the
+                    # halves sum back to the original bit for bit
+                    half = win._rows[d] * np.asarray(0.5, win.dtype)
+                    p_half = win.host.read_p()[d] * 0.5
+                    win._rows[d] = half
+                    win.host.write_p_entries({d: p_half})
+                    win._publish_selves([d])
+                    cl.put_bytes(f"w.{nm}.xfer.{r}",
+                                 np.ascontiguousarray(half).tobytes())
+                    _cp.put_float(cl, f"w.{nm}.xferp.{r}", p_half)
+                cl.put(f"w.{nm}.msreq.{r}", 0)
+                cl.put(f"w.{nm}.msdone.{r}", 1)
+                logger.warning(
+                    "rejoin: split push-sum mass of owned rank %d with "
+                    "rejoining rank %d (window %s, p -> %g each)",
+                    d, r, nm, p_half)
+
+    def _reseed_windows(self, state: TrainState) -> None:
+        super()._reseed_windows(state)
+        # checkpoint fallback re-mints unit mass for the restored ranks:
+        # exact conservation is only possible via the donor split (the old
+        # incarnation's mass died with it and no donor is reachable)
+        logger.warning(
+            "rejoin: push-sum restored from checkpoint re-mints p=1 for "
+            "its ranks — total mass is NOT conserved on this path (no "
+            "live donor to split with)")
+        for nm in self._win_names:
+            win = _windows._get_window(nm)
+            win.host.write_p_entries({r: 1.0 for r in win.owned})
 
 
 __all__ = [

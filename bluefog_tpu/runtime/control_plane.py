@@ -31,7 +31,8 @@ import time
 from typing import List, Optional
 
 from .logging import logger
-from .native import ControlPlaneClient, ControlPlaneServer
+from .native import (ControlPlaneClient, ControlPlaneServer,
+                     StaleIncarnationError)
 
 _mu = threading.Lock()
 _client: Optional[ControlPlaneClient] = None
@@ -39,11 +40,23 @@ _server: Optional[ControlPlaneServer] = None
 _world: int = 1
 _tried = False
 _conn_params = None  # (host, port, rank, secret) of the live attachment
+_incarnation: int = 0  # incarnation this attachment registered
 
 
 def _env_port(default: Optional[int] = None) -> Optional[int]:
     v = os.environ.get("BLUEFOG_CP_PORT")
     return int(v) if v else default
+
+
+def _env_incarnation() -> int:
+    """BLUEFOG_INCARNATION: this process's membership incarnation (0 on a
+    first launch; bfrun --elastic bumps it on every respawn). Registered
+    with the control-plane server in attach() so a zombie predecessor is
+    fenced the moment this process connects."""
+    try:
+        return max(0, int(os.environ.get("BLUEFOG_INCARNATION", "0") or 0))
+    except ValueError:
+        return 0
 
 
 def _distributed_client_info():
@@ -70,7 +83,7 @@ def attach() -> Optional[ControlPlaneClient]:
     Returns the process-global client, or None when the control plane is
     not configured / disabled / the native runtime is unavailable.
     """
-    global _client, _server, _world, _tried, _conn_params
+    global _client, _server, _world, _tried, _conn_params, _incarnation
     with _mu:
         if _client is not None or _tried:
             return _client
@@ -126,10 +139,19 @@ def attach() -> Optional[ControlPlaneClient]:
         deadline = time.monotonic() + float(
             os.environ.get("BLUEFOG_CP_CONNECT_TIMEOUT", "30"))
         last: Optional[Exception] = None
+        inc = _env_incarnation()
         while time.monotonic() < deadline:
             try:
-                _client = ControlPlaneClient(host, port, rank, secret=secret)
+                _client = ControlPlaneClient(host, port, rank, secret=secret,
+                                             incarnation=inc)
                 break
+            except StaleIncarnationError:
+                # typed, non-retryable: a newer incarnation of this rank is
+                # already attached — this process must not join the job
+                if _server is not None:
+                    _server.stop()
+                    _server = None
+                raise
             except (OSError, RuntimeError) as exc:
                 last = exc
                 time.sleep(0.2)
@@ -156,6 +178,7 @@ def attach() -> Optional[ControlPlaneClient]:
             return None
         _world = world
         _conn_params = (host, port, rank, secret)
+        _incarnation = inc
         if served_cap is not None:
             # Publish the SERVING process's effective mailbox cap under a
             # well-known key (value + 1, so a missing key's 0 is
@@ -194,16 +217,50 @@ def extra_client(streams: Optional[int] = None) -> ControlPlaneClient:
         raise RuntimeError("control plane is not attached")
     host, port, rank, secret = _conn_params
     return ControlPlaneClient(host, port, rank, secret=secret,
-                              streams=streams)
+                              streams=streams, incarnation=_incarnation)
 
 
 def world() -> int:
     return _world
 
 
+def incarnation() -> int:
+    """The incarnation this process registered at attach time (0 for a
+    first launch or when no control plane is attached)."""
+    return _incarnation
+
+
+# Well-known monotonic membership-epoch counter: bumped by the SERVER on
+# every incarnation registration (join) and by heartbeat monitors on dead-set
+# transitions (leave / re-admission). Window optimizers rebuild their healed
+# neighbor tables only when it moves — see runtime/heartbeat.membership_epoch.
+_EPOCH_KEY = "bf.membership.epoch"
+
+
+def membership_epoch_kv() -> int:
+    """Raw read of the shared membership-epoch counter (0 when detached)."""
+    if _client is None:
+        return 0
+    try:
+        return int(_client.get(_EPOCH_KEY))
+    except OSError:
+        return 0
+
+
+def bump_membership_epoch() -> None:
+    """Advance the shared membership epoch (best-effort, idempotent in
+    effect: consumers only compare for change)."""
+    if _client is not None:
+        try:
+            _client.fetch_add(_EPOCH_KEY, 1)
+        except OSError:
+            pass
+
+
 def detach() -> None:
     """Close the client (and server, when owned). Safe to call repeatedly."""
-    global _client, _server, _tried, _world, _conn_params, _cap_cache
+    global _client, _server, _tried, _world, _conn_params, _cap_cache, \
+        _incarnation
     with _mu:
         if _client is not None:
             _client.close()
@@ -215,6 +272,7 @@ def detach() -> None:
         _world = 1
         _conn_params = None
         _cap_cache = None
+        _incarnation = 0
 
 
 def reset_for_test() -> None:

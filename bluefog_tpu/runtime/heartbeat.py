@@ -41,10 +41,30 @@ from .logging import logger
 
 _FLAG = "bf.shutdown.flag."
 _ACK = "bf.shutdown.ack."
+_EPOCH_KEY = "bf.membership.epoch"
+# Per-rank incarnation mirror (written by the server's kAttach handler) and
+# per-(rank, incarnation) quarantine phase: 1 = attached + quarantined
+# (state transfer pending), 2 = transfer complete (eligible for
+# re-admission). See docs/fault_tolerance.md, "Rejoin & fencing".
+_INC = "bf.inc."
+_QUARANTINE = "bf.q."
+_Q_ENTERED = 1
+_Q_COMPLETE = 2
 
 
 class PeerMonitor:
-    """Heartbeat publisher + peer liveness / shutdown-flag watcher."""
+    """Heartbeat publisher + peer liveness / shutdown-flag watcher.
+
+    Elastic membership (r9): a peer whose heartbeat RESUMES after it was
+    declared dead is **not** silently re-admitted — it moves to a
+    ``suspect`` set (logged at ERROR) while staying in the dead set, and
+    only returns to live membership once the control plane shows a NEW
+    incarnation registered for it AND that incarnation's quarantine (state
+    transfer) completed. A flapping peer — same incarnation, stale
+    parameters, stale server-side identity — therefore never rejoins the
+    averaging graph; ``dead_ranks()`` semantics are unchanged for peers
+    that never resume.
+    """
 
     def __init__(self, process_index: int, process_count: int,
                  interval_sec: Optional[float] = None,
@@ -61,6 +81,9 @@ class PeerMonitor:
         self._last_value: Dict[int, int] = {}
         self._last_change: Dict[int, float] = {}
         self._dead: set = set()
+        self._suspect: set = set()       # resumed-but-unfenced peers
+        self._dead_inc: Dict[int, int] = {}  # incarnation at death time
+        self._epoch: int = 0             # membership-epoch mirror
         self._cl = None  # dedicated control-plane connection (see start())
 
     # -- lifecycle ---------------------------------------------------------
@@ -115,7 +138,25 @@ class PeerMonitor:
     def dead_peers(self) -> set:
         return set(self._dead)
 
+    def suspect_peers(self) -> set:
+        """Peers whose heartbeat resumed but whose re-admission gate has
+        not cleared (still counted dead for membership purposes)."""
+        return set(self._suspect)
+
+    @property
+    def membership_epoch(self) -> int:
+        """Locally mirrored shared membership epoch (refreshed per tick and
+        bumped synchronously on local transitions) — readable every gossip
+        step without a server round-trip."""
+        return self._epoch
+
     # -- the loop ----------------------------------------------------------
+
+    def _bump_epoch(self, cl) -> None:
+        try:
+            self._epoch = int(cl.fetch_add(_EPOCH_KEY, 1)) + 1
+        except OSError:
+            self._epoch += 1  # local monotonicity is what consumers need
 
     def _tick(self) -> None:
         cl = self._cl if self._cl is not None else _cp.client()
@@ -128,17 +169,67 @@ class PeerMonitor:
             if v != self._last_value.get(peer):
                 self._last_value[peer] = v
                 self._last_change[peer] = now
-                if peer in self._dead:
-                    self._dead.discard(peer)
-                    logger.warning("controller %d heartbeat resumed", peer)
+                if peer in self._dead and peer not in self._suspect:
+                    # Flapping-peer hole (r9): a raw heartbeat resume alone
+                    # must NEVER shrink the dead set — the peer's parameters
+                    # and server-side identity (dedup tables, mailbox
+                    # deposits, lock holdership) are stale, and silently
+                    # re-admitting it corrupts the average (and push-sum
+                    # mass). It becomes a tracked suspect until the
+                    # re-admission gate below clears it.
+                    self._suspect.add(peer)
+                    self._bump_epoch(cl)
+                    logger.error(
+                        "controller %d heartbeat RESUMED without a new "
+                        "incarnation registration — keeping it out of live "
+                        "membership (suspect) until it re-attaches with a "
+                        "bumped incarnation and completes quarantined state "
+                        "transfer; a flapping peer must not rejoin with "
+                        "stale state (docs/fault_tolerance.md)", peer)
             elif (now - self._last_change.get(peer, now) > self.timeout
                   and peer not in self._dead):
                 self._dead.add(peer)
+                self._suspect.discard(peer)
+                try:
+                    self._dead_inc[peer] = int(cl.get(f"{_INC}{peer}"))
+                except OSError:
+                    self._dead_inc[peer] = 0
+                self._bump_epoch(cl)
                 logger.error(
                     "controller %d heartbeat missing for %.0f s — peer "
                     "failure detected; collectives involving its devices "
                     "will hang (reference analog: missing-rank stall, "
                     "operations.cc:387-432)", peer, self.timeout)
+        # Re-admission gate: a suspect returns to live membership only once
+        # the server shows a NEW incarnation registered for it (it went
+        # through the fenced rejoin path, so its zombie predecessor is cut
+        # off) AND that incarnation finished quarantine — the striped
+        # neighbor state transfer (or checkpoint fallback) completed, so the
+        # values it gossips are current, and for push-sum its mass was
+        # donor-split rather than freshly minted.
+        for peer in sorted(self._suspect):
+            try:
+                inc = int(cl.get(f"{_INC}{peer}"))
+                phase = int(cl.get(f"{_QUARANTINE}{peer}.{inc}")) \
+                    if inc > self._dead_inc.get(peer, 0) else 0
+            except OSError:
+                continue
+            if phase >= _Q_COMPLETE:
+                self._suspect.discard(peer)
+                self._dead.discard(peer)
+                self._dead_inc[peer] = inc
+                self._bump_epoch(cl)
+                logger.warning(
+                    "controller %d re-admitted to live membership: "
+                    "incarnation %d registered and quarantine complete — "
+                    "window optimizers re-include its ranks at their next "
+                    "epoch check", peer, inc)
+        try:
+            shared = int(cl.get(_EPOCH_KEY))
+            if shared > self._epoch:
+                self._epoch = shared
+        except OSError:
+            pass
         if not self._shutdown_seen.is_set() and any(
                 cl.get(f"{_FLAG}{p}") for p in range(self.world)
                 if p != self.me):
@@ -239,6 +330,89 @@ def dead_controllers() -> set:
 
     mon = _global_state().peer_monitor
     return mon.dead_peers() if mon is not None else set()
+
+
+def suspect_controllers() -> set:
+    """Controllers whose heartbeat resumed but which are still fenced out
+    of live membership (see :class:`PeerMonitor`). Subset of
+    :func:`dead_controllers` — membership-wise they are still dead."""
+    from .state import _global_state
+
+    mon = _global_state().peer_monitor
+    return mon.suspect_peers() if mon is not None else set()
+
+
+def membership_epoch() -> int:
+    """Monotonic membership-epoch counter (0 when single-controller).
+
+    Bumped by the control-plane server on every incarnation registration
+    (join/rejoin) and by heartbeat monitors on dead-set transitions
+    (death, suspect, re-admission). Window optimizers compare it per
+    gossip step and rebuild their healed neighbor tables only when it
+    moved — the cheap "did membership change?" probe that replaces
+    re-deriving edge tables every step. With a live monitor the read is a
+    local mirror (no server round-trip)."""
+    from .state import _global_state
+
+    mon = _global_state().peer_monitor
+    if mon is not None:
+        return mon.membership_epoch
+    return _cp.membership_epoch_kv()
+
+
+# -- quarantine state machine (the rejoining process's side) -----------------
+#
+# A respawned rank (BLUEFOG_INCARNATION > 0) is *quarantined* between its
+# fenced attach and the completion of state transfer: it is visible in
+# membership (its incarnation is registered, so its zombie is cut off) but
+# survivors keep its ranks out of averaging until `complete_quarantine`
+# publishes phase 2 — the re-admission gate PeerMonitor._tick checks.
+
+_q_state = {"pending": False, "pid": 0, "inc": 0}
+
+
+def quarantine_pending() -> bool:
+    """True between this process's quarantined attach and the completion of
+    its state transfer (always False for incarnation-0 launches)."""
+    return _q_state["pending"]
+
+
+def enter_quarantine(process_index: int) -> None:
+    """Mark this process quarantined (called by ``bf.init`` when attaching
+    with a bumped incarnation). Publishes phase 1 under the per-(rank,
+    incarnation) key so survivors can observe the rejoin in progress."""
+    inc = _cp.incarnation()
+    if not _cp.active() or inc <= 0:
+        _q_state["pending"] = False
+        return
+    _q_state.update(pending=True, pid=process_index, inc=inc)
+    try:
+        _cp.client().put(f"{_QUARANTINE}{process_index}.{inc}", _Q_ENTERED)
+    except OSError as exc:
+        logger.warning("quarantine entry publish failed (%s)", exc)
+    logger.warning(
+        "rejoining as incarnation %d: QUARANTINED until state transfer "
+        "completes — this rank is registered (zombie fenced) but excluded "
+        "from averaging", inc)
+
+
+def complete_quarantine() -> None:
+    """Publish quarantine completion (phase 2) and bump the membership
+    epoch so survivors' monitors re-admit this rank. Idempotent."""
+    if not _q_state["pending"]:
+        return
+    _q_state["pending"] = False
+    try:
+        cl = _cp.client()
+        cl.put(f"{_QUARANTINE}{_q_state['pid']}.{_q_state['inc']}",
+               _Q_COMPLETE)
+        _cp.bump_membership_epoch()
+    except (OSError, RuntimeError) as exc:
+        logger.warning("quarantine completion publish failed (%s)", exc)
+        return
+    logger.warning(
+        "quarantine complete: state transfer finished; survivors will "
+        "re-admit this rank at their next heartbeat tick")
 
 
 def dead_ranks() -> set:

@@ -65,9 +65,25 @@ def _peer_lost(message: str) -> PeerLostError:
     return PeerLostError(message, dead=_dead_controller_set())
 
 
+class StaleIncarnationError(RuntimeError):
+    """This client's (rank, incarnation) registration was superseded.
+
+    Raised when the control-plane server fences a request because the same
+    rank re-registered with a NEWER incarnation — this process is a zombie
+    of a restarted rank (its replacement is already attached). The server
+    has garbage-collected this incarnation's dedup records, mailbox
+    deposits, and lock holdings; nothing this process does can reach shared
+    state again, so the only correct reaction is to exit. Never retried by
+    the transport (unlike a wire failure). See docs/fault_tolerance.md,
+    "Rejoin & fencing".
+    """
+
+
 # Status codes shared with csrc/bf_runtime.cc: -1 wire failure, -2 mailbox
-# byte cap, -3 dead holder / deadline on a blocking primitive.
+# byte cap, -3 dead holder / deadline on a blocking primitive, -4 stale
+# incarnation (fenced zombie).
 _DEAD_HOLDER = -3
+_STALE = -4
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -172,6 +188,17 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.bf_cp_disconnect.restype = None
     lib.bf_cp_disconnect.argtypes = [ctypes.c_void_p]
+    # incarnation fencing (r9 elastic membership)
+    lib.bf_cp_attach.restype = ctypes.c_int64
+    lib.bf_cp_attach.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.bf_cp_is_stale.restype = ctypes.c_int
+    lib.bf_cp_is_stale.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_server_dedup_entries.restype = ctypes.c_longlong
+    lib.bf_cp_server_dedup_entries.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_server_mailbox_from.restype = ctypes.c_longlong
+    lib.bf_cp_server_mailbox_from.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bf_cp_server_incarnation.restype = ctypes.c_longlong
+    lib.bf_cp_server_incarnation.argtypes = [ctypes.c_void_p, ctypes.c_int]
     # fault injection + dead-connection hooks (r8 fault tolerance)
     lib.bf_cp_fault.restype = None
     lib.bf_cp_fault.argtypes = [ctypes.c_longlong, ctypes.c_int,
@@ -484,6 +511,20 @@ class ControlPlaneServer:
         if self._h:
             self._lib.bf_cp_server_drop_conns(self._h)
 
+    # -- introspection (chaos tests assert incarnation GC left nothing) ----
+
+    def dedup_entries(self) -> int:
+        """Server-side op-seq dedup table size (all clients)."""
+        return int(self._lib.bf_cp_server_dedup_entries(self._h))
+
+    def mailbox_records_from(self, origin: int) -> int:
+        """Queued mailbox records whose deposit tag names ``origin``."""
+        return int(self._lib.bf_cp_server_mailbox_from(self._h, origin))
+
+    def incarnation_of(self, rank: int) -> int:
+        """Registered incarnation of ``rank`` (-1 = never attached)."""
+        return int(self._lib.bf_cp_server_incarnation(self._h, rank))
+
     def __enter__(self):
         return self
 
@@ -507,7 +548,8 @@ class ControlPlaneClient:
 
     def __init__(self, host: str, port: int, rank: int,
                  secret: str = "", streams: Optional[int] = None,
-                 sockbuf_bytes: Optional[int] = None) -> None:
+                 sockbuf_bytes: Optional[int] = None,
+                 incarnation: Optional[int] = None) -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native runtime unavailable")
@@ -520,12 +562,64 @@ class ControlPlaneClient:
         self._stripe_min = _env_stripe_min_bytes()
         self._extra: list = []       # lazily-opened pool connections
         self._pool_mu = threading.Lock()
+        # Incarnation fencing: None keeps the legacy unfenced wire (tests,
+        # external actors). A registered client — every pool connection
+        # included, re-registered on every transparent reconnect — is
+        # rejected server-side once its rank attaches with a newer
+        # incarnation, surfacing StaleIncarnationError instead of corrupting
+        # shared state as a zombie.
+        self.incarnation = None if incarnation is None else int(incarnation)
         self._h = lib.bf_cp_connect_auth2(host.encode(), port, rank,
                                           secret.encode(), self._sockbuf)
         if not self._h:
             raise OSError(
                 f"control plane connect to {host}:{port} failed"
                 + (" (authentication handshake rejected?)" if secret else ""))
+        if self.incarnation is not None:
+            self._register(self._h)
+
+    # -- incarnation fencing -----------------------------------------------
+
+    def _stale_message(self) -> str:
+        host, port, rank, _ = self._conn
+        return (
+            f"control plane rank {rank} (incarnation {self.incarnation}) "
+            f"was superseded at {host}:{port}: a newer incarnation of this "
+            "rank has attached, so this process is a fenced zombie — its "
+            "dedup records, queued deposits, and lock holdings were "
+            "garbage-collected server-side. Exit instead of retrying; a "
+            "legitimate restart must attach with BLUEFOG_INCARNATION "
+            "bumped (bfrun --elastic does this automatically).")
+
+    def _register(self, handle) -> None:
+        r = self._lib.bf_cp_attach(handle, self.incarnation)
+        if r == _STALE:
+            raise StaleIncarnationError(self._stale_message())
+        if r < 0:
+            raise OSError("control plane incarnation registration failed "
+                          "(connection lost or not authenticated)")
+
+    def _any_stale(self) -> bool:
+        if self.incarnation is None:
+            return False
+        for h in [self._h] + list(self._extra):
+            if h and self._lib.bf_cp_is_stale(h):
+                return True
+        return False
+
+    def _check_stale(self, r: int) -> None:
+        """Raise typed when a -4 status is the fence verdict (the native
+        layer latches a per-connection flag, so a genuine -4 scalar value
+        read from the KV can never be mistaken for it)."""
+        if r == _STALE and self._any_stale():
+            raise StaleIncarnationError(self._stale_message())
+
+    def _wire_error(self, message: str):
+        """Map a failed native call to the right exception: typed fence
+        verdict when the connection was superseded, plain OSError else."""
+        if self._any_stale():
+            raise StaleIncarnationError(self._stale_message())
+        raise OSError(message)
 
     # -- striped connection pool -------------------------------------------
 
@@ -550,6 +644,12 @@ class ControlPlaneClient:
                         len(self._extra) + 2, self.streams, host, port)
                     self.streams = len(self._extra) + 1
                     break
+                if self.incarnation is not None:
+                    try:
+                        self._register(h)
+                    except BaseException:
+                        self._lib.bf_cp_disconnect(h)
+                        raise
                 self._extra.append(h)
             return [self._h] + list(self._extra)
 
@@ -560,6 +660,7 @@ class ControlPlaneClient:
 
     def barrier(self, name: str = "default") -> int:
         r = self._lib.bf_cp_barrier(self._h, name.encode())
+        self._check_stale(r)
         if r == _DEAD_HOLDER:
             raise _peer_lost(
                 f"barrier '{name}' abandoned: a participant never arrived "
@@ -572,6 +673,7 @@ class ControlPlaneClient:
 
     def lock(self, name: str) -> None:
         r = self._lib.bf_cp_lock(self._h, name.encode())
+        self._check_stale(r)
         if r == _DEAD_HOLDER:
             # the lock was left FREE: after handling the error a fresh
             # acquire succeeds — see docs/fault_tolerance.md
@@ -585,6 +687,7 @@ class ControlPlaneClient:
 
     def unlock(self, name: str) -> None:
         r = self._lib.bf_cp_unlock(self._h, name.encode())
+        self._check_stale(r)
         if r == _DEAD_HOLDER:
             raise _peer_lost(
                 f"unlock '{name}': this client no longer held the lock — "
@@ -597,15 +700,21 @@ class ControlPlaneClient:
     def fetch_add(self, name: str, delta: int = 1) -> int:
         """Atomic fetch-then-add; returns the pre-add value
         (MPI_Fetch_and_op semantics, mpi_controller.cc:1532-1602)."""
-        return self._lib.bf_cp_fetch_add(self._h, name.encode(), delta)
+        r = self._lib.bf_cp_fetch_add(self._h, name.encode(), delta)
+        self._check_stale(r)
+        return r
 
     def put(self, name: str, value: int) -> None:
-        if self._lib.bf_cp_put(self._h, name.encode(), value) < 0:
+        r = self._lib.bf_cp_put(self._h, name.encode(), value)
+        self._check_stale(r)
+        if r < 0:
             raise OSError("control plane put failed (connection lost "
                           "or not authenticated)")
 
     def get(self, name: str) -> int:
-        return self._lib.bf_cp_get(self._h, name.encode())
+        r = self._lib.bf_cp_get(self._h, name.encode())
+        self._check_stale(r)
+        return r
 
     # -- pipelined batches --------------------------------------------------
 
@@ -619,7 +728,7 @@ class ControlPlaneClient:
         r = self._lib.bf_cp_multi(self._h, 6, "\n".join(names).encode(),
                                   None, out, n)
         if r < 0:
-            raise OSError("control plane get_many failed")
+            self._wire_error("control plane get_many failed")
         return list(out)
 
     def put_many(self, names, values) -> None:
@@ -631,7 +740,7 @@ class ControlPlaneClient:
         args = (ctypes.c_int64 * n)(*[int(v) for v in values])
         if self._lib.bf_cp_multi(self._h, 5, "\n".join(names).encode(),
                                  args, None, n) < 0:
-            raise OSError("control plane put_many failed")
+            self._wire_error("control plane put_many failed")
 
     def fetch_add_many(self, names, deltas=None) -> list:
         """Batched fetch_add (default delta 1 each): pre-add values, one
@@ -645,7 +754,7 @@ class ControlPlaneClient:
         out = (ctypes.c_int64 * n)()
         if self._lib.bf_cp_multi(self._h, 4, "\n".join(names).encode(),
                                  args, out, n) < 0:
-            raise OSError("control plane fetch_add_many failed")
+            self._wire_error("control plane fetch_add_many failed")
         return list(out)
 
     # -- bulk bytes: the host tensor transport for one-sided windows --------
@@ -668,6 +777,7 @@ class ControlPlaneClient:
         self._check_payload("append_bytes", data)
         r = self._lib.bf_cp_append_bytes(self._h, name.encode(), data,
                                          len(data))
+        self._check_stale(r)
         if r == -2:
             raise RuntimeError(
                 f"control plane mailbox '{name}' is full (server byte cap, "
@@ -686,7 +796,7 @@ class ControlPlaneClient:
                                        ctypes.byref(out),
                                        ctypes.byref(out_len))
         if r < 0:
-            raise OSError("control plane take_bytes failed")
+            self._wire_error("control plane take_bytes failed")
         try:
             payload = ctypes.string_at(out.value, out_len.value) \
                 if out_len.value else b""
@@ -762,9 +872,12 @@ class ControlPlaneClient:
                 handle, op, "\n".join(names).encode(), ptrs, lens,
                 tag_arr, out, n)
         if r < 0:
-            raise OSError("control plane bytes batch failed (connection "
-                          "lost or not authenticated)")
-        return list(out)
+            self._wire_error("control plane bytes batch failed (connection "
+                             "lost or not authenticated)")
+        out = list(out)
+        if _STALE in out:
+            self._check_stale(_STALE)
+        return out
 
     def _bytes_multi_in_raw(self, op: int, names,
                             handle=None) -> NativeReply:
@@ -777,8 +890,8 @@ class ControlPlaneClient:
                 self._h if handle is None else handle, op,
                 "\n".join(names).encode(), n,
                 ctypes.byref(out), ctypes.byref(out_len)) < 0:
-            raise OSError("control plane bytes batch failed (connection "
-                          "lost or not authenticated)")
+            self._wire_error("control plane bytes batch failed (connection "
+                             "lost or not authenticated)")
         return NativeReply(self._lib, out, out_len.value)
 
     def _bytes_multi_in(self, op: int, names) -> list:
@@ -873,6 +986,7 @@ class ControlPlaneClient:
                     self._OP_PUT_BYTES, [names[i] for i in small_idx],
                     [blobs[i] for i in small_idx]):
                 if r < 0:
+                    self._check_stale(r)
                     raise OSError("control plane put_bytes_many failed")
 
     def _put_bytes_striped(self, name: str, blob) -> None:
@@ -900,8 +1014,8 @@ class ControlPlaneClient:
                                               ptr, nbytes)
         del keep
         if r < 0:
-            raise OSError("control plane striped put_bytes failed "
-                          "(connection lost or not authenticated)")
+            self._wire_error("control plane striped put_bytes failed "
+                             "(connection lost or not authenticated)")
 
     @staticmethod
     def _parse_take_reply(payload) -> list:
@@ -993,7 +1107,7 @@ class ControlPlaneClient:
         out = (ctypes.c_int64 * n)()
         if self._lib.bf_cp_multi(self._h, 12, "\n".join(names).encode(),
                                  None, out, n) < 0:
-            raise OSError("control plane box_bytes_many failed")
+            self._wire_error("control plane box_bytes_many failed")
         return list(out)
 
     def put_bytes(self, name: str, data: bytes) -> None:
@@ -1006,13 +1120,13 @@ class ControlPlaneClient:
         self._check_payload("put_bytes", data)
         if self._lib.bf_cp_put_bytes(self._h, name.encode(), data,
                                      len(data)) < 0:
-            raise OSError("control plane put_bytes failed")
+            self._wire_error("control plane put_bytes failed")
 
     def bytes_len(self, name: str) -> int:
         """Current byte length of the named bytes slot (0 when never put)."""
         r = self._lib.bf_cp_bytes_len(self._h, name.encode())
         if r < 0:
-            raise OSError("control plane bytes_len failed")
+            self._wire_error("control plane bytes_len failed")
         return int(r)
 
     def get_bytes_view(self, name: str):
@@ -1029,8 +1143,9 @@ class ControlPlaneClient:
                 if self._lib.bf_cp_get_bytes_striped(
                         arr, nh, name.encode(), ctypes.byref(out),
                         ctypes.byref(out_len)) < 0:
-                    raise OSError("control plane striped get_bytes failed "
-                                  "(connection lost or value churning)")
+                    self._wire_error("control plane striped get_bytes "
+                                     "failed (connection lost or value "
+                                     "churning)")
                 owner = NativeReply(self._lib, out, out_len.value)
                 return owner.view, owner
         owner = self._bytes_multi_in_raw(self._OP_GET_BYTES, [name])
@@ -1052,7 +1167,7 @@ class ControlPlaneClient:
                                       ctypes.byref(out),
                                       ctypes.byref(out_len))
         if r < 0:
-            raise OSError("control plane get_bytes failed")
+            self._wire_error("control plane get_bytes failed")
         try:
             return ctypes.string_at(out.value, out_len.value) \
                 if out_len.value else b""

@@ -201,6 +201,15 @@ def init(
             ("machine", "local"),
         )
     st.local_rank = _compute_local_rank()
+    # Elastic rejoin: a respawned rank (BLUEFOG_INCARNATION > 0, exported
+    # by bfrun --elastic) attached with a bumped incarnation above — the
+    # server fenced its zombie predecessor and GC'd its state. It now
+    # enters QUARANTINE: registered in membership but excluded from
+    # averaging until a window optimizer completes state transfer
+    # (runtime/heartbeat.py, docs/fault_tolerance.md "Rejoin & fencing").
+    from .heartbeat import enter_quarantine
+
+    enter_quarantine(st.process_index)
     st.skip_negotiate = st.config.skip_negotiate
     st.windows = {}
     st.win_ops_with_associated_p = False
@@ -354,7 +363,11 @@ def _compute_local_rank() -> int:
     me = st.process_index
     h = zlib.crc32(socket.gethostname().encode())
     cl.put(f"bf.host.{me}", h)
-    cl.barrier("bf.local_rank")
+    if _cp.incarnation() == 0:
+        cl.barrier("bf.local_rank")
+    # A rejoining incarnation must NOT barrier: the surviving peers are deep
+    # in their training loops and would never arrive — their host keys from
+    # the original launch are already published, which is all we read.
     return sum(
         1 for i in range(st.process_count)
         if i < me and cl.get(f"bf.host.{i}") == h

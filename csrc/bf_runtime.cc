@@ -199,6 +199,20 @@ enum Op : uint8_t {
   //     learns the range to fan out before issuing kGetBytesPart reads).
   //   kGetBytesPart: arg = (offset << 32) | len; bulk reply = that slice.
   kPutBytesPart = 14, kBytesLen = 15, kGetBytesPart = 16,
+  // Incarnation registration (r9, elastic membership): key = 8 raw bytes of
+  // the client's dedup id, arg = the process's incarnation number
+  // (BLUEFOG_INCARNATION; a respawned rank attaches with the previous value
+  // + 1). The server keeps a per-rank incarnation table: a registration
+  // BELOW the table value is rejected with kStaleIncarnationReply (the
+  // caller is a zombie of a restarted rank), a registration ABOVE it bumps
+  // the table, garbage-collects the dead incarnation's server state (op-seq
+  // dedup records, its origin-tagged mailbox records, any locks it held —
+  // reusing the force-release epoch-bump path), and advances the
+  // well-known membership-epoch counter. Every op on a registered
+  // connection is fenced: once the rank's incarnation moves past the
+  // connection's, the op is answered with the 4-byte kStaleFrame sentinel
+  // instead of being applied.
+  kAttach = 18,
   // Op-sequence preamble (r8, fault tolerance): a reply-less annotation the
   // client writes immediately before a NON-IDEMPOTENT op (or pipelined
   // batch): key = 8 raw bytes of the client's stable id, arg = batch
@@ -216,6 +230,14 @@ enum Op : uint8_t {
 // lease expired) or whose bounded wait hit its deadline; Python surfaces it
 // as PeerLostError instead of hanging forever.
 constexpr int64_t kDeadHolderReply = -3;
+// A request from a superseded incarnation (see kAttach). Int-reply ops can
+// carry it in-band; ops with bulk replies are answered with the 4-byte
+// kStaleFrame length sentinel instead (no payload follows), which is
+// unambiguous on the wire: real replies are bounded by kMaxMsg (1 GiB).
+// Python surfaces either as bf.StaleIncarnationError — typed and
+// non-retryable, unlike a wire failure.
+constexpr int64_t kStaleIncarnationReply = -4;
+constexpr uint32_t kStaleFrame = 0xFFFFFFFEu;
 
 double EnvSeconds(const char* name, double dflt) {
   const char* v = std::getenv(name);
@@ -481,6 +503,15 @@ struct ControlServer {
   std::map<std::string, PutStaging> put_staging;            // striped puts
   std::map<std::string, LockInfo> locks;
   std::map<uint64_t, DedupEntry> dedup;            // client id -> last batch
+  // Elastic-membership fencing (kAttach): authoritative per-rank
+  // incarnation, the dedup client ids each rank's CURRENT incarnation
+  // registered (cleared on bump so a zombie's dedup state cannot outlive
+  // it), and a per-record origin tag mirror of every mailbox (the 7-bit
+  // origin field of kAppendBytesTagged tags; -1 for untagged records) so an
+  // incarnation bump can drop the dead incarnation's still-queued deposits.
+  std::map<int, int64_t> incarnations;
+  std::map<int, std::vector<uint64_t>> rank_cids;
+  std::map<std::string, std::vector<int8_t>> mailbox_origin;
   std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
   std::map<std::string, int> barrier_count;
 
@@ -507,6 +538,66 @@ struct ControlServer {
         it.second.fd = -1;
         ++it.second.epoch;
         released = true;
+      }
+    }
+    if (released) cv.notify_all();
+  }
+
+  // Garbage-collect everything the dead incarnation of `rank` could still
+  // corrupt the job with (caller holds mu): its held locks force-release
+  // (same epoch-bump wake as a connection close), its dedup batches are
+  // erased (a zombie's recorded replies must not be replayed to the new
+  // incarnation, and the table must not grow under restart churn), and its
+  // origin-tagged mailbox records — deposits of STALE parameters the owner
+  // never drained — are dropped with their byte accounting.
+  void GcIncarnationLocked(int rank) {
+    bool released = false;
+    for (auto& it : locks) {
+      if (it.second.rank == rank) {
+        it.second.rank = -1;
+        it.second.fd = -1;
+        ++it.second.epoch;
+        released = true;
+      }
+    }
+    auto rc = rank_cids.find(rank);
+    if (rc != rank_cids.end()) {
+      for (uint64_t cid : rc->second) dedup.erase(cid);
+      rc->second.clear();
+    }
+    const int8_t origin = static_cast<int8_t>(rank & 0x7F);
+    for (auto it = mailbox.begin(); it != mailbox.end();) {
+      auto oi = mailbox_origin.find(it->first);
+      auto& box = it->second;
+      if (oi == mailbox_origin.end() || oi->second.size() != box.size()) {
+        ++it;  // defensive: never drop records we cannot attribute
+        continue;
+      }
+      auto& ov = oi->second;
+      int64_t removed = 0;
+      size_t w = 0;
+      for (size_t i = 0; i < box.size(); ++i) {
+        if (ov[i] == origin) {
+          removed += static_cast<int64_t>(box[i].size());
+          continue;
+        }
+        if (w != i) {
+          box[w] = std::move(box[i]);
+          ov[w] = ov[i];
+        }
+        ++w;
+      }
+      if (removed) {
+        box.resize(w);
+        ov.resize(w);
+        box_bytes[it->first] -= removed;
+      }
+      if (box.empty()) {
+        box_bytes.erase(it->first);
+        mailbox_origin.erase(oi);
+        it = mailbox.erase(it);
+      } else {
+        ++it;
       }
     }
     if (released) cv.notify_all();
@@ -550,6 +641,10 @@ struct ControlServer {
     // index `ded_idx` (see DedupEntry).
     uint64_t ded_cid = 0, ded_seq = 0;
     uint32_t ded_left = 0, ded_idx = 0;
+    // incarnation this connection registered via kAttach (< 0: unfenced —
+    // legacy clients keep working; fencing is opt-in per connection)
+    int conn_rank = -1;
+    int64_t conn_inc = -1;
     for (;;) {
       uint32_t len;
       if (!ReadAll(fd, &len, 4)) return;
@@ -571,6 +666,72 @@ struct ControlServer {
       bool quit = false;
       bool replied = false;
       bool conn_abort = false;
+
+      // Incarnation fence: once this connection's registered incarnation is
+      // superseded, NO op is applied — every request is answered with the
+      // stale sentinel (reply-less kSeqPre is silently dropped, and any
+      // armed dedup batch is disarmed: the zombie raises, it never retries).
+      if (conn_inc >= 0) {
+        bool is_stale;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = incarnations.find(conn_rank);
+          is_stale = it != incarnations.end() && it->second > conn_inc;
+        }
+        if (is_stale) {
+          ded_left = 0;
+          if (op == kSeqPre) continue;
+          uint32_t f = kStaleFrame;
+          if (!WriteAll(fd, &f, 4)) return;
+          continue;
+        }
+      }
+
+      if (op == kAttach) {
+        // Register (rank, incarnation) for this connection. Replies the
+        // rank's table value, or kStaleIncarnationReply for a zombie. A
+        // bump GCs the dead incarnation's state, mirrors the new value
+        // into the KV (bf.inc.<rank> — readable by the Python heartbeat
+        // re-admission gate without a new query op), and advances the
+        // membership epoch so optimizers rebuild their neighbor tables.
+        bool stale_attach = false;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = incarnations.find(rank);
+          if (it != incarnations.end() && arg < it->second) {
+            stale_attach = true;
+            reply = kStaleIncarnationReply;
+          } else {
+            bool joined = it == incarnations.end() || arg > it->second;
+            if (it == incarnations.end()) {
+              incarnations[rank] = arg;
+            } else if (arg > it->second) {
+              GcIncarnationLocked(rank);
+              it->second = arg;
+            }
+            if (klen == 8) {
+              uint64_t cid;
+              std::memcpy(&cid, key.data(), 8);
+              rank_cids[rank].push_back(cid);
+            }
+            conn_rank = rank;
+            conn_inc = arg;
+            kv["bf.inc." + std::to_string(rank)] = arg;
+            if (joined) {
+              ++kv["bf.membership.epoch"];
+              cv.notify_all();
+            }
+            reply = incarnations[rank];
+          }
+        }
+        (void)stale_attach;
+        uint32_t rlen = 8;
+        char outb[12];
+        std::memcpy(outb, &rlen, 4);
+        std::memcpy(outb + 4, &reply, 8);
+        if (!WriteAll(fd, outb, 12)) return;
+        continue;
+      }
 
       if (op == kSeqPre) {
         // reply-less annotation: arm dedup for the following `count` ops
@@ -824,6 +985,13 @@ struct ControlServer {
             break;
           }
           box.emplace_back(std::move(rec));
+          // Origin mirror for incarnation GC: tagged records carry the
+          // 7-bit origin process id in tag bits 56..62; untagged are -1.
+          mailbox_origin[key].push_back(
+              op == kAppendBytesTagged
+                  ? static_cast<int8_t>((static_cast<uint64_t>(arg) >> 56) &
+                                        0x7F)
+                  : static_cast<int8_t>(-1));
           bytes += static_cast<int64_t>(dlen + extra);
           reply = static_cast<int64_t>(box.size());
           break;
@@ -848,10 +1016,15 @@ struct ControlServer {
                 records.swap(box);
                 mailbox.erase(it);
                 box_bytes.erase(key);
+                mailbox_origin.erase(key);
               } else {
                 records.assign(std::make_move_iterator(box.begin()),
                                std::make_move_iterator(box.begin() + i));
                 box.erase(box.begin(), box.begin() + i);
+                auto oi = mailbox_origin.find(key);
+                if (oi != mailbox_origin.end() && oi->second.size() >= i)
+                  oi->second.erase(oi->second.begin(),
+                                   oi->second.begin() + i);
                 int64_t taken = 0;
                 for (const auto& r : records)
                   taken += static_cast<int64_t>(r.size());
@@ -1134,6 +1307,30 @@ struct ControlClient {
   uint64_t next_seq = 1;  // batch sequence counter (guarded by mu)
   int retries = 3;        // BLUEFOG_CP_RETRIES (0 disables reconnects)
   int backoff_ms = 50;    // BLUEFOG_CP_BACKOFF_MS, doubling, capped at 2 s
+  // Incarnation fencing (kAttach): < 0 = unfenced. Once the server marks
+  // this client stale (its rank re-registered with a newer incarnation),
+  // every op fails fast with kStaleIncarnationReply instead of retrying —
+  // a zombie must stop touching shared state, not reconnect harder.
+  int64_t incarnation = -1;
+  bool stale = false;  // guarded by mu
+
+  // Register (rank, incarnation) on the CURRENT connection (caller holds
+  // mu). Returns 1 on success, kStaleIncarnationReply when superseded
+  // (also latches `stale`), -1 on wire failure, 0 when unfenced.
+  int64_t SendAttach() {
+    if (incarnation < 0) return 0;
+    std::vector<char> buf;
+    std::string key(reinterpret_cast<const char*>(&cid), 8);
+    Encode(&buf, kAttach, key, incarnation);
+    if (!ControlServer::WriteAll(fd, buf.data(), buf.size())) return -1;
+    int64_t reply;
+    if (!ReadReply(&reply)) return -1;
+    if (reply == kStaleIncarnationReply) {
+      stale = true;
+      return kStaleIncarnationReply;
+    }
+    return 1;
+  }
 
   // Ops whose effect must be applied exactly once: a retry after a lost
   // reply goes out under a kSeqPre annotation so the server can replay the
@@ -1234,6 +1431,13 @@ struct ControlClient {
   bool ReadReply(int64_t* reply) {
     uint32_t rlen;
     if (!ControlServer::ReadAll(fd, &rlen, 4)) return false;
+    if (rlen == kStaleFrame) {
+      // fenced: the server refused the op (no payload follows). Latch the
+      // flag so every later op fails fast without touching the wire.
+      stale = true;
+      *reply = kStaleIncarnationReply;
+      return true;
+    }
     if (rlen != 8) return false;
     return ControlServer::ReadAll(fd, reply, 8);
   }
@@ -1241,6 +1445,7 @@ struct ControlClient {
   int64_t Call(uint8_t op, const std::string& key, int64_t arg,
                const void* data = nullptr, size_t dlen = 0) {
     std::lock_guard<std::mutex> lk(mu);
+    if (stale) return kStaleIncarnationReply;
     const uint64_t seq = AllocSeq(op);
     for (int attempt = 0;; ++attempt) {
       std::vector<char> buf;
@@ -1251,7 +1456,8 @@ struct ControlClient {
         int64_t reply;
         if (ReadReply(&reply)) return reply;
       }
-      if (attempt >= retries || !Reconnect(attempt)) return -1;
+      if (attempt >= retries || !Reconnect(attempt))
+        return stale ? kStaleIncarnationReply : -1;
     }
   }
 
@@ -1262,6 +1468,7 @@ struct ControlClient {
   int64_t CallBytes(uint8_t op, const std::string& key, void** out,
                     int64_t* out_len) {
     std::lock_guard<std::mutex> lk(mu);
+    if (stale) return kStaleIncarnationReply;
     const uint64_t seq = AllocSeq(op);
     for (int attempt = 0;; ++attempt) {
       std::vector<char> buf;
@@ -1270,7 +1477,12 @@ struct ControlClient {
       if (SendFault(buf, FaultNext())) {
         FaultDelay();
         uint32_t rlen;
-        if (ControlServer::ReadAll(fd, &rlen, 4) && rlen <= kMaxMsg) {
+        bool got = ControlServer::ReadAll(fd, &rlen, 4);
+        if (got && rlen == kStaleFrame) {
+          stale = true;
+          return kStaleIncarnationReply;
+        }
+        if (got && rlen <= kMaxMsg) {
           char* payload = static_cast<char*>(std::malloc(rlen ? rlen : 1));
           if (!payload) return -1;
           if (!rlen || ControlServer::ReadAll(fd, payload, rlen)) {
@@ -1281,7 +1493,8 @@ struct ControlClient {
           std::free(payload);
         }
       }
-      if (attempt >= retries || !Reconnect(attempt)) return -1;
+      if (attempt >= retries || !Reconnect(attempt))
+        return stale ? kStaleIncarnationReply : -1;
     }
   }
 
@@ -1293,6 +1506,7 @@ struct ControlClient {
   int64_t CallBytesInto(uint8_t op, const std::string& key, int64_t arg,
                         void* dst, size_t cap) {
     std::lock_guard<std::mutex> lk(mu);
+    if (stale) return kStaleIncarnationReply;
     for (int attempt = 0;; ++attempt) {
       std::vector<char> buf;
       Encode(&buf, op, key, arg);
@@ -1300,11 +1514,16 @@ struct ControlClient {
         FaultDelay();
         uint32_t rlen;
         if (ControlServer::ReadAll(fd, &rlen, 4)) {
+          if (rlen == kStaleFrame) {
+            stale = true;
+            return kStaleIncarnationReply;
+          }
           if (rlen > cap) return -1;  // oversized: a real protocol error
           if (!rlen || ControlServer::ReadAll(fd, dst, rlen)) return rlen;
         }
       }
-      if (attempt >= retries || !Reconnect(attempt)) return -1;
+      if (attempt >= retries || !Reconnect(attempt))
+        return stale ? kStaleIncarnationReply : -1;
     }
   }
 
@@ -1332,6 +1551,7 @@ struct ControlClient {
                              const void* const* datas, const int64_t* lens,
                              const int64_t* args, int64_t* out, int n) {
     std::lock_guard<std::mutex> lk(mu);
+    if (stale) return kStaleIncarnationReply;
     // One dedup seq covers the WHOLE batch (count = n): on a wire failure
     // the entire batch is resent under the same seq, the server replays
     // the already-applied prefix from its recording, and only the
@@ -1402,7 +1622,8 @@ struct ControlClient {
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
-      if (a >= retries || !Reconnect(a)) return -1;
+      if (a >= retries || !Reconnect(a))
+        return stale ? kStaleIncarnationReply : -1;
     }
   }
 
@@ -1412,6 +1633,7 @@ struct ControlClient {
   int64_t CallBytesMultiIn(uint8_t op, const char* keys_nl, int n, void** out,
                            int64_t* out_len) {
     std::lock_guard<std::mutex> lk(mu);
+    if (stale) return kStaleIncarnationReply;
     const uint64_t seq = AllocSeq(op);  // multi-take: batch-level dedup
     auto attempt = [&](int fault) -> bool {
       std::vector<char> buf;
@@ -1433,7 +1655,18 @@ struct ControlClient {
       if (!payload) return false;
       for (int i = 0; i < n; ++i) {
         uint32_t rlen;
-        if (!ControlServer::ReadAll(fd, &rlen, 4) || rlen > kMaxMsg) {
+        if (!ControlServer::ReadAll(fd, &rlen, 4)) {
+          std::free(payload);
+          return false;
+        }
+        if (rlen == kStaleFrame) {
+          // fenced mid-batch: latch and fail the whole call typed — the
+          // retry loop below sees the flag and stops.
+          stale = true;
+          std::free(payload);
+          return false;
+        }
+        if (rlen > kMaxMsg) {
           std::free(payload);
           return false;
         }
@@ -1462,7 +1695,8 @@ struct ControlClient {
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
-      if (a >= retries || !Reconnect(a)) return -1;
+      if (stale || a >= retries || !Reconnect(a))
+        return stale ? kStaleIncarnationReply : -1;
     }
   }
 
@@ -1472,6 +1706,7 @@ struct ControlClient {
   int64_t CallMulti(uint8_t op, const char* keys_nl, const int64_t* args,
                     int64_t* out, int n) {
     std::lock_guard<std::mutex> lk(mu);
+    if (stale) return kStaleIncarnationReply;
     const uint64_t seq = AllocSeq(op);  // fetch_add_many: batch-level dedup
     auto attempt = [&](int fault) -> bool {
       std::vector<char> buf;
@@ -1494,7 +1729,8 @@ struct ControlClient {
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
-      if (a >= retries || !Reconnect(a)) return -1;
+      if (a >= retries || !Reconnect(a))
+        return stale ? kStaleIncarnationReply : -1;
     }
   }
 };
@@ -1550,6 +1786,15 @@ bool ControlClient::Reconnect(int attempt) {
   int nfd = DialAndHandshake(host, port, secret, sockbuf);
   if (nfd < 0) return false;
   fd = nfd;
+  // A rebuilt stream must re-register its incarnation before any op rides
+  // it — an unregistered reconnect would dodge the server's fence. A stale
+  // verdict here latches `stale` and fails the reconnect: the caller's op
+  // then returns kStaleIncarnationReply instead of retrying forever.
+  if (incarnation >= 0 && SendAttach() != 1) {
+    ::close(fd);
+    fd = -1;
+    return false;
+  }
   return true;
 }
 
@@ -1691,6 +1936,60 @@ void* bf_cp_connect_auth(const char* host, int port, int rank,
 
 void* bf_cp_connect(const char* host, int port, int rank) {
   return bf_cp_connect_auth(host, port, rank, "");
+}
+
+// Register this client's (rank, incarnation) with the server (elastic
+// membership fencing). 0 = registered; -4 = superseded (the caller is a
+// zombie of a restarted rank — every later op on this client fails fast
+// with the same code); -1 = wire failure. Re-sent automatically on every
+// transparent reconnect.
+int64_t bf_cp_attach(void* h, int64_t incarnation) {
+  auto* cl = static_cast<ControlClient*>(h);
+  std::lock_guard<std::mutex> lk(cl->mu);
+  cl->incarnation = incarnation;
+  cl->stale = false;
+  int64_t r = cl->SendAttach();
+  if (r == kStaleIncarnationReply) return r;
+  if (r >= 0) return 0;
+  for (int a = 0; a < cl->retries; ++a) {
+    if (cl->Reconnect(a)) return 0;  // Reconnect re-attached successfully
+    if (cl->stale) return kStaleIncarnationReply;
+  }
+  return -1;
+}
+
+// 1 once the server has fenced this client as a superseded incarnation.
+// Lets Python distinguish a genuine -4 scalar value from the typed status.
+int bf_cp_is_stale(void* h) {
+  auto* cl = static_cast<ControlClient*>(h);
+  std::lock_guard<std::mutex> lk(cl->mu);
+  return cl->stale ? 1 : 0;
+}
+
+// -- server-side introspection (tests assert the GC left nothing behind) ----
+
+long long bf_cp_server_dedup_entries(void* h) {
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  return static_cast<long long>(srv->dedup.size());
+}
+
+long long bf_cp_server_mailbox_from(void* h, int origin) {
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  long long n = 0;
+  for (const auto& it : srv->mailbox_origin)
+    for (int8_t o : it.second)
+      if (o == static_cast<int8_t>(origin & 0x7F)) ++n;
+  return n;
+}
+
+long long bf_cp_server_incarnation(void* h, int rank) {
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  auto it = srv->incarnations.find(rank);
+  return it == srv->incarnations.end() ? -1
+                                       : static_cast<long long>(it->second);
 }
 
 int64_t bf_cp_barrier(void* h, const char* key) {

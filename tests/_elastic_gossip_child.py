@@ -1,0 +1,111 @@
+"""Child for the kill-and-respawn-mid-gossip elastic test (ISSUE r9).
+
+Four controllers, two devices each, running push-sum window gossip under
+``bfrun --elastic``. Controller 3 hard-exits mid-loop at incarnation 0 —
+the launcher respawns it with ``BLUEFOG_INCARNATION=1``, the control plane
+fences its zombie and GCs its queued deposits, and the respawn rejoins
+through quarantined state transfer (donor mass split for push-sum).
+Survivors must (a) detect {3} dead and keep bounded gossip steps on the
+renormalized graph, (b) observe its RE-ADMISSION after quarantine
+completes, and (c) finish with finite, converging parameters; the
+rejoiner asserts its quarantine completed and that it trains on.
+
+NOTE: like every multi-process slow test in this tree, this needs a jax
+build with CPU multiprocess collectives (this image lacks them), plus a
+jax.distributed coordinator that tolerates a process re-initializing with
+the same process id. The control-plane half of the protocol (fencing, GC,
+quarantine, mass split) is covered by fast in-process tests in
+tests/test_chaos.py.
+"""
+
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+
+N = 8
+DEAD_PID = 3
+
+
+def main() -> None:
+    inc = int(os.environ.get("BLUEFOG_INCARNATION", "0") or 0)
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert bf.size() == N, bf.size()
+
+    def loss_fn(params, batch):
+        return jnp.sum((params["w"] - 3.0) ** 2)
+
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.05), loss_fn=loss_fn)
+    state = opt.init({"w": jnp.zeros((4,), jnp.float32)})
+    batch = bf.replicate(jnp.zeros((1,), jnp.float32))
+
+    if pid == DEAD_PID and inc == 0:
+        for _ in range(3):
+            state, _ = opt.step(state, batch)
+        print(f"HEALTHY {pid}", flush=True)
+        os._exit(17)  # SIGKILL shape: no announce, no atexit — respawned
+
+    if inc > 0:
+        # the respawned rank: opt.init above already ran quarantined state
+        # transfer (donor mass split); prove it trains on
+        assert not bf.runtime.heartbeat.quarantine_pending()
+        print(f"REJOINED {pid} inc={inc}", flush=True)
+        for _ in range(5):
+            state, _ = opt.step(state, batch)
+        for shard in state.params["w"].addressable_shards:
+            assert np.isfinite(np.asarray(shard.data)).all()
+        print(f"REJOIN_STEPS_OK {pid}", flush=True)
+        os._exit(0)
+
+    for _ in range(3):
+        state, _ = opt.step(state, batch)
+    print(f"HEALTHY {pid}", flush=True)
+
+    detected = readmitted = False
+    deadline = time.monotonic() + 180
+    while time.monotonic() < deadline and not (detected and readmitted):
+        t0 = time.monotonic()
+        state, _ = opt.step(state, batch)
+        step_s = time.monotonic() - t0
+        assert step_s < 30, f"step took {step_s:.1f}s"
+        if not detected and bf.dead_controllers() == {DEAD_PID}:
+            detected = True
+            assert bf.dead_ranks() == {6, 7}, bf.dead_ranks()
+            print(f"DEAD_DETECTED {pid}", flush=True)
+        if detected and not bf.dead_controllers():
+            readmitted = True
+            print(f"READMITTED {pid}", flush=True)
+    if not (detected and readmitted):
+        print(f"SURVIVOR_TIMEOUT {pid} detected={detected} "
+              f"readmitted={readmitted}", flush=True)
+        os._exit(3)
+    for _ in range(3):  # post-readmission: full-graph gossip again
+        state, _ = opt.step(state, batch)
+    for shard in state.params["w"].addressable_shards:
+        assert np.isfinite(np.asarray(shard.data)).all()
+    print(f"SURVIVOR_STEPS_OK {pid}", flush=True)
+
+    # rendezvous so process 0 (coordinator + control-plane host) exits last
+    from bluefog_tpu.runtime import control_plane
+    cl = control_plane.client()
+    cl.put(f"eg.done.{pid}", 1)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(cl.get(f"eg.done.{i}") for i in range(3)):
+            break
+        time.sleep(0.05)
+    print(f"CHILD_OK {pid}", flush=True)
+    if pid == 0:
+        time.sleep(2.0)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

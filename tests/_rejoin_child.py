@@ -1,0 +1,70 @@
+"""Child for the end-to-end quarantined-rejoin test (ISSUE r9).
+
+Two phases against one long-lived control-plane server owned by the test:
+
+* ``first`` (incarnation 0): trains a window optimizer for 3 steps, saves
+  an orbax checkpoint, records the resulting parameters, exits.
+* ``rejoin`` (BLUEFOG_INCARNATION=1): bf.init attaches with the bumped
+  incarnation — the server fences the dead incarnation — and enters
+  quarantine; the window optimizer's init runs the state transfer. With no
+  live in-neighbor on another controller (world of one), it falls back to
+  the newest checkpoint under BLUEFOG_CHECKPOINT_DIR, adopts its step
+  counter, and completes quarantine (phase 2 visible in the KV).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+
+
+def loss_fn(params, batch):
+    return jnp.sum((params["w"] - 3.0) ** 2)
+
+
+def main() -> int:
+    phase, workdir = sys.argv[1], sys.argv[2]
+    bf.init()
+    assert bf.size() == 8, bf.size()
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.05), loss_fn=loss_fn)
+    state = opt.init({"w": jnp.ones((4,), jnp.float32)})
+    batch = bf.replicate(jnp.zeros((1,), jnp.float32))
+
+    from bluefog_tpu.runtime import control_plane as cp
+
+    if phase == "first":
+        for _ in range(3):
+            state, _ = opt.step(state, batch)
+        bf.checkpoint.save(os.path.join(workdir, "ck"), state, step=3)
+        np.save(os.path.join(workdir, "params.npy"),
+                np.asarray(state.params["w"]))
+        print("FIRST_OK", flush=True)
+    else:
+        assert cp.incarnation() == 1, cp.incarnation()
+        # opt.init above already ran the quarantined transfer: no remote
+        # donor exists (this controller owns every rank), so it restored
+        # the newest checkpoint and adopted its step counter.
+        assert opt._counter == 3, opt._counter
+        want = np.load(os.path.join(workdir, "params.npy"))
+        got = np.asarray(state.params["w"])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        cl = cp.client()
+        assert cl.get("bf.inc.0") == 1
+        assert cl.get("bf.q.0.1") == 2, "quarantine did not complete"
+        from bluefog_tpu.runtime.heartbeat import quarantine_pending
+        assert not quarantine_pending()
+        # the rank trains on: a post-rejoin step must complete normally
+        state2, _ = opt.step(state, batch)
+        print("REJOIN_OK", flush=True)
+    opt.free()
+    bf.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,13 +20,31 @@ REPO = Path(__file__).resolve().parent.parent
 
 def _scrubbed_env():
     env = os.environ.copy()
-    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE"):
+    # BLUEFOG_CP_FAULT: a fault spec leaked from the operator's shell must
+    # never poison a benchmark run — throughput under injected connection
+    # drops is not a benchmark (asserted below)
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE",
+              "BLUEFOG_CP_FAULT"):
         env.pop(k, None)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     # CI smoke runs on the simulated CPU mesh; don't let children probe a
     # possibly-down accelerator tunnel (multi-minute timeout per process)
     env["JAX_PLATFORMS"] = "cpu"
     return env
+
+
+def test_fault_injection_disarmed_in_benchmark_env(monkeypatch):
+    """Fault injection stays OFF in benchmark runs by default: the bench
+    harness env scrubs any inherited BLUEFOG_CP_FAULT spec, and the native
+    injector in THIS process is disarmed unless a test armed it."""
+    monkeypatch.setenv("BLUEFOG_CP_FAULT", "drop_after=5,seed=1")
+    env = _scrubbed_env()
+    assert "BLUEFOG_CP_FAULT" not in env
+    from bluefog_tpu.runtime import native
+
+    if native.load() is not None:
+        native.fault_disarm()
+        assert native.fault_stats() == {"ops": 0, "drops": 0}
 
 
 @pytest.mark.slow

@@ -101,7 +101,7 @@ def test_fetch_add_exactly_once_under_drops(server):
     """Non-idempotent ops must never double-apply across retries: a reply
     lost in flight is replayed from the server's per-client dedup table."""
     cl = native.ControlPlaneClient("127.0.0.1", server.port, 0, streams=1)
-    native.fault_arm("drop_after=4,seed=1")
+    native.fault_arm(f"drop_after=4,seed={_seed(1)}")
     seen = [cl.fetch_add("ctr", 1) for _ in range(40)]
     drops = native.fault_stats()["drops"]
     native.fault_disarm()
@@ -117,7 +117,7 @@ def test_batched_fetch_add_exactly_once_under_drops(server):
     hot path) resends whole batches under one seq; the server replays the
     applied prefix."""
     cl = native.ControlPlaneClient("127.0.0.1", server.port, 0, streams=1)
-    native.fault_arm("drop_after=3,seed=0,trunc=1")
+    native.fault_arm(f"drop_after=3,seed={_seed(0)},trunc=1")
     total = 0
     for _ in range(12):
         pre = cl.fetch_add_many(["a", "b", "c"], deltas=[1, 2, 3])
@@ -156,7 +156,7 @@ def test_striped_roundtrip_bit_identical_under_drops(streams):
         srv = native.ControlPlaneServer(2, _free_port())
         try:
             baseline = _striped_roundtrip(srv.port, streams)
-            native.fault_arm("drop_after=3,seed=2,trunc=1")
+            native.fault_arm(f"drop_after=3,seed={_seed(2)},trunc=1")
             faulted = _striped_roundtrip(srv.port, streams)
             drops = native.fault_stats()["drops"]
             native.fault_disarm()
@@ -213,7 +213,7 @@ def test_deposit_drain_mass_conserved_under_drops(streams):
         srv.stop()
     srv = native.ControlPlaneServer(2, _free_port())
     try:
-        native.fault_arm("drop_after=5,seed=3")
+        native.fault_arm(f"drop_after=5,seed={_seed(3)}")
         got, got_in, got_out = _deposit_drain_cycle(srv.port, streams)
         drops = native.fault_stats()["drops"]
         native.fault_disarm()
@@ -492,7 +492,7 @@ def test_hosted_pushsum_mass_conserved_under_drops(bf_hosted_cp):
         dw = {r: {d: 1.0 / (outd[r] + 1)
                   for d in bf.topology_util.out_neighbor_ranks(topo, r)}
               for r in range(8)}
-        native.fault_arm("drop_after=15,seed=5")
+        native.fault_arm(f"drop_after=15,seed={_seed(5)}")
         val = x
         for _ in range(4):
             bf.win_accumulate(val, "chaos.ps", self_weight=sw,
@@ -659,3 +659,420 @@ def test_kill_peer_mid_gossip_self_heals():
         assert f"CHILD_OK {i}" in outs[i], outs[i]
     for i in range(4):
         assert f"HEALTHY {i}" in outs[i]
+
+
+# ---------------------------------------------------------------------------
+# incarnation fencing: zombie rejection + server-side GC (ISSUE r9)
+# ---------------------------------------------------------------------------
+
+def _seed(base: int) -> int:
+    """Deterministic seed, shiftable job-wide by `make chaos` so the whole
+    suite replays its drop points at a second offset (BLUEFOG_CHAOS_SEED)."""
+    return base + int(os.environ.get("BLUEFOG_CHAOS_SEED", "0") or 0)
+
+
+@pytest.mark.parametrize("streams", [4, 1])
+def test_zombie_gets_typed_stale_rejections(streams):
+    """Acceptance (c): after a rank re-attaches with a bumped incarnation,
+    its old incarnation's client receives typed StaleIncarnationError on
+    EVERY op class — scalar, blocking, pipelined, and bulk — and the
+    server retains zero dedup/mailbox state for the dead incarnation."""
+    srv = native.ControlPlaneServer(2, _free_port())
+    try:
+        old = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                        streams=streams, incarnation=0)
+        # seed server-side identity for the old incarnation
+        old.fetch_add("z.ctr", 1)                       # dedup entry
+        old.append_bytes_tagged_many(
+            ["z.box"], [b"stale-parameters"],
+            [(((1 & 0x7F) << 32) | 7) << 24])           # origin-tagged record
+        old.lock("z.lock")                               # held lock
+        assert srv.incarnation_of(1) == 0
+        assert srv.mailbox_records_from(1) == 1
+        assert srv.dedup_entries() >= 1
+
+        # the respawn attaches with incarnation+1: fence + GC
+        new = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                        streams=streams, incarnation=1)
+        assert srv.incarnation_of(1) == 1
+        assert srv.mailbox_records_from(1) == 0, \
+            "dead incarnation's queued deposits survived the GC"
+        assert srv.dedup_entries() == 0, \
+            "dead incarnation's dedup records survived the GC"
+        new.lock("z.lock")  # force-released from the zombie: re-acquirable
+        new.unlock("z.lock")
+
+        zombie_ops = [
+            lambda: old.put("z.x", 1),
+            lambda: old.get("z.x"),
+            lambda: old.fetch_add("z.ctr", 1),
+            lambda: old.barrier("z.bar"),
+            lambda: old.lock("z.lock2"),
+            lambda: old.unlock("z.lock"),
+            lambda: old.append_bytes("z.box", b"more"),
+            lambda: old.take_bytes("z.box"),
+            lambda: old.put_bytes("z.blob", b"payload"),
+            lambda: old.get_bytes("z.blob"),
+            lambda: old.get_many(["z.x", "z.y"]),
+            lambda: old.put_many(["z.x"], [2]),
+            lambda: old.fetch_add_many(["z.c2"]),
+            lambda: old.box_bytes_many(["z.box"]),
+            lambda: old.take_bytes_many(["z.box"]),
+            lambda: old.get_bytes_many(["z.blob"]),
+            lambda: old.append_bytes_many(["z.box"], [b"r"]),
+            lambda: old.bytes_len("z.blob"),
+        ]
+        for op in zombie_ops:
+            with pytest.raises(native.StaleIncarnationError,
+                               match="superseded"):
+                op()
+        # the new incarnation is unaffected
+        new.put("z.alive", 5)
+        assert new.get("z.alive") == 5
+        old.close()
+        new.close()
+    finally:
+        srv.stop()
+
+
+def test_stale_attach_rejected_at_connect():
+    """A zombie that reconnects AFTER its replacement registered is
+    rejected at construction time with the typed error (never admitted)."""
+    srv = native.ControlPlaneServer(2, _free_port())
+    try:
+        fresh = native.ControlPlaneClient("127.0.0.1", srv.port, 0,
+                                          streams=1, incarnation=3)
+        with pytest.raises(native.StaleIncarnationError):
+            native.ControlPlaneClient("127.0.0.1", srv.port, 0,
+                                      streams=1, incarnation=2)
+        # equal incarnation is NOT stale (pool connections of the same
+        # process attach with the same value)
+        peer = native.ControlPlaneClient("127.0.0.1", srv.port, 0,
+                                         streams=1, incarnation=3)
+        peer.put("ok", 1)
+        peer.close()
+        fresh.close()
+    finally:
+        srv.stop()
+
+
+@pytest.mark.parametrize("streams", [4, 1])
+def test_zombie_fenced_while_transport_drops(streams):
+    """Fencing composes with the reconnecting transport: with fault
+    injection killing connections under BOTH clients, the zombie still
+    gets typed rejections (a reconnect re-registers and is re-fenced, so
+    drops can never let it slip back in) and the live incarnation's ops
+    stay exactly-once."""
+    srv = native.ControlPlaneServer(2, _free_port())
+    try:
+        old = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                        streams=streams, incarnation=0)
+        old.put("f.pre", 1)
+        new = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                        streams=streams, incarnation=1)
+        native.fault_arm(f"drop_after=4,seed={_seed(11)}")
+        seen = [new.fetch_add("f.ctr", 1) for _ in range(30)]
+        for _ in range(10):
+            with pytest.raises(native.StaleIncarnationError):
+                old.fetch_add("f.ctr", 1)
+            with pytest.raises(native.StaleIncarnationError):
+                old.put("f.pre", 2)
+        drops = native.fault_stats()["drops"]
+        native.fault_disarm()
+        assert drops >= 3, f"only {drops} drops injected"
+        assert seen == list(range(30)), "live incarnation lost exactly-once"
+        assert new.get("f.ctr") == 30
+        assert new.get("f.pre") == 1, "zombie write leaked through"
+        old.close()
+        new.close()
+    finally:
+        srv.stop()
+
+
+def test_membership_epoch_bumps_on_joins():
+    """The server advances the membership-epoch KV on every first join and
+    every incarnation bump — the signal window optimizers key their
+    neighbor-table rebuilds on."""
+    srv = native.ControlPlaneServer(4, _free_port())
+    try:
+        a = native.ControlPlaneClient("127.0.0.1", srv.port, 0,
+                                      streams=1, incarnation=0)
+        e0 = a.get("bf.membership.epoch")
+        assert e0 >= 1  # a's own join bumped it
+        b = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                      streams=1, incarnation=0)
+        assert a.get("bf.membership.epoch") == e0 + 1
+        # same-rank same-incarnation reattach (pool conn) does NOT bump
+        b2 = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                       streams=1, incarnation=0)
+        assert a.get("bf.membership.epoch") == e0 + 1
+        # incarnation bump (rejoin) bumps
+        c = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                      streams=1, incarnation=1)
+        assert a.get("bf.membership.epoch") == e0 + 2
+        assert a.get("bf.inc.1") == 1
+        for cl in (a, b, b2, c):
+            cl.close()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# rejoin protocol: state transfer + push-sum mass split (in-process)
+# ---------------------------------------------------------------------------
+
+def test_rejoin_transfer_adopts_donor_row_and_step(bf_hosted_cp):
+    """The base (non-push-sum) transfer: a rank adopts a donor's published
+    packed window row under the donor's mutex, and _adopt_window_rows
+    rebuilds the rank-stacked params from the windows — the rejoiner's
+    parameters become the donor's current values."""
+    import jax.numpy as jnp
+    import optax
+    import time as _t
+
+    bf = bf_hosted_cp
+    from bluefog_tpu.ops import windows as W
+
+    def loss_fn(params, batch):
+        return jnp.sum(params["w"] ** 2)
+
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.05), loss_fn=loss_fn)
+    state = opt.init({"w": jnp.arange(3.0, dtype=jnp.float32)})
+    batch = bf.replicate(jnp.zeros((1,), jnp.float32))
+    try:
+        for _ in range(2):
+            state, _ = opt.step(state, batch)
+        win = W._get_window(opt._win_names[0])
+        donor_row = win._rows[3].copy()
+        assert not np.allclose(win._rows[0], donor_row) or True
+        ok = opt._transfer_rank(0, 3, _t.monotonic() + 10)
+        assert ok
+        np.testing.assert_array_equal(win._rows[0], donor_row)
+        # the published copy moved too (survivors' win_get sees it)
+        np.testing.assert_array_equal(win.read_published_row(0), donor_row)
+        # params rebuilt from windows: rank 0's leaf row == donor's values
+        state2 = opt._adopt_window_rows(state)
+        got = np.asarray(state2.params["w"])
+        np.testing.assert_allclose(got[0], got[3], rtol=0, atol=0)
+        # step counter adoption: published by gossip steps
+        from bluefog_tpu.runtime import control_plane as _cpm
+        cl = _cpm.client()
+        assert cl.get(opt._step_counter_key(0)) == opt._counter
+    finally:
+        opt.free()
+
+
+def test_rejoin_transfer_fails_over_dead_donor(bf_hosted_cp):
+    """A donor whose published slot is absent/mis-sized is skipped: the
+    transfer returns False so the caller tries the next candidate."""
+    import jax.numpy as jnp
+    import optax
+    import time as _t
+
+    bf = bf_hosted_cp
+    from bluefog_tpu.ops import windows as W
+    from bluefog_tpu.runtime import control_plane as _cpm
+
+    def loss_fn(params, batch):
+        return jnp.sum(params["w"] ** 2)
+
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.05), loss_fn=loss_fn)
+    state = opt.init({"w": jnp.zeros((2,), jnp.float32)})
+    try:
+        win = W._get_window(opt._win_names[0])
+        # clear donor 3's published tensor (a dead controller's slot after
+        # win_free cleanup, or one that never published)
+        _cpm.client().put_bytes(win._self_key(3), b"")
+        assert win.read_published_row(3) is None
+        assert not opt._transfer_rank(0, 3, _t.monotonic() + 5)
+        # a healthy donor still works
+        assert opt._transfer_rank(0, 5, _t.monotonic() + 5)
+    finally:
+        opt.free()
+
+
+def test_pushsum_mass_split_bit_exact(bf_hosted_cp):
+    """Acceptance (b), the donor side of it: the push-sum mass split moves
+    EXACTLY half the donor's numerator and p to the rejoiner — total mass
+    over the job is bit-exactly unchanged, and both parties' de-biased
+    parameters equal the donor's pre-split values."""
+    import jax.numpy as jnp
+    import optax
+    import time as _t
+
+    bf = bf_hosted_cp
+    from bluefog_tpu.ops import windows as W
+    from bluefog_tpu.runtime import control_plane as _cpm
+
+    def loss_fn(params, batch):
+        return jnp.sum((params["w"] - batch) ** 2)
+
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.1), loss_fn=loss_fn)
+    state = opt.init({"w": jnp.zeros((2,), jnp.float32)})
+    batch = bf.shard_rank_stacked(
+        bf.mesh(), np.arange(8, dtype=np.float32).reshape(8, 1))
+    try:
+        for _ in range(3):
+            state, _ = opt.step(state, batch)
+        nm = opt._win_names[0]
+        win = W._get_window(nm)
+        p_before = win.host.read_p()
+        rows_before = {r: win._rows[r].copy() for r in range(8)}
+        total_before = sum(float(rows_before[r].sum()) for r in range(8))
+        donor = 3
+        x_donor = rows_before[donor] / p_before[donor]
+
+        # rejoiner side posts the request on a thread; donor side serves
+        result = {}
+
+        def rejoin():
+            result["ok"] = opt._transfer_rank(0, donor,
+                                              _t.monotonic() + 20)
+
+        t = threading.Thread(target=rejoin, daemon=True)
+        t.start()
+        deadline = _t.monotonic() + 10
+        cl = _cpm.client()
+        while _t.monotonic() < deadline and not cl.get(f"w.{nm}.msreq.0"):
+            _t.sleep(0.01)
+        opt._serve_epoch = None  # force the scan (epoch mirror is static)
+        opt._serve_rejoin_requests()
+        t.join(20)
+        assert result.get("ok") is True
+
+        p_after = win.host.read_p()
+        # donor halved; rejoiner holds the other half — bit-exact
+        assert p_after[donor] == p_before[donor] * 0.5
+        assert p_after[0] == p_before[donor] * 0.5
+        assert float(p_after.sum()) == float(
+            p_before.sum() - p_before[0])  # rank 0's stale mass replaced
+        np.testing.assert_array_equal(
+            win._rows[donor] + win._rows[0],
+            rows_before[donor])  # numerator halves sum back exactly
+        # de-biased parameters: both equal the donor's pre-split x
+        np.testing.assert_allclose(
+            win._rows[0] / p_after[0], x_donor, rtol=1e-6)
+        np.testing.assert_allclose(
+            win._rows[donor] / p_after[donor], x_donor, rtol=1e-6)
+        # request/serve keys cleaned up
+        assert cl.get(f"w.{nm}.msreq.0") == 0
+        assert cl.get(f"w.{nm}.msdone.0") == 0
+    finally:
+        opt.free()
+
+
+def test_healed_tables_cached_per_dead_set(bf_hosted_cp, monkeypatch):
+    """The healed edge tables are derived ONCE per dead set (the membership
+    epoch gates the rebuild), not re-derived every gossip step."""
+    import jax.numpy as jnp
+    import optax
+
+    bf = bf_hosted_cp
+    import bluefog_tpu.optimizers as O
+    from bluefog_tpu.runtime import heartbeat as hb
+
+    monkeypatch.setattr(hb, "dead_ranks", lambda: {6, 7})
+    calls = [0]
+    real = O._healed_recv_weights
+
+    def counting(*a, **k):
+        calls[0] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(O, "_healed_recv_weights", counting)
+
+    def loss_fn(params, batch):
+        return jnp.sum(params["w"] ** 2)
+
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.05), loss_fn=loss_fn)
+    state = opt.init({"w": jnp.zeros((2,), jnp.float32)})
+    batch = bf.replicate(jnp.zeros((1,), jnp.float32))
+    try:
+        for _ in range(4):
+            state, _ = opt.step(state, batch)
+        assert calls[0] == 1, (
+            f"healed tables derived {calls[0]}x for one unchanged dead set")
+        # membership change -> rebuild once more
+        monkeypatch.setattr(hb, "dead_ranks", lambda: {7})
+        for _ in range(3):
+            state, _ = opt.step(state, batch)
+        assert calls[0] == 2
+    finally:
+        opt.free()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end quarantined rejoin through bf.init (subprocess)
+# ---------------------------------------------------------------------------
+
+def test_quarantined_rejoin_end_to_end(tmp_path):
+    """Full lifecycle against one live server: run + checkpoint at
+    incarnation 0, then 'respawn' with BLUEFOG_INCARNATION=1 — the rejoin
+    attaches fenced, enters quarantine, restores the newest checkpoint
+    (no remote donor in a world of one), adopts the step counter, resumes
+    training, and publishes quarantine completion."""
+    srv = native.ControlPlaneServer(1, _free_port())
+    try:
+        env = _scrubbed_env()
+        env.update({
+            "BLUEFOG_CP_HOST": "127.0.0.1",
+            "BLUEFOG_CP_PORT": str(srv.port),
+            "BLUEFOG_CP_RANK": "0",
+            "BLUEFOG_CP_WORLD": "1",
+            "BLUEFOG_CP_SERVE": "0",
+            "BLUEFOG_WIN_HOST_PLANE": "1",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "BLUEFOG_CHECKPOINT_DIR": str(tmp_path),
+        })
+
+        def run(phase, extra):
+            e = dict(env)
+            e.update(extra)
+            return subprocess.run(
+                [sys.executable, str(TESTS / "_rejoin_child.py"), phase,
+                 str(tmp_path)],
+                env=e, capture_output=True, text=True, timeout=240)
+
+        first = run("first", {})
+        assert first.returncode == 0, first.stdout + first.stderr
+        assert "FIRST_OK" in first.stdout
+        assert srv.incarnation_of(0) == 0
+
+        rejoin = run("rejoin", {"BLUEFOG_INCARNATION": "1"})
+        assert rejoin.returncode == 0, rejoin.stdout + rejoin.stderr
+        assert "REJOIN_OK" in rejoin.stdout
+        assert srv.incarnation_of(0) == 1
+    finally:
+        srv.stop()
+
+
+@pytest.mark.slow
+def test_kill_and_respawn_mid_gossip_rejoins():
+    """4 controllers under the elastic supervisor; controller 3 hard-exits
+    mid-gossip and is respawned with BLUEFOG_INCARNATION=1. Survivors must
+    detect the death, keep bounded steps on the shrunken graph, then
+    observe RE-ADMISSION once the respawn's quarantined state transfer
+    (push-sum donor mass split) completes; the rejoiner must train on.
+    Needs a jax build with CPU multiprocess collectives (slow-marked; the
+    control-plane half is covered by the fast tests above)."""
+    port = _free_port()
+    env = _scrubbed_env()
+    env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.2"
+    env["BLUEFOG_HEARTBEAT_TIMEOUT"] = "1.5"
+    env["BLUEFOG_CP_LOCK_LEASE"] = "20"
+    env["BLUEFOG_CP_QUARANTINE_TIMEOUT"] = "60"
+
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher",
+         "-H", "localhost:4", "--elastic=1",
+         "--coordinator", f"127.0.0.1:{port}", "--simulate", "2",
+         "--", sys.executable, str(TESTS / "_elastic_gossip_child.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "respawning as incarnation 1" in out.stderr
+    assert f"REJOINED {3} inc=1" in out.stdout
+    assert "REJOIN_STEPS_OK 3" in out.stdout
+    for i in range(3):
+        assert f"DEAD_DETECTED {i}" in out.stdout, out.stdout
+        assert f"READMITTED {i}" in out.stdout, out.stdout
+        assert f"SURVIVOR_STEPS_OK {i}" in out.stdout, out.stdout

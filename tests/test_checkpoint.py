@@ -66,3 +66,91 @@ def test_async_save_roundtrip(bf8, tmp_path):
                                    np.asarray(x))
         np.testing.assert_allclose(np.asarray(restored.opt_state["m"]),
                                    2.0 * np.asarray(x))
+
+
+def test_meta_sidecar_records_world_identity(bf8, tmp_path):
+    """save records world size + topology fingerprint + membership epoch in
+    a sidecar; restore onto the SAME world passes silently (ISSUE r9)."""
+    from bluefog_tpu import checkpoint as ck
+
+    x = bf.shard_rank_stacked(bf.mesh(),
+                              np.arange(8.0, dtype=np.float32).reshape(8, 1))
+    st = bf.TrainState(params={"w": x}, opt_state={}, model_state=None)
+    path = str(tmp_path / "meta_ck")
+    ck.save(path, st, step=4)
+    meta = ck.read_meta(path)
+    assert meta is not None
+    assert meta["world"] == N
+    assert meta["step"] == 4
+    assert "topology_crc" in meta and "membership_epoch" in meta
+    restored, step = ck.restore(path, template=st, strict=True)
+    assert step == 4
+
+
+def test_meta_mismatch_warns_and_strict_raises(bf8, tmp_path):
+    """A checkpoint whose sidecar names a DIFFERENT world warns on restore
+    (and raises with strict=True) instead of silently resuming rank-stacked
+    state onto the wrong world."""
+    import json
+    import logging
+
+    from bluefog_tpu import checkpoint as ck
+    from bluefog_tpu.runtime.logging import logger as bflog
+
+    x = bf.shard_rank_stacked(bf.mesh(),
+                              np.arange(8.0, dtype=np.float32).reshape(8, 1))
+    st = bf.TrainState(params={"w": x}, opt_state={}, model_state=None)
+    path = str(tmp_path / "mismatch_ck")
+    ck.save(path, st, step=1)
+    # tamper: pretend the checkpoint came from a 16-rank world with another
+    # topology
+    meta = ck.read_meta(path)
+    meta["world"] = 16
+    meta["topology_crc"] = (meta.get("topology_crc", 0) + 1) & 0xFFFFFFFF
+    with open(ck._meta_path(path), "w") as f:
+        json.dump(meta, f)
+
+    # the package logger sets propagate=False: capture with our own handler
+    records = []
+    cap = logging.Handler(level=logging.WARNING)
+    cap.emit = records.append
+    bflog.addHandler(cap)
+    try:
+        restored, _ = ck.restore(path, template=st)  # warns, succeeds
+    finally:
+        bflog.removeHandler(cap)
+    assert any("different world" in r.getMessage() for r in records)
+
+    with pytest.raises(RuntimeError, match="different world"):
+        ck.restore(path, template=st, strict=True)
+
+
+def test_meta_absent_is_tolerated(bf8, tmp_path):
+    """Pre-r9 checkpoints (no sidecar) restore without checks or warnings."""
+    import os
+
+    from bluefog_tpu import checkpoint as ck
+
+    x = bf.shard_rank_stacked(bf.mesh(),
+                              np.arange(8.0, dtype=np.float32).reshape(8, 1))
+    st = bf.TrainState(params={"w": x}, opt_state={}, model_state=None)
+    path = str(tmp_path / "old_ck")
+    ck.save(path, st, step=2)
+    os.unlink(ck._meta_path(path))
+    restored, step = ck.restore(path, template=st, strict=True)
+    assert step == 2
+
+
+def test_latest_path_picks_newest(tmp_path):
+    import os
+    import time
+
+    from bluefog_tpu import checkpoint as ck
+
+    assert ck.latest_path(str(tmp_path)) is None
+    for name in ("ck1", "ck2", "ck3"):
+        os.mkdir(tmp_path / name)
+        time.sleep(0.01)
+    os.utime(tmp_path / "ck2")  # freshest mtime
+    assert ck.latest_path(str(tmp_path)) == str(tmp_path / "ck2")
+    assert ck.latest_path(str(tmp_path / "missing")) is None

@@ -45,7 +45,11 @@ def _attach(monkeypatch, client):
     monkeypatch.setattr(cp, "_client", client)
 
 
-def test_peer_failure_detected_and_recovery(two_clients, monkeypatch):
+def test_peer_failure_detected_and_gated_recovery(two_clients, monkeypatch):
+    """Death is detected; a raw heartbeat resume alone does NOT re-admit
+    (the flapping-peer hole, ISSUE r9) — the peer becomes a suspect and
+    only returns to live membership once a new incarnation registered and
+    its quarantine completed."""
     a, b = two_clients
     _attach(monkeypatch, a)
     mon = heartbeat.PeerMonitor(0, 2, interval_sec=0.05, timeout_sec=0.3)
@@ -57,6 +61,7 @@ def test_peer_failure_detected_and_recovery(two_clients, monkeypatch):
     beat()
     mon._tick()
     assert mon.dead_peers() == set()
+    epoch0 = mon.membership_epoch
 
     deadline = time.monotonic() + 5.0
     # silence: tick until the monitor declares peer 1 dead
@@ -64,11 +69,32 @@ def test_peer_failure_detected_and_recovery(two_clients, monkeypatch):
         time.sleep(0.05)
         mon._tick()
     assert mon.dead_peers() == {1}
+    assert mon.membership_epoch > epoch0  # death bumped the epoch
 
-    # resumed heartbeat clears the failure
+    # resumed heartbeat does NOT clear the failure: dead_ranks() must never
+    # shrink from a flapping peer's raw resume (stale params, stale
+    # server-side identity) — it becomes a suspect instead
+    beat()
+    mon._tick()
+    assert mon.dead_peers() == {1}
+    assert mon.suspect_peers() == {1}
+    beat()
+    mon._tick()  # still gated on later ticks
+    assert mon.dead_peers() == {1}
+
+    # the re-admission gate: a NEW incarnation registers (normally the
+    # server's kAttach handler writes these) and completes quarantine
+    b.put("bf.inc.1", 1)
+    beat()
+    mon._tick()
+    assert mon.dead_peers() == {1}, "registration alone must not re-admit"
+    b.put("bf.q.1.1", 2)
+    epoch1 = mon.membership_epoch
     beat()
     mon._tick()
     assert mon.dead_peers() == set()
+    assert mon.suspect_peers() == set()
+    assert mon.membership_epoch > epoch1  # re-admission bumped the epoch
 
 
 def test_shutdown_flag_propagates_and_acks(two_clients, monkeypatch):

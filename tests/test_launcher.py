@@ -387,3 +387,67 @@ def test_keras_frontend_example():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "KERAS TRAIN OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# bfrun --elastic: incarnation-bumped respawn supervision (ISSUE r9)
+# ---------------------------------------------------------------------------
+
+def test_elastic_parser_forms():
+    p = launcher.build_parser()
+    a = p.parse_args(["--elastic", "--", "prog"])
+    assert a.elastic == 3  # bare flag: default budget
+    a = p.parse_args(["--elastic=5", "--min-world", "2", "--", "prog"])
+    assert a.elastic == 5 and a.min_world == 2
+    a = p.parse_args(["--", "prog"])
+    assert a.elastic is None
+
+
+def test_elastic_respawns_with_bumped_incarnation(tmp_path):
+    """A rank that crashes is respawned with BLUEFOG_INCARNATION bumped;
+    the job succeeds once the respawn does (the probe exits 0 only at
+    incarnation >= 1) — the crash is absorbed, not propagated."""
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os, sys\n"
+        "inc = int(os.environ.get('BLUEFOG_INCARNATION', '0'))\n"
+        "print(f'probe pid={os.environ.get(\"JAX_PROCESS_ID\")} "
+        "inc={inc}', flush=True)\n"
+        "sys.exit(0 if inc >= 1 else 9)\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher",
+         "-H", "localhost:2", "--elastic=2", "--",
+         sys.executable, str(probe)],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "respawning as incarnation 1" in out.stderr
+    assert "inc=1" in out.stdout
+
+
+def test_elastic_budget_exhaustion_is_terminal(tmp_path):
+    """A rank that keeps crashing past its restart budget propagates a
+    terminal failure (nonzero job exit), with the budget respected."""
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher",
+         "-H", "localhost:1", "--elastic=1", "--",
+         sys.executable, "-c", "import sys; sys.exit(9)"],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=180)
+    assert out.returncode == 9, out.stdout + out.stderr
+    assert "exhausted its restart budget" in out.stderr
+    assert out.stderr.count("respawning") == 1  # budget=1: exactly one
+
+
+def test_elastic_min_world_teardown(tmp_path):
+    """With --min-world equal to the full world, losing one rank for good
+    tears the whole job down instead of limping along under-replicated."""
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher",
+         "-H", "localhost:2", "--elastic=0", "--min-world", "2", "--",
+         sys.executable, "-c",
+         "import os, sys, time\n"
+         "if os.environ.get('JAX_PROCESS_ID') == '1':\n"
+         "    sys.exit(9)\n"
+         "time.sleep(60)\n"],
+        env=_scrubbed_env(), capture_output=True, text=True, timeout=180)
+    assert out.returncode == 9, out.stdout + out.stderr
+    assert "dropped below --min-world" in out.stderr

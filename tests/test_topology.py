@@ -172,3 +172,89 @@ class TestDynamicIterators:
                 dst, src = send[0], recv[0]
                 assert steps[dst][1] == [r]
                 assert steps[src][0] == [r]
+
+
+class TestPruneReadmit:
+    """prune_dead_ranks edge cases + readmit_ranks inversion (ISSUE r9)."""
+
+    def _column_sums(self, G):
+        return nx.to_numpy_array(G).sum(axis=0)
+
+    def test_prune_to_world_of_one(self):
+        G = tu.ExponentialTwoGraph(8)
+        Gp = tu.prune_dead_ranks(G, set(range(8)) - {3})
+        W = nx.to_numpy_array(Gp)
+        # sole survivor holds its value; corpses keep unit self-loops
+        assert W[3, 3] == pytest.approx(1.0)
+        assert np.allclose(np.diag(W), 1.0)
+        assert np.count_nonzero(W - np.diag(np.diag(W))) == 0
+
+    def test_prune_everyone_raises(self):
+        G = tu.RingGraph(4)
+        with pytest.raises(ValueError, match="every rank is dead"):
+            tu.prune_dead_ranks(G, {0, 1, 2, 3})
+
+    def test_prune_star_center(self):
+        """Killing the StarGraph center leaves every spoke holding its own
+        value (all their in-edges pointed at the corpse) with column sums
+        preserved — degraded but well-formed, never NaN."""
+        G = tu.StarGraph(6)
+        Gp = tu.prune_dead_ranks(G, {0})
+        W = nx.to_numpy_array(Gp)
+        assert np.isfinite(W).all()
+        assert np.allclose(self._column_sums(Gp), self._column_sums(G))
+        for j in range(1, 6):
+            # spoke j's only in-neighbor was the center: self weight
+            # re-absorbs the whole column mass
+            assert W[j, j] == pytest.approx(1.0)
+            assert np.count_nonzero(W[:, j]) == 1
+
+    def test_double_prune_idempotent(self):
+        G = tu.ExponentialTwoGraph(8)
+        once = tu.prune_dead_ranks(G, {2, 5})
+        twice = tu.prune_dead_ranks(once, {2, 5})
+        assert tu.IsTopologyEquivalent(once, twice)
+
+    def test_prune_composes_on_original(self):
+        """prune(prune(G, a), b) == prune(G, a | b): the stashed record
+        keeps renormalization anchored to the ORIGINAL weights."""
+        G = tu.ExponentialTwoGraph(8)
+        chained = tu.prune_dead_ranks(tu.prune_dead_ranks(G, {1}), {6})
+        direct = tu.prune_dead_ranks(G, {1, 6})
+        assert np.allclose(nx.to_numpy_array(chained),
+                           nx.to_numpy_array(direct))
+
+    @pytest.mark.parametrize("factory", [
+        tu.ExponentialTwoGraph, tu.RingGraph, tu.StarGraph,
+        tu.FullyConnectedGraph,
+    ])
+    def test_readmit_roundtrip(self, factory):
+        G = factory(8)
+        dead = {2, 5}
+        back = tu.readmit_ranks(tu.prune_dead_ranks(G, dead), dead)
+        assert tu.IsTopologyEquivalent(back, G)
+        assert np.allclose(nx.to_numpy_array(back), nx.to_numpy_array(G))
+
+    def test_partial_readmit(self):
+        G = tu.ExponentialTwoGraph(8)
+        pruned = tu.prune_dead_ranks(G, {2, 5})
+        part = tu.readmit_ranks(pruned, {5})
+        assert tu.IsTopologyEquivalent(part, tu.prune_dead_ranks(G, {2}))
+
+    def test_readmit_from_original_without_record(self):
+        """A pruned matrix that lost its stash (serialization strips graph
+        attributes) still readmits exactly when the original is supplied."""
+        G = tu.ExponentialTwoGraph(8)
+        pruned = tu.prune_dead_ranks(G, {2, 5})
+        stripped = nx.from_numpy_array(nx.to_numpy_array(pruned),
+                                       create_using=nx.DiGraph)
+        back = tu.readmit_ranks(stripped, {2, 5}, original=G)
+        assert tu.IsTopologyEquivalent(back, G)
+
+    def test_readmit_rejects_unknown_ranks(self):
+        G = tu.ExponentialTwoGraph(8)
+        pruned = tu.prune_dead_ranks(G, {2})
+        with pytest.raises(ValueError, match="not in the pruned set"):
+            tu.readmit_ranks(pruned, {3})
+        with pytest.raises(ValueError, match="no prune record"):
+            tu.readmit_ranks(G, {2})
