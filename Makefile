@@ -6,7 +6,8 @@ PYTEST      = python -m pytest
 MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
-        test_launcher test_models bench chaos dryrun native scaling lm_bench
+        test_launcher test_models bench chaos dryrun native scaling \
+        lm_bench metrics-smoke
 
 test:            ## full suite (~15 min on the single-core CI box)
 	$(PYTEST) tests/ -q
@@ -38,9 +39,16 @@ test_models:
 bench:           ## headline benchmark on the default backend (real chip)
 	python bench.py
 
-chaos:           ## tier-1 chaos subset, fault injection replayed at TWO seed
-                 ## offsets (BLUEFOG_CHAOS_SEED shifts every armed drop point,
-                 ## so reconnect/dedup/fencing paths face different drop sites)
+metrics-smoke:   ## telemetry-plane acceptance: 2-rank in-process job with a
+                 ## non-empty KV scrape + health snapshot + prometheus lint,
+                 ## bfrun --status from a separate process, and the < 100 ns
+                 ## counter-increment microbench
+	JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
+
+chaos: metrics-smoke  ## tier-1 chaos subset, fault injection replayed at TWO
+                 ## seed offsets (BLUEFOG_CHAOS_SEED shifts every armed drop
+                 ## point, so reconnect/dedup/fencing — and the telemetry
+                 ## counters asserted against them — face different drop sites)
 	JAX_PLATFORMS=cpu BLUEFOG_CHAOS_SEED=3 $(PYTEST) tests/test_chaos.py -q -m "not slow"
 	JAX_PLATFORMS=cpu BLUEFOG_CHAOS_SEED=11 $(PYTEST) tests/test_chaos.py -q -m "not slow"
 

@@ -68,6 +68,10 @@ from .runtime.timeline import (
     timeline_context,
 )
 
+# telemetry plane: metrics registry + cluster health (docs/metrics.md)
+from .runtime import metrics
+from .runtime.metrics import cluster_health
+
 # ops
 from .ops import (
     allgather,

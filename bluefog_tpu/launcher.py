@@ -133,6 +133,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "spec e.g. 'drop_after=37,delay_ms=50,trunc=1,"
                         "seed=7' — see docs/fault_tolerance.md). Testing "
                         "only: never set on a production job")
+    p.add_argument("--status", action="store_true",
+                   help="print the job's cluster-health view (per-rank "
+                        "step counters, staleness, stragglers, push-sum "
+                        "mass conservation) from the control-plane KV and "
+                        "exit — works from OUTSIDE the job as long as "
+                        "BLUEFOG_CP_HOST/PORT (or --cp) and, for "
+                        "authenticated jobs, BLUEFOG_CP_SECRET are set. "
+                        "Ranks publish snapshots on the "
+                        "BLUEFOG_METRICS_INTERVAL cadence (docs/metrics.md)")
+    p.add_argument("--cp", type=str, default=None, metavar="HOST:PORT",
+                   help="control-plane address for --status (default: "
+                        "BLUEFOG_CP_HOST/BLUEFOG_CP_PORT, falling back to "
+                        "JAX_COORDINATOR_ADDRESS port + 17)")
     p.add_argument("--timeline-filename", type=str, default=None,
                    help="enable the timeline profiler, writing to this prefix")
     p.add_argument("--verbose", action="store_true",
@@ -441,8 +454,58 @@ def _fanout(args) -> int:
     return rc
 
 
+def _status(args) -> int:
+    """``bfrun --status``: the cluster-health view from outside the job.
+
+    Reads the packed per-rank snapshots the controllers publish under
+    ``bf.metrics.<rank>`` (runtime/metrics.py) over a plain control-plane
+    connection — no jax mesh, no membership registration, no job
+    interference (scalar gets only)."""
+    host = os.environ.get("BLUEFOG_CP_HOST")
+    port = int(os.environ["BLUEFOG_CP_PORT"]) \
+        if os.environ.get("BLUEFOG_CP_PORT") else None
+    if args.cp:
+        h, _, p = args.cp.partition(":")
+        if not p:
+            print("bfrun --status: --cp wants HOST:PORT", file=sys.stderr)
+            return 1
+        host, port = h, int(p)
+    if host is None or port is None:
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+        if coord and ":" in coord:
+            chost, _, cport = coord.partition(":")
+            host = host or chost
+            port = port or int(cport) + 17
+    if not host or not port:
+        print("bfrun --status: control-plane address unknown; pass "
+              "--cp HOST:PORT or set BLUEFOG_CP_HOST/BLUEFOG_CP_PORT",
+              file=sys.stderr)
+        return 1
+    from .runtime import metrics as _metrics
+    from .runtime.native import ControlPlaneClient
+
+    secret = os.environ.get("BLUEFOG_CP_SECRET", "")
+    try:
+        cl = ControlPlaneClient(host, port, 0, secret=secret, streams=1)
+    except (OSError, RuntimeError) as exc:
+        print(f"bfrun --status: cannot reach the control plane at "
+              f"{host}:{port} ({exc})", file=sys.stderr)
+        return 1
+    try:
+        health = _metrics.read_cluster_health(cl)
+        print(_metrics.format_health(health))
+        if not health["ranks"]:
+            print("  (no rank has published metrics — is "
+                  "BLUEFOG_METRICS_INTERVAL set on the job?)")
+    finally:
+        cl.close()
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.status:
+        return _status(args)
     if not args.command:
         build_parser().print_usage()
         return 1

@@ -60,13 +60,28 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import topology as topology_util
 from ..runtime import control_plane as _cp
 from ..runtime import handles as _handles
+from ..runtime import metrics as _metrics
 from ..runtime.logging import logger
 from ..runtime.state import _global_state
-from ..runtime.timeline import timeline_context
+from ..runtime.timeline import (timeline_context, timeline_counter,
+                                timeline_flow_finish, timeline_flow_start)
 from .neighbors import _check_rank_stacked, _per_rank
 from ..utils.compat import shard_map
 
 Weights = Union[float, Dict[int, float], Dict[int, Dict[int, float]]]
+
+
+def _op_timer(activity: str):
+    """Step-phase latency histogram for one window op ('WIN_PUT' ->
+    ``win.put_sec``): the quantitative complement of the timeline span
+    emitted next to it (docs/metrics.md)."""
+    return _metrics.timed(f"win.{activity[4:].lower()}_sec")
+
+
+# Flow-event name binding a deposit on the origin to its drain at the owner
+# (chrome flow id = the deposit tag's 39-bit (origin << 32 | counter)
+# sequence, identical on both sides of the wire).
+_FLOW_DEPOSIT = "WIN_DEPOSIT"
 
 
 class _LocalWinHost:
@@ -758,6 +773,10 @@ class Window:
         pend.seen.add(idx)
 
     def _finish_deposit(self, pair, pend: _PendingDeposit) -> None:
+        # close the origin's flow arrow: same id the sender emitted
+        # (the 39-bit (origin << 32 | counter) tag sequence)
+        timeline_flow_finish(_FLOW_DEPOSIT, pend.seq)
+        _metrics.counter("win.deposits_drained").inc()
         if pend.mode == _DEP_ACC:
             wire_t = _win_wire_dtype(self.mail_dtype)
             contrib = pend.staging.view(wire_t).reshape(self.row_shape)
@@ -836,12 +855,17 @@ class Window:
                         poll_names, pooled=pooled)),
                     poll_pairs)
 
+        drained_records = 0
+        drained_bytes = 0
         fetch, fetch_pairs = sweep(pairs)
         while True:
             batches, owner = fetch.result()
             cur_pairs, fetch = fetch_pairs, None
             got = any(batches)
             if got:
+                drained_records += sum(len(recs) for recs in batches)
+                drained_bytes += sum(
+                    len(r) for recs in batches for r in recs)
                 # Progress: sweep everything once more, streamed WHILE the
                 # records below fold (an empty extra sweep costs one RTT).
                 # Pool the next sweep only when THIS round hauled bulk
@@ -935,7 +959,13 @@ class Window:
                 # 200x/s while waiting on one slow origin
                 time.sleep(0.005)
                 fetch, fetch_pairs = sweep(sorted(partial), pooled=False)
+        if drained_records:
+            _metrics.counter("win.drain_records").inc(drained_records)
+            _metrics.counter("win.drain_bytes").inc(drained_bytes)
+            # counter track next to the WIN_UPDATE span that did the drain
+            timeline_counter("win.drained_records", drained_records)
         if orphans:
+            _metrics.counter("win.drain_orphans").inc(orphans)
             logger.debug(
                 "window '%s': discarded %d orphaned deposit chunk(s) left "
                 "by a concurrent clear", self.name, orphans)
@@ -1547,7 +1577,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
     if require_mutex:
         _acquire_all(win, touched)
     try:
-        with timeline_context(win.name, activity), win.state_mu:
+        with timeline_context(win.name, activity), _op_timer(activity), \
+                win.state_mu:
             use_p = st.win_ops_with_associated_p
             if not from_get:
                 # batched owned-only read: the hosted hot path never pays
@@ -1575,6 +1606,7 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 dep_blobs: List = []  # bytes headers + zero-copy np views
                 dep_tags: List[int] = []  # (seq, index) per record
                 dep_edge_of: List[Tuple[int, int, int]] = []  # per record
+                dep_flows: List[Tuple[Tuple[int, int, int], int]] = []
                 deposited = set()
                 try:
                     for src in win.owned:
@@ -1609,6 +1641,11 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                     origin=st.process_index))
                                 dep_edge_of.extend(
                                     [(src, dst, k)] * len(recs))
+                                # flow id == the drain-side tag sequence
+                                dep_flows.append((
+                                    (src, dst, k),
+                                    ((st.process_index & 0x7F) << 32)
+                                    | (win._dep_seq & 0xFFFFFFFF)))
                         # post-send self scaling (push-sum down-weighting)
                         win._rows[src] = (
                             rows[src].astype(acc_t) * np.asarray(
@@ -1639,12 +1676,24 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                             e for i, e in enumerate(dep_edge_of)
                             if replies[i] >= 0 and e not in full)
                     if full:
+                        _metrics.counter("win.deposits_rejected").inc(
+                            len(full))
                         raise RuntimeError(
                             f"window '{win.name}': deposit mailbox full "
                             f"for edges (src, dst, slot) {sorted(full)} "
                             "(server byte cap, BLUEFOG_CP_MAILBOX_MAX_MB) "
                             "— the owning controller has not drained; it "
                             "may be dead (check bf.dead_controllers())")
+                    # cross-process trace correlation: one flow arrow per
+                    # LANDED remote deposit, id = the tag sequence the
+                    # owner's drain recovers from the wire
+                    sent = 0
+                    for edge, fid in dep_flows:
+                        if edge in deposited:
+                            timeline_flow_start(_FLOW_DEPOSIT, fid)
+                            sent += 1
+                    if sent:
+                        _metrics.counter("win.deposits_sent").inc(sent)
                     win._publish_selves(win.owned)
                 except Exception:
                     # un-bump the edges whose deposits never landed (e.g. a
@@ -1757,7 +1806,8 @@ def _do_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
     fn = win._exchange_fn(accumulate, donate, identity_self)
     _acquire(win, touched, require_mutex)
     try:
-        with timeline_context(win.name, activity), win.state_mu:
+        with timeline_context(win.name, activity), _op_timer(activity), \
+                win.state_mu:
             new_self, new_mail = fn(
                 source if not from_get else win.self_value, win.mail,
                 np.asarray(w), np.asarray(active), sw_arr)
@@ -1937,7 +1987,7 @@ def win_update(
         return _hosted_update(win, sw_list, nw_table, nw, read_mask,
                               reset, clone, require_mutex)
 
-    with timeline_context(name, "WIN_UPDATE"):
+    with timeline_context(name, "WIN_UPDATE"), _op_timer("WIN_UPDATE"):
         _acquire(win, range(n), require_mutex)
         win.state_mu.acquire()
         try:
@@ -1981,7 +2031,7 @@ def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
     st = _global_state()
     acc_t = np.dtype(_win_acc_dtype(win.mail_dtype))
     lay = win.layout
-    with timeline_context(win.name, "WIN_UPDATE"):
+    with timeline_context(win.name, "WIN_UPDATE"), _op_timer("WIN_UPDATE"):
         # lock only OWNED ranks (the reference's win_update locks the local
         # window; remote ranks' updates are their owners' job)
         if require_mutex:
@@ -2078,7 +2128,7 @@ def win_fence(name: str) -> bool:
     drains its ranks' server mailboxes -> barrier (all owners folded).
     """
     win = _get_window(name)
-    with timeline_context(name, "WIN_FENCE"):
+    with timeline_context(name, "WIN_FENCE"), _op_timer("WIN_FENCE"):
         win.host.flush()
         if win.hosted:
             with win.state_mu:

@@ -77,6 +77,7 @@ from .ops.neighbors import _dynamic_weight_matrix, _static_weight_matrix
 from .ops.plan import CombinePlan, spmd_combine
 from .runtime import control_plane as _cp
 from .runtime import heartbeat as _hb
+from .runtime import metrics as _metrics
 from .runtime.logging import logger
 from .runtime.native import PeerLostError
 from .runtime.state import _global_state
@@ -317,9 +318,11 @@ class _FusedOptimizer:
         if fn is None:
             fn = self._build(key, plan, do_comm)
             self._step_cache[key] = fn
-        with timeline_context(self.name, "STEP"):
+        with timeline_context(self.name, "STEP"), \
+                _metrics.timed("opt.step_sec"):
             params, opt_state, model_state, metrics = fn(
                 w, state.params, state.opt_state, state.model_state, batch)
+        _metrics.gauge("opt.step").set(self._counter)
         return TrainState(params, opt_state, model_state), metrics
 
 
@@ -876,7 +879,9 @@ class _WindowOptimizer(_FusedOptimizer):
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict]:
         self._counter += 1
         do_comm = (self._counter % self.num_steps_per_communication) == 0
-        with timeline_context(self.name, "STEP"):
+        _metrics.gauge("opt.step").set(self._counter)
+        with timeline_context(self.name, "STEP"), \
+                _metrics.timed("opt.step_sec"):
             state, metrics = self._local_step(state, batch)
             if not do_comm:
                 return state, metrics
@@ -895,34 +900,40 @@ class _WindowOptimizer(_FusedOptimizer):
             # tried and measured ~45 ms SLOWER at MLP scale on the CPU
             # mesh: the in-program concat defeats the donated in-place
             # optimizer update.)
-            with timeline_context(self.name, "PACK"):
+            with timeline_context(self.name, "PACK"), \
+                    _metrics.timed("opt.pack_sec"):
                 packed = [
                     _fusion.pack_jit([leaves[i] for i in idxs], spec)
                     for idxs, spec in zip(self._groups, self._specs)
                 ]
-            if self._fused_pack:
-                # Single window: one mutex acquisition spans the whole
-                # put+update pair (inner acquires are local depth bumps).
-                # A PeerLostError here comes from the hoisted acquire —
-                # BEFORE any data op, so retrying is side-effect-free: the
-                # dead holder's lock was force-released server-side, and
-                # _gossip recomputes its edge tables against the (now
-                # updated) dead set, continuing on the shrunken graph.
-                for attempt in (0, 1):
-                    try:
-                        with self._hoisted_mutex(self._win_names[0],
-                                                 self._dead_ranks()):
-                            mixed = self._gossip(packed)
-                        break
-                    except PeerLostError as exc:
-                        if attempt:
-                            raise
-                        logger.warning(
-                            "gossip step hit a dead peer (%s); retrying "
-                            "once on the self-healed topology", exc)
-            else:
-                mixed = self._gossip(packed)
-            with timeline_context(self.name, "UNPACK"):
+            with _metrics.timed("opt.gossip_sec"):
+                if self._fused_pack:
+                    # Single window: one mutex acquisition spans the whole
+                    # put+update pair (inner acquires are local depth
+                    # bumps). A PeerLostError here comes from the hoisted
+                    # acquire — BEFORE any data op, so retrying is
+                    # side-effect-free: the dead holder's lock was
+                    # force-released server-side, and _gossip recomputes
+                    # its edge tables against the (now updated) dead set,
+                    # continuing on the shrunken graph.
+                    for attempt in (0, 1):
+                        try:
+                            with self._hoisted_mutex(self._win_names[0],
+                                                     self._dead_ranks()):
+                                mixed = self._gossip(packed)
+                            break
+                        except PeerLostError as exc:
+                            if attempt:
+                                raise
+                            _metrics.counter("opt.gossip_retries").inc()
+                            logger.warning(
+                                "gossip step hit a dead peer (%s); "
+                                "retrying once on the self-healed "
+                                "topology", exc)
+                else:
+                    mixed = self._gossip(packed)
+            with timeline_context(self.name, "UNPACK"), \
+                    _metrics.timed("opt.unpack_sec"):
                 out = list(leaves)
                 for idxs, spec, buf in zip(self._groups, self._specs,
                                            mixed):
@@ -962,6 +973,7 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
             key = ("put", frozenset(dead))
             cached = None if custom else self._healed_cache.get(key)
             if cached is None:
+                _metrics.counter("opt.healed_rebuilds").inc()
                 sw, nw = _healed_recv_weights(win, dead, self_weight,
                                               neighbor_weights)
                 cached = (_healed_send_table(win, dead, dst_weights), sw, nw)
@@ -1012,6 +1024,7 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
             key = ("get", frozenset(dead))
             cached = None if custom else self._healed_cache.get(key)
             if cached is None:
+                _metrics.counter("opt.healed_rebuilds").inc()
                 # pull only from LIVE sources (a dead peer's published
                 # tensor goes stale, and at re-publish races it could tear
                 # mass) and renormalize the combine over the live in-sets
@@ -1062,10 +1075,33 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         super().__init__(*args, **kw)
         st = _global_state()
         self._prior_associated_p = st.win_ops_with_associated_p
+        self._reminted = False
         _windows.turn_on_win_ops_with_associated_p()
 
     def _restore_flags(self) -> None:
         _global_state().win_ops_with_associated_p = self._prior_associated_p
+
+    def init(self, params, model_state=None) -> TrainState:
+        # Mass-conservation accounting for the health plane: `minted` is
+        # the de-bias mass this controller CREATED (p=1 per owned rank at
+        # window creation, or at a checkpoint-fallback re-mint); a rejoin
+        # via the donor mass split transfers mass without minting, so the
+        # cluster-wide sum(mass) == sum(minted) invariant survives it
+        # (bf.cluster_health's drift check; docs/metrics.md).
+        was_rejoining = _hb.quarantine_pending()
+        self._reminted = False
+        state = super().init(params, model_state)
+        minted = 0.0
+        mass = 0.0
+        for nm in self._win_names:
+            win = _windows._get_window(nm)
+            if not was_rejoining or self._reminted:
+                minted += float(len(win.owned))
+            p = win.host.read_p()
+            mass += float(np.sum(np.asarray(p)[list(win.owned)]))
+        _metrics.gauge("pushsum.minted").set(minted)
+        _metrics.gauge("pushsum.mass").set(mass)
+        return state
 
     def _gossip(self, leaves):
         st = _global_state()
@@ -1082,6 +1118,8 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         key = frozenset(dead)
         cached = self._healed_cache.get(key)
         if cached is None:
+            if dead:  # the empty-set entry is the initial build, not a heal
+                _metrics.counter("opt.healed_rebuilds").inc()
             out_nbrs = {
                 r: [d for d in
                     topology_util.out_neighbor_ranks(st.topology, r)
@@ -1096,6 +1134,8 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         else:
             sw, dw = cached
         out = []
+        mass = 0.0
+        drift = 0.0
         for nm, leaf in zip(self._win_names, leaves):
             win = st.windows[nm]
             # numerator = x * p  (x is the de-biased parameter)
@@ -1109,8 +1149,18 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
             collected = _windows.win_update_then_collect(
                 nm, require_mutex=self.require_mutex)
             p_new = _windows.win_associated_p_all(nm)
+            owned = list(win.owned)
+            p_own = np.asarray(p_new)[owned]
+            mass += float(np.sum(p_own))
+            drift = max(drift, float(np.max(np.abs(p_own - 1.0)))
+                        if len(owned) else 0.0)
             out.append(collected / np.asarray(p_new, collected.dtype).reshape(
                 (n,) + (1,) * (collected.ndim - 1)))
+        # health-plane gauges: this controller's share of the global
+        # push-sum mass (summed across controllers by bf.cluster_health)
+        # and how far the de-bias scalar has wandered from neutral
+        _metrics.gauge("pushsum.mass").set(mass)
+        _metrics.gauge("pushsum.debias_drift").set(drift)
         return out
 
     # -- elastic rejoin with exact mass conservation -----------------------
@@ -1206,6 +1256,7 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
 
     def _reseed_windows(self, state: TrainState) -> None:
         super()._reseed_windows(state)
+        self._reminted = True
         # checkpoint fallback re-mints unit mass for the restored ranks:
         # exact conservation is only possible via the donor split (the old
         # incarnation's mass died with it and no donor is reachable)

@@ -37,7 +37,9 @@ import time
 from typing import Dict, Optional
 
 from . import control_plane as _cp
+from . import metrics as _metrics
 from .logging import logger
+from .timeline import timeline_instant
 
 _FLAG = "bf.shutdown.flag."
 _ACK = "bf.shutdown.ack."
@@ -179,6 +181,8 @@ class PeerMonitor:
                     # re-admission gate below clears it.
                     self._suspect.add(peer)
                     self._bump_epoch(cl)
+                    _metrics.counter("hb.suspect_transitions").inc()
+                    timeline_instant(f"controller.{peer}", "SUSPECT")
                     logger.error(
                         "controller %d heartbeat RESUMED without a new "
                         "incarnation registration — keeping it out of live "
@@ -195,6 +199,8 @@ class PeerMonitor:
                 except OSError:
                     self._dead_inc[peer] = 0
                 self._bump_epoch(cl)
+                _metrics.counter("hb.dead_transitions").inc()
+                timeline_instant(f"controller.{peer}", "DEAD")
                 logger.error(
                     "controller %d heartbeat missing for %.0f s — peer "
                     "failure detected; collectives involving its devices "
@@ -219,6 +225,8 @@ class PeerMonitor:
                 self._dead.discard(peer)
                 self._dead_inc[peer] = inc
                 self._bump_epoch(cl)
+                _metrics.counter("hb.readmissions").inc()
+                timeline_instant(f"controller.{peer}", "READMIT")
                 logger.warning(
                     "controller %d re-admitted to live membership: "
                     "incarnation %d registered and quarantine complete — "
@@ -230,6 +238,14 @@ class PeerMonitor:
                 self._epoch = shared
         except OSError:
             pass
+        # Telemetry plane: mirror membership into the registry, then let
+        # the interval-gated publisher piggyback this tick (the whole
+        # cluster-health publication costs zero extra threads and no
+        # per-step RTT in multi-controller jobs).
+        _metrics.gauge("membership.epoch").set(self._epoch)
+        _metrics.gauge("hb.dead_peers").set(len(self._dead))
+        _metrics.gauge("hb.suspect_peers").set(len(self._suspect))
+        _metrics.maybe_publish(cl)
         if not self._shutdown_seen.is_set() and any(
                 cl.get(f"{_FLAG}{p}") for p in range(self.world)
                 if p != self.me):
@@ -368,7 +384,7 @@ def membership_epoch() -> int:
 # survivors keep its ranks out of averaging until `complete_quarantine`
 # publishes phase 2 — the re-admission gate PeerMonitor._tick checks.
 
-_q_state = {"pending": False, "pid": 0, "inc": 0}
+_q_state = {"pending": False, "pid": 0, "inc": 0, "t0": 0.0}
 
 
 def quarantine_pending() -> bool:
@@ -385,7 +401,10 @@ def enter_quarantine(process_index: int) -> None:
     if not _cp.active() or inc <= 0:
         _q_state["pending"] = False
         return
-    _q_state.update(pending=True, pid=process_index, inc=inc)
+    _q_state.update(pending=True, pid=process_index, inc=inc,
+                    t0=time.monotonic())
+    _metrics.counter("hb.quarantine_entries").inc()
+    timeline_instant(f"controller.{process_index}", "QUARANTINE_ENTER")
     try:
         _cp.client().put(f"{_QUARANTINE}{process_index}.{inc}", _Q_ENTERED)
     except OSError as exc:
@@ -402,6 +421,11 @@ def complete_quarantine() -> None:
     if not _q_state["pending"]:
         return
     _q_state["pending"] = False
+    # quarantine duration: how long this rank sat fenced-but-transferring —
+    # the elastic-rejoin latency the health plane watches
+    _metrics.histogram("hb.quarantine_sec").observe(
+        time.monotonic() - _q_state["t0"])
+    timeline_instant(f"controller.{_q_state['pid']}", "QUARANTINE_COMPLETE")
     try:
         cl = _cp.client()
         cl.put(f"{_QUARANTINE}{_q_state['pid']}.{_q_state['inc']}",

@@ -25,16 +25,46 @@ logging.addLevelName(_LEVELS["trace"], "TRACE")
 logger = logging.getLogger("bluefog_tpu")
 
 
+class _RankPrefixFilter(logging.Filter):
+    """Injects a ``[rank r / inc i]`` prefix once ``bf.init`` has run.
+
+    Interleaved multi-process logs (bfrun fan-out multiplexes every
+    child's stderr onto one terminal) are unattributable without it. The
+    identity is resolved LAZILY per record — at import time neither the
+    process index nor the incarnation exists yet — and any failure
+    degrades to an empty prefix: log formatting must never raise.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.bfprefix = self._prefix()
+        return True
+
+    @staticmethod
+    def _prefix() -> str:
+        try:
+            from .state import _global_state
+
+            st = _global_state()
+            if not st.initialized:
+                return ""
+            from . import control_plane as _cp
+
+            return f"[rank {st.process_index} / inc {_cp.incarnation()}] "
+        except Exception:  # noqa: BLE001 — formatting must never raise
+            return ""
+
+
 def _configure() -> None:
     if logger.handlers:
         return
     level = _LEVELS.get(os.environ.get("BLUEFOG_LOG_LEVEL", "warn").lower(),
                         logging.WARNING)
     hide_time = os.environ.get("BLUEFOG_LOG_HIDE_TIME", "0") == "1"
-    fmt = "[%(levelname)s] %(message)s" if hide_time else \
-        "%(asctime)s [%(levelname)s] %(message)s"
+    fmt = "[%(levelname)s] %(bfprefix)s%(message)s" if hide_time else \
+        "%(asctime)s [%(levelname)s] %(bfprefix)s%(message)s"
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(logging.Formatter(fmt))
+    handler.addFilter(_RankPrefixFilter())
     logger.addHandler(handler)
     logger.setLevel(level)
     logger.propagate = False

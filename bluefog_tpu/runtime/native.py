@@ -96,6 +96,13 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.bf_timeline_close.restype = None
     lib.bf_timeline_close.argtypes = [ctypes.c_void_p]
+    # arg-carrying events (r10): counter tracks ('C') and flow binding
+    # ('s'/'f') need an int64 value/id alongside the classic fields
+    lib.bf_timeline_event2.restype = None
+    lib.bf_timeline_event2.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char,
+        ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+    ]
 
     lib.bf_cp_serve.restype = ctypes.c_void_p
     lib.bf_cp_serve.argtypes = [ctypes.c_int, ctypes.c_int]
@@ -199,6 +206,13 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_cp_server_mailbox_from.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.bf_cp_server_incarnation.restype = ctypes.c_longlong
     lib.bf_cp_server_incarnation.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    # telemetry counter blocks (r10 observability)
+    lib.bf_cp_client_counters.restype = ctypes.c_int
+    lib.bf_cp_client_counters.argtypes = [
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+    lib.bf_cp_server_counters.restype = ctypes.c_int
+    lib.bf_cp_server_counters.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
     # fault injection + dead-connection hooks (r8 fault tolerance)
     lib.bf_cp_fault.restype = None
     lib.bf_cp_fault.argtypes = [ctypes.c_longlong, ctypes.c_int,
@@ -275,6 +289,55 @@ def fault_stats() -> dict:
         return {"ops": 0, "drops": 0}
     return {"ops": int(lib.bf_cp_fault_ops()),
             "drops": int(lib.bf_cp_fault_drops())}
+
+
+# Op-class names for the telemetry counter block (mirrors enum Op in
+# csrc/bf_runtime.cc; index = op code).
+_OP_NAMES = {
+    1: "barrier", 2: "lock", 3: "unlock", 4: "fetch_add", 5: "put",
+    6: "get", 7: "shutdown", 8: "append_bytes", 9: "take_bytes",
+    10: "put_bytes", 11: "get_bytes", 12: "box_bytes",
+    13: "append_bytes_tagged", 14: "put_bytes_part", 15: "bytes_len",
+    16: "get_bytes_part", 17: "seq_pre", 18: "attach",
+}
+
+_CL_SLOTS = 100  # 3*32 per-op triples + 4 event counters (csrc layout)
+
+
+def client_stats() -> dict:
+    """Cumulative native-client transport counters for this process.
+
+    ``ops`` / ``bytes_out`` / ``bytes_in`` are keyed by op class (zero
+    rows suppressed); ``redials`` counts successful transparent
+    reconnects, ``redial_attempts`` every dial tried, ``stale_frames``
+    incarnation-fence verdicts observed on the wire, and
+    ``striped_transfers`` whole striped put/get operations. Counters are
+    process-global and never reset — consumers (the metrics registry)
+    report deltas against their own baseline. Empty dict when the native
+    runtime is unavailable."""
+    lib = load()
+    if lib is None:
+        return {}
+    buf = (ctypes.c_longlong * _CL_SLOTS)()
+    if lib.bf_cp_client_counters(buf, _CL_SLOTS) < 0:
+        return {}
+    ops, b_out, b_in = {}, {}, {}
+    for code, name in _OP_NAMES.items():
+        if buf[code]:
+            ops[name] = int(buf[code])
+        if buf[32 + code]:
+            b_out[name] = int(buf[32 + code])
+        if buf[64 + code]:
+            b_in[name] = int(buf[64 + code])
+    return {
+        "ops": ops,
+        "bytes_out": b_out,
+        "bytes_in": b_in,
+        "redials": int(buf[96]),
+        "redial_attempts": int(buf[97]),
+        "stale_frames": int(buf[98]),
+        "striped_transfers": int(buf[99]),
+    }
 
 
 def _arm_fault_from_env(lib) -> None:
@@ -524,6 +587,37 @@ class ControlPlaneServer:
     def incarnation_of(self, rank: int) -> int:
         """Registered incarnation of ``rank`` (-1 = never attached)."""
         return int(self._lib.bf_cp_server_incarnation(self._h, rank))
+
+    _SRV_SLOTS = 43  # 32 per-op counts + 11 aggregates (csrc layout)
+
+    def stats(self) -> dict:
+        """Server-side telemetry: per-op dispatch counts (zero rows
+        suppressed) plus the live aggregates the health plane publishes —
+        connection count, queued mailbox depth/bytes, held locks — and the
+        fault/recovery event counters (lock force-releases, barrier
+        withdrawals, dedup replays, fenced ops)."""
+        if not self._h:
+            return {}
+        buf = (ctypes.c_longlong * self._SRV_SLOTS)()
+        if self._lib.bf_cp_server_counters(self._h, buf,
+                                           self._SRV_SLOTS) < 0:
+            return {}
+        ops = {name: int(buf[code]) for code, name in _OP_NAMES.items()
+               if buf[code]}
+        return {
+            "ops": ops,
+            "live_connections": int(buf[32]),
+            "mailbox_records": int(buf[33]),
+            "mailbox_bytes": int(buf[34]),
+            "locks_held": int(buf[35]),
+            "lock_force_releases": int(buf[36]),
+            "barrier_withdrawals": int(buf[37]),
+            "dedup_replays": int(buf[38]),
+            "stale_rejects": int(buf[39]),
+            "kv_entries": int(buf[40]),
+            "bytes_slots": int(buf[41]),
+            "bytes_slot_bytes": int(buf[42]),
+        }
 
     def __enter__(self):
         return self

@@ -150,6 +150,11 @@ def init(
     # BLUEFOG_CP_HOST is set (runtime/control_plane.py).
     from . import control_plane as _cp
     _cp.attach()
+    # Fresh telemetry epoch for the job: instruments zero in place (cached
+    # bound methods in subsystems stay valid) and the native transport
+    # counter block re-baselines, so snapshots report this job's deltas.
+    from . import metrics as _metrics
+    _metrics.reset_for_job()
     if devices is None and st.config.simulate_devices > 0:
         # bfrun --simulate N: rank over forced-CPU devices even when an
         # accelerator backend registered (launcher.py:62-68). N counts
@@ -251,6 +256,12 @@ def init(
         st.peer_monitor = PeerMonitor(st.process_index, st.process_count)
         st.peer_monitor.start()
 
+    # Telemetry publication (BLUEFOG_METRICS_INTERVAL / _PROM): the
+    # heartbeat tick carries it in multi-controller jobs; single-controller
+    # jobs get a dedicated cadence thread (runtime/metrics.py).
+    _metrics.start_publisher_if_needed(
+        has_heartbeat=st.peer_monitor is not None)
+
     logger.info(
         "bluefog_tpu initialized: %d rank(s) on %s, local_size=%d",
         st.size, st.devices[0].platform, st.local_size,
@@ -275,6 +286,15 @@ def shutdown(_announce: bool = True) -> None:
         # Coordinated: peers learn the job is ending BEFORE this process
         # (possibly the control-plane server host) tears anything down.
         announce_shutdown(st.process_index, st.process_count)
+    from . import metrics as _metrics
+    if _metrics.publication_enabled():
+        # final flush: short jobs (and clean exits generally) leave a
+        # current scrape + KV snapshot even if no cadence tick ever fired
+        try:
+            _metrics.publish_now()
+        except Exception:  # noqa: BLE001 — teardown must not raise
+            pass
+    _metrics.stop_publisher()
     if st.peer_monitor is not None:
         st.peer_monitor.stop()
         st.peer_monitor = None

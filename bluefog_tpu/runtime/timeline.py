@@ -28,6 +28,10 @@ import jax
 
 from .logging import logger
 
+# Counter-event name anchoring each per-process trace to the wall clock;
+# scripts/merge_timelines.py keys on it to align files before merging.
+CLOCK_SYNC_COUNTER = "bf.clock_sync_us"
+
 
 class Timeline:
     """Streaming chrome-tracing writer with named activities per (tensor, lane)."""
@@ -72,6 +76,13 @@ class Timeline:
                 target=self._writer_loop, name="bf-timeline-writer", daemon=True
             )
             self._writer.start()
+        # Clock-sync anchor: timestamps are a per-process perf_counter
+        # origin, useless across processes until anchored to a shared
+        # clock. The first event of every trace is a counter carrying the
+        # wall-clock microseconds at (approximately) ts=0;
+        # scripts/merge_timelines.py shifts each file onto the common
+        # wall-clock axis using (value - ts) before concatenating.
+        self.counter(CLOCK_SYNC_COUNTER, time.time_ns() // 1000)
 
     # -- producer side (any thread) ---------------------------------------
 
@@ -131,6 +142,55 @@ class Timeline:
         finally:
             self.activity_end(tensor_name, tid)
 
+    # -- counter + flow events (r10 trace correlation) ---------------------
+
+    def counter(self, name: str, value: int, tid: int = 0) -> None:
+        """Chrome counter-track sample (``ph: "C"``): mailbox depth,
+        push-sum mass, and the clock-sync anchor ride these."""
+        if self._failed or self._closed:
+            return
+        if self._native is not None:
+            with self._native_mu:
+                if self._native is not None:
+                    self._native_lib.bf_timeline_event2(
+                        self._native, name.encode(), b"bf", b"C",
+                        int(self._now_us()), tid, int(value))
+            return
+        self._q.put(
+            {"name": name, "cat": "bf", "ph": "C", "ts": self._now_us(),
+             "pid": self._pid, "tid": tid, "args": {"value": int(value)}}
+        )
+
+    def _flow(self, phase: bytes, name: str, flow_id: int, tid: int) -> None:
+        if self._failed or self._closed:
+            return
+        if self._native is not None:
+            with self._native_mu:
+                if self._native is not None:
+                    self._native_lib.bf_timeline_event2(
+                        self._native, name.encode(), b"bf.flow", phase,
+                        int(self._now_us()), tid, int(flow_id))
+            return
+        ev = {"name": name, "cat": "bf.flow", "ph": phase.decode(),
+              "id": int(flow_id), "ts": self._now_us(), "pid": self._pid,
+              "tid": tid}
+        if phase == b"f":
+            ev["bp"] = "e"  # bind to the enclosing slice
+        self._q.put(ev)
+
+    def flow_start(self, name: str, flow_id: int, tid: int = 0) -> None:
+        """Open a cross-process flow arrow (``ph: "s"``). The id is the
+        binding key: the hosted window plane uses the deposit tag's
+        ``(origin << 32) | counter`` sequence, which the draining side
+        recovers from the wire, so a ``win_put`` on rank A visually
+        connects to its drain inside rank B's ``win_update`` when the
+        per-rank trace files are merged."""
+        self._flow(b"s", name, flow_id, tid)
+
+    def flow_finish(self, name: str, flow_id: int, tid: int = 0) -> None:
+        """Close a flow arrow (``ph: "f"``, bound to the enclosing slice)."""
+        self._flow(b"f", name, flow_id, tid)
+
     # -- writer side -------------------------------------------------------
 
     def _writer_loop(self) -> None:
@@ -186,6 +246,40 @@ def timeline_end_activity(tensor_name: str, tid: int = 0) -> bool:
     if tl is None:
         return False
     tl.activity_end(tensor_name, tid)
+    return True
+
+
+def timeline_counter(name: str, value, tid: int = 0) -> bool:
+    """Sample a chrome counter track (no-op when the timeline is off)."""
+    tl = _timeline()
+    if tl is None:
+        return False
+    tl.counter(name, int(value), tid)
+    return True
+
+
+def timeline_instant(tensor_name: str, activity: str, tid: int = 0) -> bool:
+    """Emit an instant event (stall warnings, membership transitions)."""
+    tl = _timeline()
+    if tl is None:
+        return False
+    tl.instant(tensor_name, activity, tid)
+    return True
+
+
+def timeline_flow_start(name: str, flow_id: int, tid: int = 0) -> bool:
+    tl = _timeline()
+    if tl is None:
+        return False
+    tl.flow_start(name, flow_id, tid)
+    return True
+
+
+def timeline_flow_finish(name: str, flow_id: int, tid: int = 0) -> bool:
+    tl = _timeline()
+    if tl is None:
+        return False
+    tl.flow_finish(name, flow_id, tid)
     return True
 
 

@@ -13,7 +13,9 @@ from __future__ import annotations
 import threading
 
 from . import handles
+from . import metrics as _metrics
 from .logging import logger
+from .timeline import timeline_instant
 
 
 class StallWatchdog:
@@ -61,6 +63,11 @@ class StallWatchdog:
             }
             for h, (name, age) in stalled.items():
                 self._warned.add(h)
+                # stalls are part of the telemetry plane, not just stderr:
+                # a counter for the scrape and an instant event in the
+                # trace, right where the silence is
+                _metrics.counter("watchdog.stalls").inc()
+                timeline_instant(name, "STALL")
                 logger.warning(
                     "op '%s' (handle %d) has not completed for %.0f s; "
                     "likely a hung multi-host collective (some host absent)",

@@ -51,9 +51,10 @@ namespace {
 struct TimelineEvent {
   std::string name;
   std::string cat;
-  char phase;      // 'B', 'E', 'i'
+  char phase;      // 'B', 'E', 'i', 'C' (counter), 's'/'f' (flow)
   int64_t ts_us;
   int tid;
+  int64_t arg = 0;  // counter value ('C') or flow id ('s'/'f')
 };
 
 struct Timeline {
@@ -110,6 +111,27 @@ struct Timeline {
             "\"pid\": %d, \"tid\": %d}",
             cat.c_str(), (long long)ev.ts_us, pid, ev.tid);
         break;
+      case 'C':
+        // chrome counter track: one series named after the event
+        std::fprintf(f,
+            "{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"C\", "
+            "\"ts\": %lld, \"pid\": %d, \"tid\": %d, "
+            "\"args\": {\"value\": %lld}}",
+            name.c_str(), cat.c_str(), (long long)ev.ts_us, pid, ev.tid,
+            (long long)ev.arg);
+        break;
+      case 's':
+      case 'f':
+        // flow events bind across processes by (cat, id) once per-rank
+        // trace files are merged (scripts/merge_timelines.py); 'f' carries
+        // binding point "e" so the arrow lands on the enclosing slice.
+        std::fprintf(f,
+            "{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"%c\", "
+            "\"id\": %lld%s, \"ts\": %lld, \"pid\": %d, \"tid\": %d}",
+            name.c_str(), cat.c_str(), ev.phase, (long long)ev.arg,
+            ev.phase == 'f' ? ", \"bp\": \"e\"" : "",
+            (long long)ev.ts_us, pid, ev.tid);
+        break;
       default:
         std::fprintf(f,
             "{\"name\": \"%s\", \"cat\": \"%s\", \"ph\": \"i\", \"s\": \"t\", "
@@ -133,16 +155,21 @@ void* bf_timeline_open(const char* path, int pid) {
   return tl;
 }
 
-void bf_timeline_event(void* handle, const char* name, const char* cat,
-                       char phase, int64_t ts_us, int tid) {
+void bf_timeline_event2(void* handle, const char* name, const char* cat,
+                        char phase, int64_t ts_us, int tid, int64_t arg) {
   auto* tl = static_cast<Timeline*>(handle);
   {
     std::lock_guard<std::mutex> lk(tl->mu);
     if (tl->closing) return;
     tl->q.push_back(TimelineEvent{name ? name : "", cat ? cat : "",
-                                  phase, ts_us, tid});
+                                  phase, ts_us, tid, arg});
   }
   tl->cv.notify_one();
+}
+
+void bf_timeline_event(void* handle, const char* name, const char* cat,
+                       char phase, int64_t ts_us, int tid) {
+  bf_timeline_event2(handle, name, cat, phase, ts_us, tid, 0);
 }
 
 void bf_timeline_close(void* handle) {
@@ -286,6 +313,29 @@ int FaultNext() {
 void FaultDelay() {
   int ms = g_fault_delay_ms.load(std::memory_order_relaxed);
   if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
+
+// -- client telemetry counter block (r10 observability) ---------------------
+//
+// Process-global relaxed atomics, always on: the per-op cost is one to three
+// relaxed fetch_adds next to a syscall-bound socket write — unmeasurable on
+// the wire. Read (never reset) from Python via bf_cp_client_counters(); the
+// metrics registry reports deltas against its own baseline.
+constexpr int kOpSlots = 32;  // op codes are < 32; slot = op & 31
+std::atomic<long long> g_cl_ops[kOpSlots];
+std::atomic<long long> g_cl_bytes_out[kOpSlots];
+std::atomic<long long> g_cl_bytes_in[kOpSlots];
+std::atomic<long long> g_cl_redials{0};         // successful reconnects
+std::atomic<long long> g_cl_redial_attempts{0}; // dials tried (incl. failed)
+std::atomic<long long> g_cl_stale_frames{0};    // kStaleFrame verdicts seen
+std::atomic<long long> g_cl_striped_xfers{0};   // whole striped put/get ops
+
+inline void ClOut(uint8_t op, long long bytes) {
+  g_cl_ops[op & 31].fetch_add(1, std::memory_order_relaxed);
+  g_cl_bytes_out[op & 31].fetch_add(bytes, std::memory_order_relaxed);
+}
+inline void ClIn(uint8_t op, long long bytes) {
+  g_cl_bytes_in[op & 31].fetch_add(bytes, std::memory_order_relaxed);
 }
 
 // -- SHA-256 / HMAC-SHA256 (self-contained; no OpenSSL in the image) --------
@@ -515,6 +565,17 @@ struct ControlServer {
   std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
   std::map<std::string, int> barrier_count;
 
+  // Telemetry counter block (r10): per-op dispatch counts plus the fault/
+  // recovery events the Python metrics registry surfaces (lock force-
+  // releases, barrier withdrawals, dedup replays, fenced ops). Relaxed
+  // atomics — the aggregate gauges (mailbox depth/bytes, live connections)
+  // are computed under `mu` by bf_cp_server_counters instead.
+  std::atomic<long long> srv_ops[32] = {};
+  std::atomic<long long> srv_lock_force_releases{0};
+  std::atomic<long long> srv_barrier_withdrawals{0};
+  std::atomic<long long> srv_dedup_replays{0};
+  std::atomic<long long> srv_stale_rejects{0};
+
   // Has the peer closed its end? Used by blocked lock/barrier waiters: the
   // protocol is strictly request-reply with one outstanding request per
   // connection, so readable-or-EOF while WE owe the reply can only mean the
@@ -538,6 +599,7 @@ struct ControlServer {
         it.second.fd = -1;
         ++it.second.epoch;
         released = true;
+        srv_lock_force_releases.fetch_add(1, std::memory_order_relaxed);
       }
     }
     if (released) cv.notify_all();
@@ -558,6 +620,7 @@ struct ControlServer {
         it.second.fd = -1;
         ++it.second.epoch;
         released = true;
+        srv_lock_force_releases.fetch_add(1, std::memory_order_relaxed);
       }
     }
     auto rc = rank_cids.find(rank);
@@ -666,6 +729,7 @@ struct ControlServer {
       bool quit = false;
       bool replied = false;
       bool conn_abort = false;
+      srv_ops[op & 31].fetch_add(1, std::memory_order_relaxed);
 
       // Incarnation fence: once this connection's registered incarnation is
       // superseded, NO op is applied — every request is answered with the
@@ -680,6 +744,7 @@ struct ControlServer {
         }
         if (is_stale) {
           ded_left = 0;
+          srv_stale_rejects.fetch_add(1, std::memory_order_relaxed);
           if (op == kSeqPre) continue;
           uint32_t f = kStaleFrame;
           if (!WriteAll(fd, &f, 4)) return;
@@ -804,6 +869,7 @@ struct ControlServer {
           }
         }
         if (replay) {
+          srv_dedup_replays.fetch_add(1, std::memory_order_relaxed);
           bool ok;
           if (replay_is_bulk) {
             uint32_t rlen = static_cast<uint32_t>(replay_bulk.size());
@@ -853,6 +919,8 @@ struct ControlServer {
               }
               if (std::chrono::steady_clock::now() >= deadline) {
                 --barrier_count[key];
+                srv_barrier_withdrawals.fetch_add(
+                    1, std::memory_order_relaxed);
                 break;
               }
               cv.wait_for(lk, std::chrono::milliseconds(200));
@@ -862,6 +930,8 @@ struct ControlServer {
                 lk.lock();
                 if (closed && barrier_gen[key] == gen) {
                   --barrier_count[key];
+                  srv_barrier_withdrawals.fetch_add(
+                      1, std::memory_order_relaxed);
                   conn_abort = true;
                   break;
                 }
@@ -904,6 +974,8 @@ struct ControlServer {
               L.rank = -1;
               L.fd = -1;
               ++L.epoch;
+              srv_lock_force_releases.fetch_add(
+                  1, std::memory_order_relaxed);
               cv.notify_all();
               reply = kDeadHolderReply;
               break;
@@ -1435,6 +1507,7 @@ struct ControlClient {
       // fenced: the server refused the op (no payload follows). Latch the
       // flag so every later op fails fast without touching the wire.
       stale = true;
+      g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
       *reply = kStaleIncarnationReply;
       return true;
     }
@@ -1452,9 +1525,13 @@ struct ControlClient {
       if (seq) EncodePre(&buf, seq, 1);
       Encode(&buf, op, key, arg, data, dlen);
       if (SendFault(buf, FaultNext())) {
+        ClOut(op, static_cast<long long>(buf.size()));
         FaultDelay();
         int64_t reply;
-        if (ReadReply(&reply)) return reply;
+        if (ReadReply(&reply)) {
+          ClIn(op, 12);
+          return reply;
+        }
       }
       if (attempt >= retries || !Reconnect(attempt))
         return stale ? kStaleIncarnationReply : -1;
@@ -1475,17 +1552,20 @@ struct ControlClient {
       if (seq) EncodePre(&buf, seq, 1);
       Encode(&buf, op, key, 0);
       if (SendFault(buf, FaultNext())) {
+        ClOut(op, static_cast<long long>(buf.size()));
         FaultDelay();
         uint32_t rlen;
         bool got = ControlServer::ReadAll(fd, &rlen, 4);
         if (got && rlen == kStaleFrame) {
           stale = true;
+          g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
           return kStaleIncarnationReply;
         }
         if (got && rlen <= kMaxMsg) {
           char* payload = static_cast<char*>(std::malloc(rlen ? rlen : 1));
           if (!payload) return -1;
           if (!rlen || ControlServer::ReadAll(fd, payload, rlen)) {
+            ClIn(op, 4LL + rlen);
             *out = payload;
             *out_len = rlen;
             return rlen;
@@ -1511,15 +1591,20 @@ struct ControlClient {
       std::vector<char> buf;
       Encode(&buf, op, key, arg);
       if (SendFault(buf, FaultNext())) {
+        ClOut(op, static_cast<long long>(buf.size()));
         FaultDelay();
         uint32_t rlen;
         if (ControlServer::ReadAll(fd, &rlen, 4)) {
           if (rlen == kStaleFrame) {
             stale = true;
+            g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
             return kStaleIncarnationReply;
           }
           if (rlen > cap) return -1;  // oversized: a real protocol error
-          if (!rlen || ControlServer::ReadAll(fd, dst, rlen)) return rlen;
+          if (!rlen || ControlServer::ReadAll(fd, dst, rlen)) {
+            ClIn(op, 4LL + rlen);
+            return rlen;
+          }
         }
       }
       if (attempt >= retries || !Reconnect(attempt))
@@ -1565,7 +1650,9 @@ struct ControlClient {
       constexpr int kMaxInflight = 128;
       std::vector<char> buf;
       bool first_send = true;
+      long long wire = 0;
       auto send = [&](const std::vector<char>& b) -> bool {
+        wire += static_cast<long long>(b.size());
         if (first_send) {
           first_send = false;
           return SendFault(b, fault);
@@ -1605,6 +1692,7 @@ struct ControlClient {
           if (!send(buf)) return false;
           buf.clear();
           if (!ControlServer::WriteAll(fd, datas[i], dlen)) return false;
+          wire += static_cast<long long>(dlen);
         }
         p = e ? e + 1 : p + key.size();
         if (i + 1 - replies_read > kMaxInflight) {
@@ -1618,7 +1706,10 @@ struct ControlClient {
         }
       }
       if (!buf.empty() && !send(buf)) return false;
-      return drain_to(n);
+      if (!drain_to(n)) return false;
+      ClOut(op, wire);
+      ClIn(op, 12LL * n);
+      return true;
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
@@ -1646,6 +1737,7 @@ struct ControlClient {
         p = e ? e + 1 : p + key.size();
       }
       if (!SendFault(buf, fault)) return false;
+      ClOut(op, static_cast<long long>(buf.size()));
       FaultDelay();
       // Grow the result with realloc doubling and read replies straight
       // into it: no shadow buffer, so a 100 MB drain holds 100-ish MB
@@ -1663,6 +1755,7 @@ struct ControlClient {
           // fenced mid-batch: latch and fail the whole call typed — the
           // retry loop below sees the flag and stops.
           stale = true;
+          g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
           std::free(payload);
           return false;
         }
@@ -1689,6 +1782,7 @@ struct ControlClient {
         }
         used += rlen;
       }
+      ClIn(op, static_cast<long long>(used) + 4LL * n);
       *out = payload;
       *out_len = static_cast<int64_t>(used);
       return true;
@@ -1719,12 +1813,14 @@ struct ControlClient {
         p = e ? e + 1 : p + key.size();
       }
       if (!SendFault(buf, fault)) return false;
+      ClOut(op, static_cast<long long>(buf.size()));
       FaultDelay();
       for (int i = 0; i < n; ++i) {
         int64_t reply;
         if (!ReadReply(&reply)) return false;
         if (out) out[i] = reply;
       }
+      ClIn(op, 12LL * n);
       return true;
     };
     for (int a = 0;; ++a) {
@@ -1783,9 +1879,11 @@ bool ControlClient::Reconnect(int attempt) {
                  << (attempt < 6 ? attempt : 6);
   if (ms > 2000) ms = 2000;
   if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+  g_cl_redial_attempts.fetch_add(1, std::memory_order_relaxed);
   int nfd = DialAndHandshake(host, port, secret, sockbuf);
   if (nfd < 0) return false;
   fd = nfd;
+  g_cl_redials.fetch_add(1, std::memory_order_relaxed);
   // A rebuilt stream must re-register its incarnation before any op rides
   // it — an unregistered reconnect would dodge the server's fence. A stale
   // verdict here latches `stale` and fails the reconnect: the caller's op
@@ -1992,6 +2090,71 @@ long long bf_cp_server_incarnation(void* h, int rank) {
                                        : static_cast<long long>(it->second);
 }
 
+// -- telemetry counter reads (r10 observability) ----------------------------
+//
+// Fixed layouts consumed by runtime/native.py (client_stats/server wrapper);
+// both return the number of slots filled so Python can stay forward-
+// compatible with a longer block.
+//
+// Client block: [0..31] per-op-class request counts, [32..63] request bytes,
+// [64..95] reply bytes, [96] redials (successful reconnects), [97] redial
+// attempts, [98] stale frames observed, [99] whole striped transfers.
+int bf_cp_client_counters(long long* out, int n) {
+  const int want = 3 * kOpSlots + 4;
+  if (!out || n < want) return -1;
+  for (int i = 0; i < kOpSlots; ++i) {
+    out[i] = g_cl_ops[i].load(std::memory_order_relaxed);
+    out[kOpSlots + i] = g_cl_bytes_out[i].load(std::memory_order_relaxed);
+    out[2 * kOpSlots + i] = g_cl_bytes_in[i].load(std::memory_order_relaxed);
+  }
+  out[96] = g_cl_redials.load(std::memory_order_relaxed);
+  out[97] = g_cl_redial_attempts.load(std::memory_order_relaxed);
+  out[98] = g_cl_stale_frames.load(std::memory_order_relaxed);
+  out[99] = g_cl_striped_xfers.load(std::memory_order_relaxed);
+  return want;
+}
+
+// Server block: [0..31] per-op dispatch counts, [32] live connections,
+// [33] queued mailbox records, [34] queued mailbox payload bytes,
+// [35] locks currently held, [36] lock force-releases, [37] barrier
+// withdrawals, [38] dedup replays served, [39] fenced (stale) ops,
+// [40] scalar kv entries, [41] bytes slots, [42] bytes-slot payload bytes.
+int bf_cp_server_counters(void* h, long long* out, int n) {
+  const int want = 32 + 11;
+  if (!h || !out || n < want) return -1;
+  auto* srv = static_cast<ControlServer*>(h);
+  for (int i = 0; i < 32; ++i)
+    out[i] = srv->srv_ops[i].load(std::memory_order_relaxed);
+  long long recs = 0, rec_bytes = 0, held = 0, slots = 0, slot_bytes = 0;
+  long long conns, kvn;
+  {
+    std::lock_guard<std::mutex> lk(srv->mu);
+    conns = static_cast<long long>(srv->handler_fds.size());
+    for (const auto& it : srv->mailbox)
+      recs += static_cast<long long>(it.second.size());
+    for (const auto& it : srv->box_bytes) rec_bytes += it.second;
+    for (const auto& it : srv->locks)
+      if (it.second.rank != -1) ++held;
+    kvn = static_cast<long long>(srv->kv.size());
+    for (const auto& it : srv->bytes_kv) {
+      ++slots;
+      if (it.second) slot_bytes += static_cast<long long>(it.second->size());
+    }
+  }
+  out[32] = conns;
+  out[33] = recs;
+  out[34] = rec_bytes;
+  out[35] = held;
+  out[36] = srv->srv_lock_force_releases.load(std::memory_order_relaxed);
+  out[37] = srv->srv_barrier_withdrawals.load(std::memory_order_relaxed);
+  out[38] = srv->srv_dedup_replays.load(std::memory_order_relaxed);
+  out[39] = srv->srv_stale_rejects.load(std::memory_order_relaxed);
+  out[40] = kvn;
+  out[41] = slots;
+  out[42] = slot_bytes;
+  return want;
+}
+
 int64_t bf_cp_barrier(void* h, const char* key) {
   return static_cast<ControlClient*>(h)->Call(kBarrier, key, 0);
 }
@@ -2061,6 +2224,7 @@ int64_t bf_cp_get_bytes_part(void* h, const char* key, int64_t offset,
 int64_t bf_cp_put_bytes_striped(void** handles, int nh, const char* key,
                                 const void* data, int64_t len) {
   if (nh <= 0) return -1;
+  g_cl_striped_xfers.fetch_add(1, std::memory_order_relaxed);
   if (nh == 1 || len < nh)
     return bf_cp_put_bytes_part(handles[0], key, 0, len, data, len);
   int64_t per = (len + nh - 1) / nh;
@@ -2088,6 +2252,7 @@ int64_t bf_cp_put_bytes_striped(void** handles, int nh, const char* key,
 int64_t bf_cp_get_bytes_striped(void** handles, int nh, const char* key,
                                 void** out, int64_t* out_len) {
   if (nh <= 0) return -1;
+  g_cl_striped_xfers.fetch_add(1, std::memory_order_relaxed);
   for (int attempt = 0; attempt < 3; ++attempt) {
     int64_t total = bf_cp_bytes_len(handles[0], key);
     if (total < 0) return -1;

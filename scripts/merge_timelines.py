@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Merge per-rank chrome-tracing timeline files into one trace.
+
+Every bluefog_tpu timeline file is a self-contained chrome-tracing JSON
+array whose timestamps count from a *per-process* perf_counter origin, so
+two ranks' files cannot be overlaid as-is. Each trace's first event is a
+clock-sync counter (``bf.clock_sync_us``, runtime/timeline.py) carrying
+the wall-clock microseconds at its capture timestamp; this script shifts
+every file onto the common wall-clock axis (rebased so the earliest event
+sits at ts=0), concatenates the event arrays, and adds process_name
+metadata per pid.
+
+After the merge, the hosted window plane's flow events (``cat:
+"bf.flow"``, ids = deposit-tag sequences) bind across processes: a
+``win_put`` deposit on rank A draws an arrow to its drain inside rank B's
+``win_update`` in chrome://tracing / Perfetto.
+
+Usage:
+    python scripts/merge_timelines.py /tmp/tl_0.json /tmp/tl_1.json ... \
+        [-o merged.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+CLOCK_SYNC = "bf.clock_sync_us"
+
+
+def load_events(path: str) -> list:
+    with open(path) as f:
+        events = json.load(f)
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a chrome-tracing event array")
+    return events
+
+
+def wall_offset_us(events: list, path: str) -> float:
+    """wall_us - trace_ts for this file (from its clock-sync counter)."""
+    for ev in events:
+        if ev.get("name") == CLOCK_SYNC and ev.get("ph") == "C":
+            value = ev.get("args", {}).get("value")
+            if value is None:
+                break
+            return float(value) - float(ev.get("ts", 0.0))
+    raise ValueError(
+        f"{path}: no '{CLOCK_SYNC}' clock-sync event — produced by an old "
+        "build? Re-record the trace, or merge by hand at your own risk")
+
+
+def merge(paths) -> list:
+    per_file = []
+    for p in paths:
+        events = load_events(p)
+        per_file.append((p, events, wall_offset_us(events, p)))
+    base = min(off for _, _, off in per_file)
+    merged = []
+    pids = set()
+    for path, events, off in per_file:
+        shift = off - base
+        for ev in events:
+            if "ts" in ev:
+                ev = dict(ev)
+                ev["ts"] = float(ev["ts"]) + shift
+            merged.append(ev)
+            if "pid" in ev:
+                pids.add(ev["pid"])
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    for pid in sorted(pids):
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": f"bluefog rank {pid}"}})
+    return merged
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("files", nargs="+", help="per-rank timeline JSON files")
+    ap.add_argument("-o", "--output", default="merged_timeline.json")
+    args = ap.parse_args(argv)
+    merged = merge(args.files)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    flows = sum(1 for e in merged if e.get("ph") in ("s", "f"))
+    print(f"merged {len(args.files)} trace(s), {len(merged)} events "
+          f"({flows} flow events) -> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Telemetry-plane smoke test (`make metrics-smoke`).
+
+A 2-rank in-process job with the control plane + hosted window plane
+forced on and metrics publication enabled, asserting the acceptance
+surface of the telemetry plane end to end:
+
+  * the metrics hot path stays cheap: a counter increment costs < 100 ns
+    (the disabled-by-default publication gate has nothing to gate — the
+    increment IS the whole cost);
+  * a push-sum optimizer job publishes a non-empty packed snapshot to the
+    control-plane KV and a non-empty, well-formed Prometheus scrape file;
+  * ``bf.cluster_health()`` reports per-rank step counters and exact mass
+    conservation;
+  * ``bfrun --status`` prints the same view from a SEPARATE process.
+
+Exits non-zero (with a message) on any violated assertion.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+import tempfile
+import timeit
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+_s = socket.socket()
+_s.bind(("127.0.0.1", 0))
+PORT = _s.getsockname()[1]
+_s.close()
+
+PROM = os.path.join(tempfile.mkdtemp(prefix="bf_metrics_"), "scrape.prom")
+os.environ.update({
+    "BLUEFOG_CP_HOST": "127.0.0.1",
+    "BLUEFOG_CP_PORT": str(PORT),
+    "BLUEFOG_CP_WORLD": "1",
+    "BLUEFOG_CP_RANK": "0",
+    "BLUEFOG_WIN_HOST_PLANE": "1",
+    "BLUEFOG_METRICS_INTERVAL": "1",
+    "BLUEFOG_METRICS_PROM": PROM,
+})
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+import bluefog_tpu as bf  # noqa: E402
+from bluefog_tpu.runtime import metrics as metrics_mod  # noqa: E402
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"metrics-smoke FAILED: {msg}", file=sys.stderr)
+        sys.exit(1)
+
+
+def microbench_counter_ns() -> float:
+    """Per-call cost of a bound counter increment.
+
+    Two de-noising measures: the calls are unrolled 10x per loop
+    iteration so timeit's own for-loop scaffolding (~15-20 ns/iter on
+    this interpreter) amortizes out of the per-call figure, and the min
+    is taken over many SHORT windows — the true cost is the fastest
+    window, and on a loaded CI box a 2 ms quiet slice is far likelier
+    than a 150 ms one."""
+    c = metrics_mod.counter("smoke.bench")
+    unroll = 10
+    n = 2_000
+    stmt = ";".join(["inc()"] * unroll)
+    best = min(timeit.repeat(stmt, globals={"inc": c.inc},
+                             number=n, repeat=60)) / (n * unroll)
+    return best * 1e9
+
+
+def main() -> int:
+    # 1) hot path: the increment is the entire cost, telemetry on or off
+    ns = microbench_counter_ns()
+    print(f"counter increment: {ns:.0f} ns/call")
+    check(ns < 100.0, f"counter increment costs {ns:.0f} ns (budget 100)")
+
+    # 2) a real 2-rank job publishing through the control plane
+    bf.init(devices=jax.devices("cpu")[:2])
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.1), zloss,
+                                         window_prefix="smoke.ps")
+    state = opt.init({"w": jnp.ones((8,), jnp.float32)})
+    for _ in range(4):
+        state, _ = opt.step(state, jnp.zeros((2, 1), jnp.float32))
+
+    snap = metrics_mod.publish_now()
+    check(snap is not None, "publish_now returned nothing")
+    check(snap["counters"] or snap["gauges"], "empty snapshot")
+
+    # KV scrape is non-empty and unpacks
+    from bluefog_tpu.runtime import control_plane as cp
+    blob = cp.client().get_bytes("bf.metrics.0")
+    check(len(blob) > 0, "no packed snapshot under bf.metrics.0")
+    back = metrics_mod.unpack_snapshot(blob)
+    check(back["gauges"].get("opt.step") == 4.0,
+          f"published step gauge wrong: {back['gauges'].get('opt.step')}")
+
+    # 3) cluster health: per-rank steps + exact mass conservation
+    health = bf.cluster_health()
+    print(metrics_mod.format_health(health))
+    check(health["ranks"], "cluster_health reported no ranks")
+    check(health["ranks"][0]["step"] == 4, "per-rank step counter wrong")
+    check(health["mass"] is not None and health["mass"]["conserved"],
+          f"push-sum mass not conserved: {health['mass']}")
+    check(not health["stragglers"], "phantom straggler on a healthy job")
+
+    # 4) prometheus scrape file: non-empty, format-linted
+    with open(PROM) as f:
+        text = f.read()
+    check(text.strip(), "prometheus scrape file is empty")
+    metric_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+(\s+\d+)?$")
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            check(re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                           r"(counter|gauge|histogram)$", line),
+                  f"bad TYPE line: {line!r}")
+        else:
+            check(metric_re.match(line), f"bad metric line: {line!r}")
+    check("bluefog_opt_step" in text, "opt.step missing from the scrape")
+
+    # 5) bfrun --status from a separate process sees the same view
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--status"],
+        env=env, capture_output=True, text=True, timeout=120)
+    print(out.stdout, end="")
+    check(out.returncode == 0, f"bfrun --status failed: {out.stderr}")
+    check("rank 0" in out.stdout and "step 4" in out.stdout,
+          f"--status output missing rank/step: {out.stdout!r}")
+    check("conserved" in out.stdout, "--status output missing mass check")
+
+    opt.free()
+    bf.shutdown()
+    print("metrics-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,6 +130,38 @@ def test_batched_fetch_add_exactly_once_under_drops(server):
     cl.close()
 
 
+def test_fault_metrics_match_injected_drops(server):
+    """Telemetry closes the "did the fault actually fire" blind spot:
+    every injected connection drop must show up as a client redial, the
+    reply-lost half as server-side dedup replays, and the registry
+    snapshot must surface the injector's own counts."""
+    from bluefog_tpu.runtime import metrics as metrics_mod
+
+    base = native.client_stats()
+    cl = native.ControlPlaneClient("127.0.0.1", server.port, 0, streams=1)
+    native.fault_arm(f"drop_after=4,seed={_seed(13)}")
+    for _ in range(40):
+        cl.fetch_add("fm.ctr", 1)
+    drops = native.fault_stats()["drops"]
+    snap = metrics_mod.snapshot()
+    native.fault_disarm()
+    assert drops >= 5, f"only {drops} drops injected"
+    assert cl.get("fm.ctr") == 40  # exactly-once held while we counted
+
+    after = native.client_stats()
+    redials = after["redials"] - base["redials"]
+    # every drop kills the connection -> the op's retry must redial
+    assert redials >= drops, (redials, drops)
+    # the reply-lost half of the drops was answered from the dedup table
+    assert server.stats()["dedup_replays"] >= 1
+    # and the registry snapshot carries the injector's own counters, so a
+    # chaos run's scrape proves the faults fired
+    assert snap["counters"]["cp.fault.drops"] == drops
+    assert snap["counters"]["cp.fault.ops"] > 0
+    assert "cp.client.redials" in snap["counters"]
+    cl.close()
+
+
 def _striped_roundtrip(port: int, streams: int, rounds: int = 10):
     """put_bytes/get_bytes cycle of striping-sized payloads; returns the
     bytes read back each round (for cross-run comparison)."""

@@ -1,0 +1,447 @@
+"""Telemetry plane: metrics registry, health aggregation, trace correlation.
+
+Covers the r10 acceptance surface in-process:
+
+  * registry instruments + packed-snapshot pack/unpack roundtrip;
+  * Prometheus text-exposition lint;
+  * ``bf.cluster_health()`` straggler + mass-drift detection (synthetic
+    lagging snapshot) and the healthy-job conserved verdict on a real
+    4-rank push-sum run;
+  * ``bfrun --status`` (the launcher's ``_status``) printing the same
+    view through a raw external control-plane client;
+  * a merged two-rank timeline containing flow-event pairs that link a
+    hosted-plane deposit to its drain — parsed, not eyeballed;
+  * the ``[rank r / inc i]`` log-record prefix.
+"""
+
+import json
+import os
+import re
+import socket
+import struct
+import time
+import timeit
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.runtime import control_plane as cp
+from bluefog_tpu.runtime import metrics as metrics_mod
+from bluefog_tpu.runtime import native
+from bluefog_tpu.runtime.state import _global_state
+from bluefog_tpu.runtime.timeline import Timeline
+
+from conftest import cpu_devices
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# registry + snapshot wire format
+# ---------------------------------------------------------------------------
+
+def test_instruments_and_snapshot():
+    r = metrics_mod.Registry()
+    c = r.counter("t.hits")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert r.counter("t.hits") is c  # same instrument back
+    g = r.gauge("t.depth")
+    g.set(3)
+    g.add(2.5)
+    assert g.value == 5.5
+    h = r.histogram("t.lat", bounds=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.counts == [1, 1, 1, 1] and h.count == 4
+    snap = r.snapshot(include_native=False)
+    assert snap["counters"]["t.hits"] == 5.0
+    assert snap["gauges"]["t.depth"] == 5.5
+    assert snap["hists"]["t.lat"]["count"] == 4
+    # reset zeroes in place, instrument identity preserved
+    r.reset()
+    assert c.value == 0 and r.counter("t.hits") is c
+    assert h.count == 0
+
+
+def test_histogram_rejects_unsorted_bounds():
+    r = metrics_mod.Registry()
+    with pytest.raises(ValueError):
+        r.histogram("bad", bounds=(1.0, 0.5))
+
+
+def test_pack_unpack_roundtrip():
+    r = metrics_mod.Registry()
+    r.counter("a.b").inc(7)
+    r.gauge("g").set(-2.25)
+    h = r.histogram("lat")
+    h.observe(0.002)
+    h.observe(12.0)
+    snap = r.snapshot(include_native=False)
+    snap["meta"].update(rank=3, inc=2)
+    blob = metrics_mod.pack_snapshot(snap)
+    back = metrics_mod.unpack_snapshot(blob)
+    assert back["meta"]["rank"] == 3 and back["meta"]["inc"] == 2
+    assert back["meta"]["ts"] == pytest.approx(snap["meta"]["ts"])
+    assert back["counters"] == snap["counters"]
+    assert back["gauges"] == snap["gauges"]
+    assert back["hists"]["lat"]["counts"] == snap["hists"]["lat"]["counts"]
+    assert back["hists"]["lat"]["sum"] == pytest.approx(12.002)
+    # garbage is rejected, not misparsed
+    with pytest.raises(ValueError):
+        metrics_mod.unpack_snapshot(b"XXXX" + blob[4:])
+    with pytest.raises((ValueError, struct.error)):
+        metrics_mod.unpack_snapshot(blob[:10])
+
+
+def test_counter_hot_path_is_cheap():
+    """The strict < 100 ns gate runs in `make metrics-smoke`; this is the
+    in-suite sanity bound (CI boxes share cores with the test runner)."""
+    c = metrics_mod.Registry().counter("bench")
+    n = 100_000
+    per = min(timeit.repeat("inc()", globals={"inc": c.inc},
+                            number=n, repeat=5)) / n
+    assert per < 500e-9, f"counter inc costs {per * 1e9:.0f} ns"
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition
+# ---------------------------------------------------------------------------
+
+_METRIC_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$")
+
+
+def test_prometheus_exposition_lints():
+    r = metrics_mod.Registry()
+    r.counter("ops.total").inc(3)
+    r.gauge("mailbox.bytes").set(1024)
+    h = r.histogram("lat", bounds=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(2.0)
+    snap = r.snapshot(include_native=False)
+    snap["meta"]["rank"] = 1
+    text = metrics_mod.prometheus_text(snap)
+    lines = text.strip().splitlines()
+    assert lines, "empty exposition"
+    for line in lines:
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(counter|gauge|histogram)$", line), line
+        else:
+            assert _METRIC_RE.match(line), line
+    # histogram structure: cumulative buckets + +Inf + sum/count
+    assert 'bluefog_lat_bucket{rank="1",le="0.1"} 1' in lines
+    assert 'bluefog_lat_bucket{rank="1",le="1"} 1' in lines
+    assert 'bluefog_lat_bucket{rank="1",le="+Inf"} 2' in lines
+    assert 'bluefog_lat_count{rank="1"} 2' in lines
+    # name sanitization: dots become underscores, prefix applied
+    assert any(l.startswith("bluefog_mailbox_bytes{") for l in lines)
+
+
+# ---------------------------------------------------------------------------
+# health aggregation logic (synthetic snapshots — no mesh needed)
+# ---------------------------------------------------------------------------
+
+def _snap(rank, step=None, mass=None, minted=None, ts=None, inc=0,
+          epoch=0):
+    gauges = {"membership.epoch": float(epoch)}
+    if step is not None:
+        gauges["opt.step"] = float(step)
+    if mass is not None:
+        gauges["pushsum.mass"] = float(mass)
+    if minted is not None:
+        gauges["pushsum.minted"] = float(minted)
+    return {"meta": {"schema": 1, "rank": rank, "inc": inc,
+                     "ts": time.time() if ts is None else ts},
+            "counters": {}, "gauges": gauges, "hists": {}}
+
+
+def test_health_flags_straggler_by_step_spread(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_STRAGGLER_STEPS", "3")
+    snaps = {0: _snap(0, step=50), 1: _snap(1, step=49),
+             2: _snap(2, step=40)}
+    h = metrics_mod.health_from_snapshots(snaps, world=3, interval=1.0)
+    assert h["stragglers"] == [2]
+    assert h["ranks"][0]["step"] == 50 and h["ranks"][2]["step"] == 40
+    assert h["missing"] == []
+
+
+def test_health_staleness_and_missing():
+    snaps = {0: _snap(0, step=10), 1: _snap(1, step=10, ts=time.time() - 60)}
+    h = metrics_mod.health_from_snapshots(snaps, world=3, interval=1.0)
+    assert h["ranks"][0]["alive"] and not h["ranks"][1]["alive"]
+    assert h["missing"] == [2]
+
+
+def test_health_mass_conservation_and_drift():
+    ok = {0: _snap(0, mass=2.0, minted=2.0), 1: _snap(1, mass=2.0,
+                                                      minted=2.0)}
+    h = metrics_mod.health_from_snapshots(ok, world=2, interval=1.0)
+    assert h["mass"]["conserved"] and h["mass"]["drift"] == 0.0
+    # lost deposits: a rank's mass fell measurably below what was minted
+    bad = {0: _snap(0, mass=1.25, minted=2.0), 1: _snap(1, mass=2.0,
+                                                        minted=2.0)}
+    h = metrics_mod.health_from_snapshots(bad, world=2, interval=1.0)
+    assert not h["mass"]["conserved"]
+    assert h["mass"]["drift"] == pytest.approx(-0.75)
+    # a dead rank's snapshot drops out of BOTH sums (live-rank check)
+    stale = {0: _snap(0, mass=2.0, minted=2.0),
+             1: _snap(1, mass=2.0, minted=2.0, ts=time.time() - 600)}
+    h = metrics_mod.health_from_snapshots(stale, world=2, interval=1.0)
+    assert h["mass"]["conserved"] and h["mass"]["total"] == 2.0
+
+
+def test_format_health_mentions_everything():
+    snaps = {0: _snap(0, step=9, mass=1.0, minted=1.0),
+             1: _snap(1, step=2)}
+    h = metrics_mod.health_from_snapshots(snaps, world=3, interval=1.0)
+    text = metrics_mod.format_health(h)
+    assert "rank 0" in text and "rank 1" in text
+    assert "STRAGGLER" in text
+    assert "no snapshot published" in text  # rank 2
+    assert "conserved" in text
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the control plane (real job, real KV)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def bf_hosted_metrics(monkeypatch):
+    """4-rank job, forced control plane + hosted plane, publication on."""
+    if native.load() is None:
+        pytest.skip("native runtime unavailable")
+    port = _free_port()
+    for k, v in {
+        "BLUEFOG_CP_HOST": "127.0.0.1",
+        "BLUEFOG_CP_PORT": str(port),
+        "BLUEFOG_CP_WORLD": "1",
+        "BLUEFOG_CP_RANK": "0",
+        "BLUEFOG_WIN_HOST_PLANE": "1",
+        "BLUEFOG_METRICS_INTERVAL": "1",
+    }.items():
+        monkeypatch.setenv(k, v)
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(4))
+    assert cp.active()
+    yield bf
+    bf.shutdown()
+    cp.reset_for_test()
+
+
+def _run_pushsum_steps(bf_, steps=3, prefix="met.ps"):
+    import jax.numpy as jnp
+    import optax
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf_.DistributedPushSumOptimizer(optax.sgd(0.1), zloss,
+                                          window_prefix=prefix)
+    state = opt.init({"w": jnp.ones((4,), jnp.float32)})
+    for _ in range(steps):
+        state, _ = opt.step(state, jnp.zeros((4, 1), jnp.float32))
+    return opt
+
+
+def test_cluster_health_end_to_end(bf_hosted_metrics):
+    """Acceptance: a 4-rank in-process job reports per-rank step counters
+    and push-sum total mass within the ulp-scaled tolerance of minted
+    mass; an artificially-stalled rank is flagged a straggler; and
+    ``bfrun --status`` prints the same view from a raw external client."""
+    bf_ = bf_hosted_metrics
+    opt = _run_pushsum_steps(bf_, steps=5)
+    snap = metrics_mod.publish_now()
+    assert snap is not None
+
+    # published packed snapshot landed in the KV and unpacks
+    blob = cp.client().get_bytes("bf.metrics.0")
+    assert blob
+    back = metrics_mod.unpack_snapshot(blob)
+    assert back["gauges"]["opt.step"] == 5.0
+
+    health = bf_.cluster_health()
+    assert health["ranks"][0]["step"] == 5
+    assert health["mass"] is not None
+    assert health["mass"]["minted"] == pytest.approx(4.0)
+    assert health["mass"]["conserved"], health["mass"]
+    assert health["stragglers"] == []
+
+    # artificially-stalled rank: a second controller's snapshot lagging
+    # the fleet by more than the straggler threshold
+    lag = _snap(1, step=1, ts=time.time())
+    cp.client().put_bytes("bf.metrics.1", metrics_mod.pack_snapshot(lag))
+    cp.client().put("bf.metrics.world", 2)  # the simulated job's world
+    merged = metrics_mod.read_cluster_health(cp.client(), world=2)
+    assert merged["stragglers"] == [1]
+    assert merged["ranks"][1]["step"] == 1
+
+    # bfrun --status: same view through a RAW external client (the
+    # launcher's exact code path, no bf.init on that side)
+    from bluefog_tpu import launcher
+
+    class _Args:
+        cp = f"127.0.0.1:{os.environ['BLUEFOG_CP_PORT']}"
+        status = True
+
+    import contextlib
+    import io
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = launcher._status(_Args())
+    assert rc == 0
+    text = out.getvalue()
+    assert "rank 0" in text and "step 5" in text
+    assert "STRAGGLER" in text  # the synthetic lagging rank 1
+    assert "conserved" in text
+    opt.free()
+
+
+def test_publication_piggyback_and_prom_file(bf_hosted_metrics, tmp_path,
+                                             monkeypatch):
+    bf_ = bf_hosted_metrics
+    prom = tmp_path / "scrape.prom"
+    monkeypatch.setenv("BLUEFOG_METRICS_PROM", str(prom))
+    opt = _run_pushsum_steps(bf_, steps=2, prefix="met.prom")
+    snap = metrics_mod.publish_now()
+    assert snap is not None
+    text = prom.read_text()
+    assert "bluefog_opt_step" in text
+    assert "bluefog_pushsum_mass" in text
+    # the interval gate: an immediate second maybe_publish is a no-op
+    before = cp.client().bytes_len("bf.metrics.0")
+    metrics_mod.maybe_publish()
+    assert cp.client().bytes_len("bf.metrics.0") == before
+    opt.free()
+
+
+def test_win_op_histograms_and_drain_counters(bf_hosted_metrics):
+    """Window data-plane instrumentation: op latency histograms fill and
+    the drain counters move when deposits actually flow."""
+    import jax.numpy as jnp
+
+    bf_ = bf_hosted_metrics
+    x = bf_.shard_rank_stacked(bf_.mesh(), jnp.ones((4, 8)))
+    assert bf_.win_create(x, "met.win")
+    h_put = metrics_mod.histogram("win.put_sec")
+    h_upd = metrics_mod.histogram("win.update_sec")
+    puts0, upds0 = h_put.count, h_upd.count
+    bf_.win_put(x, "met.win")
+    bf_.win_update(name="met.win")
+    assert h_put.count == puts0 + 1
+    assert h_upd.count == upds0 + 1
+    bf_.win_free("met.win")
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace correlation (acceptance: merged flow pair)
+# ---------------------------------------------------------------------------
+
+def test_merged_timeline_binds_deposit_to_drain(bf_hosted_metrics,
+                                                tmp_path, monkeypatch):
+    """Two in-process 'controllers' — origin owning ranks 0..1, owner
+    owning ranks 2..3 — write separate per-rank trace files; the merged
+    timeline must contain >= 1 flow pair (same id, 's' at the origin, 'f'
+    at the drain), validated by parsing, plus balanced B/E spans."""
+    import jax.numpy as jnp
+
+    from bluefog_tpu.ops import windows as win_mod
+
+    bf_ = bf_hosted_metrics
+    st = _global_state()
+    x = bf_.shard_rank_stacked(bf_.mesh(), jnp.ones((4, 16)))
+
+    # controller A: owns ranks 0..1 (its window half); deposits to 2..3
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [0, 1])
+    assert bf_.win_create(x, "flow.win", zero_init=True)
+    win_a = st.windows["flow.win"]
+    assert win_a.hosted and set(win_a.owned) == {0, 1}
+
+    # controller B: a second Window object under the SAME name, owning
+    # the other half — its mailbox keys are the ones A deposits into
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [2, 3])
+    win_b = win_mod.Window("flow.win", np.ones((4, 16), np.float32),
+                           zero_init=True)
+    assert set(win_b.owned) == {2, 3}
+
+    # rank-0 trace: the deposits (flow starts) happen under A
+    st.timeline = Timeline(str(tmp_path / "tl_"), process_index=0,
+                           use_native=False)
+    bf_.win_put(x, "flow.win")
+    st.timeline.close()
+    path0 = st.timeline.path
+
+    # rank-1 trace: B drains A's deposits (flow finishes)
+    st.timeline = Timeline(str(tmp_path / "tl_"), process_index=1,
+                           use_native=False)
+    with win_b.state_mu:
+        win_b._drain_deposits()
+    st.timeline.close()
+    path1 = st.timeline.path
+    st.timeline = None
+
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    try:
+        import merge_timelines
+        merged = merge_timelines.merge([path0, path1])
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "merged.json"
+    out.write_text(json.dumps(merged))
+    events = json.loads(out.read_text())
+
+    starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in events if e.get("ph") == "f"}
+    pairs = set(starts) & set(finishes)
+    assert pairs, "no flow pair linking a deposit to its drain"
+    for fid in pairs:
+        assert starts[fid]["pid"] == 0 and finishes[fid]["pid"] == 1
+        assert starts[fid]["name"] == "WIN_DEPOSIT"
+        # merged clock: the drain cannot precede its deposit
+        assert finishes[fid]["ts"] >= starts[fid]["ts"]
+    # chrome-tracing validity: balanced B/E per (pid, cat, tid) lane
+    open_spans = {}
+    for e in events:
+        key = (e.get("pid"), e.get("cat"), e.get("tid"))
+        if e.get("ph") == "B":
+            open_spans[key] = open_spans.get(key, 0) + 1
+        elif e.get("ph") == "E":
+            open_spans[key] = open_spans.get(key, 0) - 1
+            assert open_spans[key] >= 0, f"E without B for {key}"
+    assert all(v == 0 for v in open_spans.values())
+    # win_free must not trip over the second window's state: clean up the
+    # registered one only
+    bf_.win_free("flow.win")
+
+
+# ---------------------------------------------------------------------------
+# logging prefix satellite
+# ---------------------------------------------------------------------------
+
+def test_log_records_carry_rank_incarnation_prefix():
+    from bluefog_tpu.runtime.logging import _RankPrefixFilter
+
+    assert _RankPrefixFilter._prefix() == ""  # before init
+    bf.init(devices=cpu_devices(4))
+    try:
+        assert _RankPrefixFilter._prefix() == "[rank 0 / inc 0] "
+        import logging as _logging
+
+        rec = _logging.LogRecord("bluefog_tpu", _logging.WARNING, __file__,
+                                 1, "msg", (), None)
+        assert _RankPrefixFilter().filter(rec)
+        assert rec.bfprefix == "[rank 0 / inc 0] "
+    finally:
+        bf.shutdown()
+    assert _RankPrefixFilter._prefix() == ""  # after shutdown
