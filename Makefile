@@ -7,7 +7,12 @@ MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=
 
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
         test_launcher test_models bench chaos dryrun native scaling \
-        lm_bench metrics-smoke
+        lm_bench metrics-smoke lint bfcheck check tsan asan
+
+# Test files replayed under the sanitizers: the chaos suite (reconnect /
+# dedup / fencing churn) plus the striped-transport + hosted-window stress
+# tests — the paths that hammer the native layer's concurrency.
+SANITIZE_TESTS = tests/test_chaos.py tests/test_hosted_windows.py
 
 test:            ## full suite (~15 min on the single-core CI box)
 	$(PYTEST) tests/ -q
@@ -45,7 +50,43 @@ metrics-smoke:   ## telemetry-plane acceptance: 2-rank in-process job with a
                  ## counter-increment microbench
 	JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
 
-chaos: metrics-smoke  ## tier-1 chaos subset, fault injection replayed at TWO
+lint:            ## ruff (curated rule set, pyproject.toml) when installed;
+                 ## otherwise bfcheck's stdlib-only fallback linter
+	@if command -v ruff >/dev/null 2>&1; then \
+	    ruff check bluefog_tpu scripts tests; \
+	else \
+	    echo "ruff not installed; using bfcheck's fallback linter"; \
+	    python scripts/bfcheck --lint; \
+	fi
+
+bfcheck:         ## project-invariant static analysis (wire protocol, knob
+                 ## registry, lock/thread discipline — docs/static_analysis.md)
+	python scripts/bfcheck
+
+check: lint bfcheck  ## the full static gate (make check = lint + bfcheck)
+
+tsan:            ## ThreadSanitizer build of csrc + chaos/striped-stress replay
+                 ## (zero reports required; csrc findings are bugs, never
+                 ## suppressed — csrc/tsan.supp covers third-party libs only)
+	SANITIZE=thread bash csrc/build.sh
+	env BLUEFOG_NATIVE_SO=$(abspath csrc/build/libbf_runtime.tsan.so) \
+	    LD_PRELOAD=$$(gcc -print-file-name=libtsan.so) \
+	    TSAN_OPTIONS="exitcode=66 halt_on_error=0 suppressions=$(abspath csrc/tsan.supp)" \
+	    JAX_PLATFORMS=cpu $(PYTEST) $(SANITIZE_TESTS) -q -m "not slow"
+
+asan:            ## AddressSanitizer build of csrc + the same replay.
+                 ## detect_leaks=0: CPython intentionally leaks at exit.
+                 ## libstdc++ rides LD_PRELOAD next to libasan because the
+                 ## python binary doesn't link it — without it ASan's init
+                 ## can't resolve the real __cxa_throw and CHECK-aborts on
+                 ## jaxlib/MLIR's first C++ exception.
+	SANITIZE=address bash csrc/build.sh
+	env BLUEFOG_NATIVE_SO=$(abspath csrc/build/libbf_runtime.asan.so) \
+	    LD_PRELOAD="$$(gcc -print-file-name=libasan.so) $$(gcc -print-file-name=libstdc++.so)" \
+	    ASAN_OPTIONS="detect_leaks=0 exitcode=66" \
+	    JAX_PLATFORMS=cpu $(PYTEST) $(SANITIZE_TESTS) -q -m "not slow"
+
+chaos: check metrics-smoke  ## tier-1 chaos subset, fault injection replayed at TWO
                  ## seed offsets (BLUEFOG_CHAOS_SEED shifts every armed drop
                  ## point, so reconnect/dedup/fencing — and the telemetry
                  ## counters asserted against them — face different drop sites)

@@ -17,7 +17,6 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Callable, Optional
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 

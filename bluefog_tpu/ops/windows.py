@@ -336,6 +336,11 @@ class _ControlPlaneWinHost:
             depth = self._mu_depth.get(rank, 0)
             if depth == 0:
                 try:
+                    # bfcheck: ok-blocking-under-lock (the gate exists to
+                    # serialize local threads THROUGH this server acquire;
+                    # waiting on the gate is equivalent to waiting on the
+                    # server, and the gate is per-rank so nothing else
+                    # stalls)
                     self._cl.lock(f"{self._pre}.mu.{rank}")
                 except PeerLostError as exc:
                     # typed + attributed: the caller (window optimizers'

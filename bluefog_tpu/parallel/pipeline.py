@@ -34,7 +34,6 @@ from __future__ import annotations
 import functools
 from typing import Optional, Sequence
 
-import numpy as np
 import optax
 
 import jax

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import List, Optional
 
+from .config import knob_env
 from .logging import logger
 from .native import (ControlPlaneClient, ControlPlaneServer,
                      StaleIncarnationError)
@@ -125,8 +126,9 @@ def attach() -> Optional[ControlPlaneClient]:
         served_cap = None
         if rank == 0 and os.environ.get("BLUEFOG_CP_SERVE", "1") != "0":
             try:
-                max_mb = float(os.environ.get(
-                    "BLUEFOG_CP_MAILBOX_MAX_MB", "256"))
+                # single authoritative default: the knob registry
+                # (runtime/config.py KNOBS; bfcheck flags per-site literals)
+                max_mb = float(knob_env("BLUEFOG_CP_MAILBOX_MAX_MB"))
                 _server = ControlPlaneServer(
                     world, port, secret=secret,
                     max_mailbox_bytes=int(max_mb * (1 << 20)))
@@ -308,8 +310,7 @@ def mailbox_cap_bytes() -> int:
         if v > 0:
             cap = int(v) - 1
     if cap is None:
-        cap = int(float(os.environ.get(
-            "BLUEFOG_CP_MAILBOX_MAX_MB", "256")) * (1 << 20))
+        cap = int(float(knob_env("BLUEFOG_CP_MAILBOX_MAX_MB")) * (1 << 20))
     _cap_cache = cap
     return cap
 

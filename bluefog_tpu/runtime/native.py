@@ -20,9 +20,18 @@ import threading
 from typing import Optional
 
 from .logging import logger
+from .protocol import OP_CODES, OP_NAMES as _OP_NAMES  # noqa: F401 — re-export
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
 _SO = os.path.join(_CSRC, "build", "libbf_runtime.so")
+
+
+def _so_path() -> str:
+    """The shared library to load: ``BLUEFOG_NATIVE_SO`` overrides the
+    default build product — how ``make tsan`` / ``make asan`` point the
+    whole Python runtime at a sanitizer-instrumented build without
+    touching the normal artifact."""
+    return os.environ.get("BLUEFOG_NATIVE_SO") or _SO
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -291,15 +300,9 @@ def fault_stats() -> dict:
             "drops": int(lib.bf_cp_fault_drops())}
 
 
-# Op-class names for the telemetry counter block (mirrors enum Op in
-# csrc/bf_runtime.cc; index = op code).
-_OP_NAMES = {
-    1: "barrier", 2: "lock", 3: "unlock", 4: "fetch_add", 5: "put",
-    6: "get", 7: "shutdown", 8: "append_bytes", 9: "take_bytes",
-    10: "put_bytes", 11: "get_bytes", 12: "box_bytes",
-    13: "append_bytes_tagged", 14: "put_bytes_part", 15: "bytes_len",
-    16: "get_bytes_part", 17: "seq_pre", 18: "attach",
-}
+# Op-class names for the telemetry counter block: _OP_NAMES (imported
+# above) is runtime/protocol.py's code->name table, the same source the
+# C++ enum mirrors — one table, three consumers, bfcheck-verified.
 
 _CL_SLOTS = 100  # 3*32 per-op triples + 4 event counters (csrc layout)
 
@@ -362,7 +365,15 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO):
+        so = _so_path()
+        if not os.path.exists(so):
+            if so != _SO:
+                # an explicit BLUEFOG_NATIVE_SO that does not exist is a
+                # misconfiguration, not a build trigger (sanitizer builds
+                # are produced by `make tsan` / `make asan`, not lazily)
+                logger.warning("BLUEFOG_NATIVE_SO=%s does not exist; "
+                               "native runtime unavailable", so)
+                return None
             script = os.path.join(_CSRC, "build.sh")
             if not os.path.exists(script):
                 return None
@@ -374,7 +385,7 @@ def load() -> Optional[ctypes.CDLL]:
                             "using pure-Python fallbacks", exc)
                 return None
         try:
-            _lib = _configure(ctypes.CDLL(_SO))
+            _lib = _configure(ctypes.CDLL(so))
         except AttributeError:
             # A stale cached build predates a symbol _configure now needs
             # (the .so is gitignored; load() only builds when it's missing).
@@ -384,7 +395,7 @@ def load() -> Optional[ctypes.CDLL]:
             try:
                 subprocess.run(["sh", os.path.join(_CSRC, "build.sh")],
                                check=True, capture_output=True, timeout=120)
-                _lib = _configure(ctypes.CDLL(_SO))
+                _lib = _configure(ctypes.CDLL(so))
             except (subprocess.SubprocessError, OSError,
                     AttributeError) as exc:
                 logger.info("native runtime rebuild failed (%s)", exc)
@@ -819,7 +830,7 @@ class ControlPlaneClient:
             return []
         n = len(names)
         out = (ctypes.c_int64 * n)()
-        r = self._lib.bf_cp_multi(self._h, 6, "\n".join(names).encode(),
+        r = self._lib.bf_cp_multi(self._h, OP_CODES["get"], "\n".join(names).encode(),
                                   None, out, n)
         if r < 0:
             self._wire_error("control plane get_many failed")
@@ -832,7 +843,7 @@ class ControlPlaneClient:
             return
         n = len(names)
         args = (ctypes.c_int64 * n)(*[int(v) for v in values])
-        if self._lib.bf_cp_multi(self._h, 5, "\n".join(names).encode(),
+        if self._lib.bf_cp_multi(self._h, OP_CODES["put"], "\n".join(names).encode(),
                                  args, None, n) < 0:
             self._wire_error("control plane put_many failed")
 
@@ -846,7 +857,7 @@ class ControlPlaneClient:
         args = (ctypes.c_int64 * n)(
             *([1] * n if deltas is None else [int(d) for d in deltas]))
         out = (ctypes.c_int64 * n)()
-        if self._lib.bf_cp_multi(self._h, 4, "\n".join(names).encode(),
+        if self._lib.bf_cp_multi(self._h, OP_CODES["fetch_add"], "\n".join(names).encode(),
                                  args, out, n) < 0:
             self._wire_error("control plane fetch_add_many failed")
         return list(out)
@@ -905,12 +916,14 @@ class ControlPlaneClient:
             off += rl
         return records
 
-    # op codes for the pipelined bytes batches (csrc/bf_runtime.cc enum Op)
-    _OP_APPEND_BYTES = 8
-    _OP_TAKE_BYTES = 9
-    _OP_PUT_BYTES = 10
-    _OP_GET_BYTES = 11
-    _OP_APPEND_BYTES_TAGGED = 13
+    # op codes for the pipelined bytes batches — single source of truth is
+    # runtime/protocol.py (mirroring csrc/bf_runtime.cc enum Op; bfcheck
+    # asserts the bijection)
+    _OP_APPEND_BYTES = OP_CODES["append_bytes"]
+    _OP_TAKE_BYTES = OP_CODES["take_bytes"]
+    _OP_PUT_BYTES = OP_CODES["put_bytes"]
+    _OP_GET_BYTES = OP_CODES["get_bytes"]
+    _OP_APPEND_BYTES_TAGGED = OP_CODES["append_bytes_tagged"]
 
     def _bytes_multi_out(self, op: int, names, blobs, tags=None,
                          handle=None) -> list:
@@ -1199,7 +1212,7 @@ class ControlPlaneClient:
             return []
         n = len(names)
         out = (ctypes.c_int64 * n)()
-        if self._lib.bf_cp_multi(self._h, 12, "\n".join(names).encode(),
+        if self._lib.bf_cp_multi(self._h, OP_CODES["box_bytes"], "\n".join(names).encode(),
                                  None, out, n) < 0:
             self._wire_error("control plane box_bytes_many failed")
         return list(out)

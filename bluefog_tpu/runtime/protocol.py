@@ -1,0 +1,108 @@
+"""Canonical wire-protocol op table for the native control plane.
+
+This module is the single Python-side source of truth for the control
+plane's op codes and their retry-safety classification. The C++ side
+(``csrc/bf_runtime.cc``: ``enum Op`` and ``Client::IsDedupOp``) mirrors it
+by hand — and ``scripts/bfcheck`` (the ``protocol`` analyzer, run by
+``make check`` and tier-1 via ``tests/test_bfcheck.py``) parses the C++
+and asserts both mirrors stay a bijection, so a new op cannot ship with a
+missing mirror or a silently retry-unsafe classification.
+
+To add an op:
+  1. add an ``OpSpec`` row here (pick the next free code; decide
+     ``idempotent`` deliberately — ``False`` means a retry after a lost
+     reply must be served from the server's dedup table, so the client
+     annotates it with ``kSeqPre``),
+  2. add the enumerator to ``enum Op`` in csrc/bf_runtime.cc (numeric
+     order) and, when not idempotent, to ``Client::IsDedupOp``,
+  3. run ``make check`` — the analyzer verifies the bijection and the
+     retry-set equality for you.
+
+Import discipline: this module must stay dependency-free (stdlib only,
+no jax, no sibling imports) — it is imported by ``runtime/native.py``
+and parsed by ``scripts/bfcheck`` fixtures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class OpSpec:
+    """One wire op: Python name, wire code, C++ enumerator, retry class.
+
+    ``idempotent=True`` ops are retried raw after a wire failure (applying
+    them twice is harmless). ``idempotent=False`` ops must be applied
+    exactly once: the client prefixes them with a ``kSeqPre`` annotation
+    and the server records/replays their replies (docs/fault_tolerance.md).
+    """
+
+    name: str
+    code: int
+    cxx: str
+    idempotent: bool
+    doc: str = ""
+
+
+OPS: Tuple[OpSpec, ...] = (
+    OpSpec("barrier", 1, "kBarrier", False,
+           "blocking rendezvous; a drop-and-retry must not double-count "
+           "this client's arrival"),
+    OpSpec("lock", 2, "kLock", True,
+           "blocking acquire; a redundant re-grant is absorbed by per-rank "
+           "re-entrancy and a dropped holder is force-released server-side"),
+    OpSpec("unlock", 3, "kUnlock", False,
+           "double-applied it would release the NEXT holder's acquisition"),
+    OpSpec("fetch_add", 4, "kFetchAdd", False,
+           "atomic read-modify-write; double-applied it drifts the counter"),
+    OpSpec("put", 5, "kPut", True, "last-writer-wins scalar write"),
+    OpSpec("get", 6, "kGet", True, "pure read"),
+    OpSpec("shutdown", 7, "kShutdown", True,
+           "server stop request; repeating it is a no-op"),
+    OpSpec("append_bytes", 8, "kAppendBytes", False,
+           "double-applied it duplicates a mailbox deposit record"),
+    OpSpec("take_bytes", 9, "kTakeBytes", False,
+           "destructive drain; a retry must replay the recorded haul, not "
+           "drain again"),
+    OpSpec("put_bytes", 10, "kPutBytes", True,
+           "last-writer-wins bulk slot overwrite"),
+    OpSpec("get_bytes", 11, "kGetBytes", True, "pure bulk read"),
+    OpSpec("box_bytes", 12, "kBoxBytes", True,
+           "pure read of a mailbox's pending byte count"),
+    OpSpec("append_bytes_tagged", 13, "kAppendBytesTagged", False,
+           "tagged deposit append; same exactly-once contract as "
+           "append_bytes"),
+    OpSpec("put_bytes_part", 14, "kPutBytesPart", False,
+           "striped-put byte range into a staging buffer; a duplicate part "
+           "re-arms assembly bookkeeping"),
+    OpSpec("bytes_len", 15, "kBytesLen", True, "pure read of a slot's size"),
+    OpSpec("get_bytes_part", 16, "kGetBytesPart", True,
+           "pure ranged bulk read"),
+    OpSpec("seq_pre", 17, "kSeqPre", True,
+           "the reply-less dedup annotation itself; re-sending it re-arms "
+           "the same (client, seq) batch"),
+    OpSpec("attach", 18, "kAttach", True,
+           "incarnation registration; re-registering the same incarnation "
+           "is a no-op (every reconnect re-sends it)"),
+)
+
+# name -> wire code (the table every Python-side consumer keys off)
+OP_CODES: Dict[str, int] = {o.name: o.code for o in OPS}
+
+# code -> name (telemetry counter rows, diagnostics)
+OP_NAMES: Dict[int, str] = {o.code: o.name for o in OPS}
+
+# Ops whose effect must be applied exactly once: the client's kSeqPre
+# retry set (mirrors Client::IsDedupOp in csrc/bf_runtime.cc).
+RETRY_UNSAFE: FrozenSet[str] = frozenset(
+    o.name for o in OPS if not o.idempotent)
+
+
+def spec(name: str) -> OpSpec:
+    """The OpSpec for ``name`` (KeyError on an unknown op)."""
+    for o in OPS:
+        if o.name == name:
+            return o
+    raise KeyError(f"unknown control-plane op {name!r}")

@@ -212,6 +212,11 @@ void bf_timeline_close(void* handle) {
 
 namespace {
 
+// Declared in NUMERIC order — this enum is the C++ half of the wire-protocol
+// op table whose Python half is bluefog_tpu/runtime/protocol.py (OPS).
+// scripts/bfcheck's `protocol` analyzer parses both and asserts a bijection
+// (names, codes, and the IsDedupOp retry classification below), so keep the
+// two in lockstep and the declarations in code order.
 enum Op : uint8_t {
   kBarrier = 1, kLock = 2, kUnlock = 3, kFetchAdd = 4, kPut = 5, kGet = 6,
   kShutdown = 7, kAppendBytes = 8, kTakeBytes = 9, kPutBytes = 10,
@@ -226,6 +231,15 @@ enum Op : uint8_t {
   //     learns the range to fan out before issuing kGetBytesPart reads).
   //   kGetBytesPart: arg = (offset << 32) | len; bulk reply = that slice.
   kPutBytesPart = 14, kBytesLen = 15, kGetBytesPart = 16,
+  // Op-sequence preamble (r8, fault tolerance): a reply-less annotation the
+  // client writes immediately before a NON-IDEMPOTENT op (or pipelined
+  // batch): key = 8 raw bytes of the client's stable id, arg = batch
+  // sequence number, data = u32 op count. The server dedups the following
+  // `count` ops per (client, seq): a request retried after a lost reply is
+  // answered from the recorded reply instead of being applied twice (the
+  // reconnecting transport's exactly-once contract for fetch_add / append /
+  // take / unlock / barrier / striped-put parts).
+  kSeqPre = 17,
   // Incarnation registration (r9, elastic membership): key = 8 raw bytes of
   // the client's dedup id, arg = the process's incarnation number
   // (BLUEFOG_INCARNATION; a respawned rank attaches with the previous value
@@ -240,15 +254,6 @@ enum Op : uint8_t {
   // connection's, the op is answered with the 4-byte kStaleFrame sentinel
   // instead of being applied.
   kAttach = 18,
-  // Op-sequence preamble (r8, fault tolerance): a reply-less annotation the
-  // client writes immediately before a NON-IDEMPOTENT op (or pipelined
-  // batch): key = 8 raw bytes of the client's stable id, arg = batch
-  // sequence number, data = u32 op count. The server dedups the following
-  // `count` ops per (client, seq): a request retried after a lost reply is
-  // answered from the recorded reply instead of being applied twice (the
-  // reconnecting transport's exactly-once contract for fetch_add / append /
-  // take / unlock / barrier / striped-put parts).
-  kSeqPre = 17,
 };
 
 // Reply status codes shared with the Python layer (runtime/native.py):
@@ -529,6 +534,21 @@ struct DedupEntry {
   uint32_t inflight = 0xFFFFFFFFu;
 };
 
+// Bounded condvar wait that stays visible to ThreadSanitizer. libstdc++
+// lowers condition_variable::wait_for (steady_clock) to
+// pthread_cond_clockwait, which older TSan runtimes (gcc 10's) do NOT
+// intercept — the wait's internal mutex unlock/relock then goes unmodeled,
+// the sanitizer's lock model corrupts, and `make tsan` floods with false
+// "double lock of a mutex" cascades. wait_until against system_clock
+// lowers to the intercepted pthread_cond_timedwait instead. Every caller
+// is a predicate loop polling a few times per second (or stop()'s bounded
+// drain), so a realtime clock jump at worst perturbs one poll interval.
+inline void BoundedWaitMs(std::condition_variable& cv,
+                          std::unique_lock<std::mutex>& lk, int ms) {
+  cv.wait_until(lk, std::chrono::system_clock::now() +
+                        std::chrono::milliseconds(ms));
+}
+
 struct ControlServer {
   int listen_fd = -1;
   int world = 0;
@@ -540,6 +560,18 @@ struct ControlServer {
   std::vector<int> handler_fds;    // live connections only (pruned on close)
   int active_handlers = 0;         // guarded by mu; handlers are detached
   std::atomic<bool> stopping{false};
+  // Lifetime: the server is shared between its owner (bf_cp_serve*) and
+  // every detached handler thread. Each holds one reference; whoever drops
+  // the LAST one deletes. A thread only drops its reference after it has
+  // fully exited every mu/cv critical section, so the delete can never race
+  // the tail of another thread's pthread_mutex_unlock (the classic mutex-
+  // destruction hazard TSan flags when stop() deletes while a handler is
+  // still inside its final unlock). Found by `make tsan`.
+  std::atomic<int> refs{1};
+
+  void Unref() {
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
 
   std::mutex mu;
   std::condition_variable cv;
@@ -861,7 +893,7 @@ struct ControlServer {
               break;
             }
             if (e.inflight == ded_idx && !stopping.load()) {
-              cv.wait_for(lk, std::chrono::milliseconds(200));
+              BoundedWaitMs(cv, lk, 200);
               continue;
             }
             e.inflight = ded_idx;  // we execute it
@@ -923,7 +955,7 @@ struct ControlServer {
                     1, std::memory_order_relaxed);
                 break;
               }
-              cv.wait_for(lk, std::chrono::milliseconds(200));
+              BoundedWaitMs(cv, lk, 200);
               if (barrier_gen[key] == gen && !stopping.load()) {
                 lk.unlock();
                 bool closed = PeerClosed(fd);
@@ -980,7 +1012,7 @@ struct ControlServer {
               reply = kDeadHolderReply;
               break;
             }
-            cv.wait_for(lk, std::chrono::milliseconds(200));
+            BoundedWaitMs(cv, lk, 200);
             lk.unlock();
             bool closed = PeerClosed(fd);
             lk.lock();
@@ -1358,10 +1390,13 @@ struct ControlServer {
       }
       handler_fds.push_back(fd);
       ++active_handlers;
+      refs.fetch_add(1, std::memory_order_relaxed);
       // Detached: the reconnecting transport churns connections, and a
       // joinable-thread-per-connection vector would grow for the job's
-      // lifetime. stop() instead waits on active_handlers == 0.
-      std::thread([this, fd] { Handle(fd); }).detach();
+      // lifetime. stop() instead waits on active_handlers == 0. The
+      // Unref() after Handle() returns is the handler's LAST access to
+      // the server — it runs outside every critical section.
+      std::thread([this, fd] { Handle(fd); Unref(); }).detach();
     }
   }
 };
@@ -1406,7 +1441,9 @@ struct ControlClient {
 
   // Ops whose effect must be applied exactly once: a retry after a lost
   // reply goes out under a kSeqPre annotation so the server can replay the
-  // recorded reply instead of re-applying. Everything else (get/put/
+  // recorded reply instead of re-applying. This switch mirrors the
+  // `idempotent=False` rows of bluefog_tpu/runtime/protocol.py (bfcheck
+  // asserts the two sets are equal). Everything else (get/put/
   // bytes_len/ranged get/put_bytes/lock) is idempotent and retries raw —
   // a redundant lock re-grant is absorbed by per-rank re-entrancy, and a
   // dropped connection's locks were force-released server-side anyway.
@@ -1973,18 +2010,22 @@ void bf_cp_server_stop(void* handle) {
   ::close(srv->listen_fd);
   srv->accept_thread.join();
   // Wake every blocked handler (recv returns 0 after shutdown; cv waiters
-  // see `stopping`), then wait for the detached handlers to drain before
-  // freeing the server. A handler wedged past the grace (e.g. mid-write to
-  // a jammed peer) leaks the server object instead of risking a
-  // use-after-free under it.
+  // see `stopping`), then wait for the detached handlers to drain so the
+  // server is quiescent when stop() returns. Freeing is NOT done here:
+  // the owner merely drops its reference, and the last thread out —
+  // usually this one, but a handler wedged past the grace (e.g. mid-write
+  // to a jammed peer) finishes the job later — deletes the server. The
+  // old direct `delete srv` could destroy the mutex while the final
+  // handler was still inside its last pthread_mutex_unlock (caught by
+  // `make tsan`); the refcount hand-off cannot.
   {
     std::unique_lock<std::mutex> lk(srv->mu);
     for (int fd : srv->handler_fds) ::shutdown(fd, SHUT_RDWR);
-    if (!srv->cv.wait_for(lk, std::chrono::seconds(10),
-                          [&] { return srv->active_handlers == 0; }))
-      return;  // deliberate leak: a live handler still references *srv
+    srv->cv.wait_until(lk, std::chrono::system_clock::now() +
+                               std::chrono::seconds(10),
+                       [&] { return srv->active_handlers == 0; });
   }
-  delete srv;
+  srv->Unref();
 }
 
 // Fault-injection kill hook: hard-drop every live client connection (the

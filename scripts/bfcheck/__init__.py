@@ -1,0 +1,97 @@
+"""bfcheck — project-invariant static analysis for the bluefog_tpu tree.
+
+Four analyzers over the repository (run all via ``python scripts/bfcheck``,
+``make check``, or tier-1 through ``tests/test_bfcheck.py``):
+
+``protocol``
+    Wire-protocol consistency: the C++ ``enum Op`` + ``IsDedupOp`` retry
+    set in ``csrc/bf_runtime.cc`` must be a bijection with the Python op
+    table in ``bluefog_tpu/runtime/protocol.py`` — a new op cannot ship
+    with a missing mirror or a silently retry-unsafe classification.
+
+``knobs``
+    Env-knob registry: every ``BLUEFOG_*`` read in the tree must be
+    declared in ``runtime/config.py``'s ``KNOBS`` table, per-site literal
+    defaults must agree with the registry, and every declared knob must be
+    documented in ``docs/env_variables.md`` (whose knob table is generated
+    from the registry — ``python scripts/bfcheck --write-docs``).
+
+``locks``
+    Lock & thread discipline over the Python runtime: lock-order
+    inversions across the known thread entry points, blocking
+    control-plane calls made while holding a local mutex, and daemon
+    threads without stop/join wiring.
+
+``lint``
+    Minimal pyflakes-style fallback (unused imports, duplicate
+    definitions) used by ``make lint`` when ``ruff`` is not installed.
+
+A finding can be waived at its line with ``# bfcheck: ok-<check-id>`` plus
+a justification; waivers are themselves flagged when they stop matching
+anything. Analyzer self-tests (seeded violations) live in
+``tests/test_bfcheck.py``; the enforced invariants are documented in
+``docs/static_analysis.md``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List
+
+__all__ = [
+    "Diagnostic", "ANALYZERS", "run", "run_all", "repo_root",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: ``path:line: [analyzer] message``."""
+
+    analyzer: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.analyzer}] {self.message}"
+
+
+def repo_root(start: str = __file__) -> str:
+    """The repository root (directory holding ``bluefog_tpu`` and ``csrc``)."""
+    d = os.path.dirname(os.path.abspath(start))
+    while d != os.path.dirname(d):
+        if os.path.isdir(os.path.join(d, "bluefog_tpu")) and \
+                os.path.isdir(os.path.join(d, "csrc")):
+            return d
+        d = os.path.dirname(d)
+    raise RuntimeError("bfcheck: repository root not found")
+
+
+def _analyzers() -> Dict[str, Callable[[str], List[Diagnostic]]]:
+    # imported lazily so ``import bfcheck`` stays cheap and fixture tests
+    # can import individual analyzers directly
+    from . import knob_check, lint_check, lock_check, protocol_check
+
+    return {
+        "protocol": protocol_check.check,
+        "knobs": knob_check.check,
+        "locks": lock_check.check,
+        "lint": lint_check.check,
+    }
+
+
+ANALYZERS = ("protocol", "knobs", "locks", "lint")
+
+
+def run(name: str, root: str) -> List[Diagnostic]:
+    """Run one analyzer by name over the tree at ``root``."""
+    return _analyzers()[name](root)
+
+
+def run_all(root: str, names=None) -> List[Diagnostic]:
+    """Run the given analyzers (default: all) and return every finding."""
+    out: List[Diagnostic] = []
+    for name in (names or ANALYZERS):
+        out.extend(run(name, root))
+    return out

@@ -1,0 +1,78 @@
+"""CLI driver: ``python scripts/bfcheck [options]`` (or ``make check``).
+
+Exit status 0 = tree clean, 1 = findings (printed as ``file:line:
+[analyzer] message``), 2 = usage/setup error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Support both `python scripts/bfcheck` (dir on sys.path, no package
+# context) and `python -m bfcheck` from scripts/: ensure the parent dir is
+# importable and re-import ourselves as a package.
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if __package__ in (None, ""):
+    sys.path.insert(0, os.path.dirname(_HERE))
+    import bfcheck  # noqa: E402
+    from bfcheck import knob_check  # noqa: E402
+else:
+    from . import knob_check
+    import bfcheck  # noqa: F401 — resolved via sys.path by the runner
+
+    bfcheck = sys.modules[__package__.split(".")[0]]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bfcheck",
+        description="project-invariant static analysis for bluefog_tpu")
+    ap.add_argument("--root", default=None,
+                    help="repository root (default: auto-detected)")
+    ap.add_argument("--analyzer", "-a", action="append",
+                    choices=list(bfcheck.ANALYZERS), default=None,
+                    help="run only this analyzer (repeatable)")
+    ap.add_argument("--lint", action="store_true",
+                    help="shorthand for --analyzer lint (the make-lint "
+                         "fallback when ruff is unavailable)")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate the docs/env_variables.md knob table "
+                         "from the registry, then exit")
+    ap.add_argument("--list", action="store_true",
+                    help="list analyzers and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in bfcheck.ANALYZERS:
+            print(name)
+        return 0
+
+    try:
+        root = args.root or bfcheck.repo_root()
+    except RuntimeError as exc:
+        print(f"bfcheck: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_docs:
+        changed = knob_check.write_docs(root)
+        print("docs/env_variables.md: "
+              + ("knob table regenerated" if changed else "already current"))
+        return 0
+
+    names = args.analyzer or (["lint"] if args.lint else None)
+    findings = bfcheck.run_all(root, names)
+    for d in findings:
+        print(d)
+    ran = ", ".join(names or bfcheck.ANALYZERS)
+    if findings:
+        print(f"bfcheck: {len(findings)} finding(s) [{ran}]",
+              file=sys.stderr)
+        return 1
+    print(f"bfcheck: clean [{ran}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Minimal pyflakes-style fallback linter.
+
+``make lint`` prefers ``ruff`` (configured in pyproject.toml); on boxes
+without it this analyzer keeps the highest-signal checks enforceable with
+the stdlib only:
+
+* **unused imports** — a module-level ``import``/``from-import`` whose
+  bound name is never referenced again in the file (``# noqa`` on the
+  line, conventional re-export contexts like ``__init__.py``, and names
+  listed in ``__all__`` are exempt),
+* **duplicate definitions** — two top-level ``def``/``class`` statements
+  binding the same name in one module (the later silently shadows the
+  earlier; almost always a copy-paste casualty).
+
+Scope matches the ruff config: ``bluefog_tpu/``, ``scripts/``,
+``tests/``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from . import Diagnostic
+
+SCAN_ROOTS = ("bluefog_tpu", "scripts", "tests")
+
+
+def _names_used(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # the ROOT of an attribute chain is a name usage
+            base = node.value
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    return used
+
+
+def _exported(tree: ast.AST) -> set:
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__" and \
+                        isinstance(node.value, (ast.List, ast.Tuple)):
+                    out.update(e.value for e in node.value.elts
+                               if isinstance(e, ast.Constant)
+                               and isinstance(e.value, str))
+    return out
+
+
+def check_file(path: str, rel: str) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as exc:
+        return [Diagnostic("lint", rel, exc.lineno or 1,
+                           f"syntax error: {exc.msg}")]
+    lines = src.splitlines()
+    used = _names_used(tree)
+    exported = _exported(tree)
+    reexport_ok = os.path.basename(path) == "__init__.py"
+
+    # unused imports (module level only; function-local imports are almost
+    # always deliberate lazy imports in this tree)
+    for node in tree.body:
+        names = []
+        if isinstance(node, ast.Import):
+            names = [(a.asname or a.name.split(".")[0], a) for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__" or \
+                    any(a.name == "*" for a in node.names):
+                continue
+            names = [(a.asname or a.name, a) for a in node.names]
+        if not names:
+            continue
+        line_text = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        span = "\n".join(lines[node.lineno - 1:node.end_lineno])
+        if "noqa" in line_text or "noqa" in span:
+            continue
+        for bound, alias in names:
+            if bound.startswith("_"):
+                continue
+            if reexport_ok or bound in exported:
+                continue
+            # count references excluding the import statement itself
+            if bound not in used or _only_import_uses(tree, bound):
+                out.append(Diagnostic(
+                    "lint", rel, node.lineno,
+                    f"'{bound}' imported but unused (delete it, or mark a "
+                    "deliberate re-export with `# noqa: F401`)"))
+
+    # duplicate top-level definitions
+    seen = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen:
+                out.append(Diagnostic(
+                    "lint", rel, node.lineno,
+                    f"redefinition of '{node.name}' (first defined at "
+                    f"line {seen[node.name]}) — the earlier definition is "
+                    "dead"))
+            else:
+                seen[node.name] = node.lineno
+    return out
+
+
+def _only_import_uses(tree: ast.AST, name: str) -> bool:
+    """True when every Name reference to ``name`` sits inside an import
+    statement (i.e. no real use)."""
+    import_lines = set()
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            import_lines.update(range(node.lineno, (node.end_lineno or
+                                                    node.lineno) + 1))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id == name and \
+                node.lineno not in import_lines:
+            return False
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id == name and \
+                    base.lineno not in import_lines:
+                return False
+    return True
+
+
+def check(root: str) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for entry in SCAN_ROOTS:
+        base = os.path.join(root, entry)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "build")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    path = os.path.join(dirpath, fn)
+                    out.extend(check_file(path, os.path.relpath(path, root)))
+    return out

@@ -1,0 +1,307 @@
+"""Lock & thread discipline analyzer (Python runtime).
+
+Three checks over ``bluefog_tpu/`` (AST only, no imports):
+
+``lock-order``
+    Builds the lock-acquisition graph: a ``with <lock>:`` / ``.acquire()``
+    nested inside another lock's scope records the ordered pair
+    (outer → inner), keyed by the lock's attribute/variable name
+    (``self._mu`` → ``_mu``; ``win.state_mu`` → ``state_mu``;
+    ``win_mutex(...)`` → ``win_mutex``). One interprocedural hop is
+    followed: a call made while holding L to a same-module function that
+    acquires M also records (L → M). Any cycle in the global graph is a
+    potential deadlock between thread entry points and is reported at
+    both edges.
+
+``blocking-under-lock``
+    Flags calls that can block on the control-plane SERVER — names in
+    ``BLOCKING_CALLS`` (``barrier``, distributed ``lock``, ``win_mutex``,
+    ``synchronize``…) — made while a local ``threading`` lock is held:
+    a handler parked for seconds while holding a process-local mutex
+    stalls every other thread that needs it (the heartbeat above all).
+    Sites that hold a lock across a blocking call DELIBERATELY carry a
+    ``# bfcheck: ok-blocking-under-lock (reason)`` waiver on the call
+    line, which this check honors (and reports when unused).
+
+``daemon-join``
+    Every ``threading.Thread(daemon=True)`` creation must have stop/join
+    wiring: a ``.join(`` somewhere in the same module (matching how the
+    thread object is stored), or an explicit
+    ``# bfcheck: ok-daemon-no-join (reason)`` waiver. Fire-and-forget
+    daemons outlive shutdown and segfault interpreters at teardown.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Diagnostic
+
+PY_ROOT = "bluefog_tpu"
+
+# Calls that can park on the control-plane server (or a peer) indefinitely.
+BLOCKING_CALLS = {
+    "barrier", "win_mutex", "mutex_acquire", "_acquire", "_acquire_all",
+    "synchronize", "lock",
+}
+
+# Lock names recognized as process-local threading locks. Derived from the
+# naming convention the runtime actually uses; the analyzer also treats any
+# `with X:` whose key ends in one of these suffixes as a lock scope.
+LOCK_SUFFIXES = ("_mu", "_lock", "mutex", "mutexes", "state_mu", "_gate",
+                 "_gates")
+
+WAIVER_BLOCKING = "bfcheck: ok-blocking-under-lock"
+WAIVER_DAEMON = "bfcheck: ok-daemon-no-join"
+
+
+def _key_of(node) -> Optional[str]:
+    """Normalize a lock expression to its stable name key."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        return _key_of(node.value)
+    if isinstance(node, ast.Call):
+        return _call_name(node)
+    return None
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _looks_like_lock(key: str) -> bool:
+    return key is not None and (
+        key.endswith(LOCK_SUFFIXES) or key in ("win_mutex",))
+
+
+class _FuncInfo:
+    """Per-function facts: locks acquired at top level, ordered pairs,
+    blocking calls with held-lock context, calls made under each lock."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.acquires: List[Tuple[str, int]] = []          # (lock, line)
+        self.pairs: List[Tuple[str, str, int]] = []        # (outer, inner)
+        self.blocking: List[Tuple[str, str, int]] = []     # (lock, call)
+        self.calls_under: List[Tuple[str, str, int]] = []  # (lock, callee)
+
+
+class _ModuleScanner(ast.NodeVisitor):
+    def __init__(self, rel: str, waived_lines: Set[int]) -> None:
+        self.rel = rel
+        self.waived_lines = waived_lines
+        self.funcs: Dict[str, _FuncInfo] = {}
+        self._stack: List[str] = []      # held locks (lexical)
+        self._fn: Optional[_FuncInfo] = None
+
+    # -- function scoping ---------------------------------------------------
+
+    def _visit_fn(self, node) -> None:
+        prev_fn, prev_stack = self._fn, self._stack
+        info = _FuncInfo(node.name)
+        # methods of different classes may share names; last one wins is
+        # acceptable for this analysis (keys are advisory)
+        self.funcs[node.name] = info
+        self._fn, self._stack = info, []
+        for child in node.body:
+            self.visit(child)
+        self._fn, self._stack = prev_fn, prev_stack
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- lock scopes --------------------------------------------------------
+
+    def _record_acquire(self, key: str, line: int) -> None:
+        if self._fn is None:
+            return
+        if not self._stack:
+            self._fn.acquires.append((key, line))
+        for outer in self._stack:
+            if outer != key:
+                self._fn.pairs.append((outer, key, line))
+
+    def visit_With(self, node: ast.With) -> None:
+        keys = []
+        for item in node.items:
+            key = _key_of(item.context_expr)
+            if key is not None and (_looks_like_lock(key)
+                                    or key in BLOCKING_CALLS):
+                # a `with win_mutex(...)` is both an acquisition and a
+                # potentially blocking server call
+                if key in BLOCKING_CALLS and self._stack and \
+                        node.lineno not in self.waived_lines and \
+                        self._fn is not None:
+                    self._fn.blocking.append(
+                        (self._stack[-1], key, node.lineno))
+                self._record_acquire(key, node.lineno)
+                keys.append(key)
+        self._stack.extend(keys)
+        for child in node.body:
+            self.visit(child)
+        for _ in keys:
+            self._stack.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _call_name(node)
+        if name == "acquire" and isinstance(node.func, ast.Attribute):
+            key = _key_of(node.func.value)
+            if _looks_like_lock(key):
+                # .acquire() without `with`: treat the rest of the function
+                # as holding it (matching the acquire/try/finally idiom)
+                self._record_acquire(key, node.lineno)
+                self._stack.append(key)
+        elif name in BLOCKING_CALLS and self._fn is not None \
+                and self._stack and node.lineno not in self.waived_lines:
+            self._fn.blocking.append((self._stack[-1], name, node.lineno))
+        elif name and self._fn is not None and self._stack:
+            self._fn.calls_under.append((self._stack[-1], name, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        # releases in `finally:` close the acquire/try/finally idiom; pop
+        # any lock released there once the try block is done
+        for child in node.body + node.handlers + node.orelse:
+            self.visit(child)
+        released = set()
+        for child in node.finalbody:
+            for sub in ast.walk(child):
+                if isinstance(sub, ast.Call) and \
+                        _call_name(sub) == "release" and \
+                        isinstance(sub.func, ast.Attribute):
+                    key = _key_of(sub.func.value)
+                    if key:
+                        released.add(key)
+            self.visit(child)
+        for key in released:
+            if key in self._stack:
+                self._stack.remove(key)
+
+
+def _waived(src: str, marker: str) -> Set[int]:
+    """Lines covered by a waiver comment: the marker's own line plus the
+    following few lines (a waiver usually sits in a comment block just
+    above the flagged statement)."""
+    out = set()
+    for i, line in enumerate(src.splitlines(), 1):
+        if marker in line:
+            out.update(range(i, i + 7))
+    return out
+
+
+def check(root: str) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+
+    def bad(path, line, msg):
+        out.append(Diagnostic("locks", path, line, msg))
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    py_root = os.path.join(root, PY_ROOT)
+    for dirpath, dirnames, filenames in os.walk(py_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError as exc:
+                bad(rel, exc.lineno or 1, f"syntax error: {exc.msg}")
+                continue
+
+            scanner = _ModuleScanner(rel, _waived(src, WAIVER_BLOCKING))
+            scanner.visit(tree)
+
+            # intraprocedural pairs -> global edge set
+            for info in scanner.funcs.values():
+                for outer, inner, line in info.pairs:
+                    edges.setdefault((outer, inner), (rel, line))
+                # one interprocedural hop: call under L to a same-module
+                # function whose top level acquires M
+                for lock, callee, line in info.calls_under:
+                    target = scanner.funcs.get(callee)
+                    if target is None:
+                        continue
+                    for inner, _ in target.acquires:
+                        if inner != lock:
+                            edges.setdefault((lock, inner), (rel, line))
+                for lock, call, line in info.blocking:
+                    bad(rel, line,
+                        f"potentially blocking control-plane call "
+                        f"'{call}' while holding local lock '{lock}' — a "
+                        "parked server op would stall every thread "
+                        "needing that lock (waive deliberate sites with "
+                        f"`# {WAIVER_BLOCKING} (reason)`)")
+
+            # daemon-thread join wiring
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and _call_name(node) == "Thread"):
+                    continue
+                daemon = any(kw.arg == "daemon"
+                             and isinstance(kw.value, ast.Constant)
+                             and kw.value.value is True
+                             for kw in node.keywords)
+                if not daemon:
+                    continue
+                if node.lineno in _waived(src, WAIVER_DAEMON) or \
+                        (node.lineno - 1) in _waived(src, WAIVER_DAEMON):
+                    continue
+                if ".join(" not in src:
+                    bad(rel, node.lineno,
+                        "daemon thread created but this module never "
+                        "join()s any thread — wire a stop()/join() path "
+                        "or waive with "
+                        f"`# {WAIVER_DAEMON} (reason)`")
+
+    # cycles in the global lock-order graph
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    reported = set()
+    for (a, b), (rel, line) in sorted(edges.items()):
+        if (b, a) in edges and (b, a) not in reported:
+            reported.add((a, b))
+            rel2, line2 = edges[(b, a)]
+            bad(rel, line,
+                f"lock-order inversion: '{a}' → '{b}' here but "
+                f"'{b}' → '{a}' at {rel2}:{line2} — two threads taking "
+                "them in opposite orders deadlock")
+    # longer cycles (3+): DFS
+    def _find_cycle(start: str) -> Optional[List[str]]:
+        seen, stack = set(), [(start, [start])]
+        while stack:
+            node, path_ = stack.pop()
+            for nxt in graph.get(node, ()):  # noqa: B007
+                if nxt == start and len(path_) > 2:
+                    return path_ + [start]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path_ + [nxt]))
+        return None
+
+    for start in sorted(graph):
+        cyc = _find_cycle(start)
+        if cyc and not any((cyc[i], cyc[i + 1]) in reported
+                           or (cyc[i + 1], cyc[i]) in reported
+                           for i in range(len(cyc) - 1)):
+            rel, line = edges[(cyc[0], cyc[1])]
+            reported.add((cyc[0], cyc[1]))
+            bad(rel, line,
+                "lock-order cycle: " + " → ".join(cyc)
+                + " — break one edge or order the acquisitions")
+    return out

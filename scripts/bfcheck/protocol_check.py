@@ -1,0 +1,168 @@
+"""Wire-protocol consistency analyzer.
+
+Parses the C++ side of the control-plane protocol out of
+``csrc/bf_runtime.cc`` — the ``enum Op`` block and the client's
+``IsDedupOp`` retry switch — and cross-checks it against the Python
+source of truth, ``bluefog_tpu/runtime/protocol.py``:
+
+* the (enumerator, code) pairs must be a BIJECTION with the OPS table
+  (no op missing a mirror, no code clash, no name drift),
+* enum declarations must appear in numeric order (the canonical anchor
+  both mirrors share),
+* the ``IsDedupOp`` case set must equal the table's retry-unsafe rows
+  (``idempotent=False``) — the cross-check that keeps a new op from
+  shipping retry-unsafe: adding it to the enum without deciding its
+  idempotency, or deciding it on one side only, fails here.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+from typing import List
+
+from . import Diagnostic
+
+CC_PATH = os.path.join("csrc", "bf_runtime.cc")
+PY_PATH = os.path.join("bluefog_tpu", "runtime", "protocol.py")
+
+_ENUM_RE = re.compile(r"enum\s+Op\s*:\s*uint8_t\s*\{(.*?)\};", re.S)
+_ENTRY_RE = re.compile(r"\bk([A-Za-z0-9]+)\s*=\s*(\d+)")
+_DEDUP_RE = re.compile(
+    r"IsDedupOp\s*\(uint8_t\s+\w+\)\s*\{(.*?)\n  \}", re.S)
+_CASE_RE = re.compile(r"case\s+k([A-Za-z0-9]+)\s*:")
+
+
+def _line_of(text: str, offset: int) -> int:
+    return text.count("\n", 0, offset) + 1
+
+
+def load_protocol(root: str):
+    """Load runtime/protocol.py by path (dependency-free module, so this
+    works for fixture trees without importing the bluefog_tpu package)."""
+    path = os.path.join(root, PY_PATH)
+    spec = importlib.util.spec_from_file_location("_bfcheck_protocol", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules
+    import sys
+
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return mod
+
+
+def parse_cxx(root: str):
+    """((name, code, line) enum entries, {dedup case names}, cc text)."""
+    path = os.path.join(root, CC_PATH)
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    m = _ENUM_RE.search(text)
+    entries = []
+    if m:
+        # strip comments inside the enum body before scanning entries
+        body = re.sub(r"//[^\n]*", "", m.group(1))
+        base = m.start(1)
+        for em in _ENTRY_RE.finditer(body):
+            # line numbers come from the uncommented body; recompute against
+            # the original text by locating the exact "kName = N" token
+            tok = re.search(r"\bk%s\s*=\s*%s\b" % (em.group(1), em.group(2)),
+                            text[base:m.end(1)])
+            line = _line_of(text, base + tok.start()) if tok else \
+                _line_of(text, m.start())
+            entries.append((f"k{em.group(1)}", int(em.group(2)), line))
+    dm = _DEDUP_RE.search(text)
+    dedup = set()
+    dedup_line = _line_of(text, dm.start()) if dm else 1
+    if dm:
+        dedup = {f"k{c}" for c in _CASE_RE.findall(dm.group(1))}
+    return entries, dedup, dedup_line, text
+
+
+def check(root: str) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+
+    def bad(path, line, msg):
+        out.append(Diagnostic("protocol", path, line, msg))
+
+    try:
+        proto = load_protocol(root)
+    except (OSError, SyntaxError) as exc:
+        bad(PY_PATH, 1, f"cannot load protocol table: {exc}")
+        return out
+    entries, dedup, dedup_line, _ = parse_cxx(root)
+    if not entries:
+        bad(CC_PATH, 1, "enum Op not found (parser anchor lost? keep the "
+                        "`enum Op : uint8_t {` spelling)")
+        return out
+
+    ops = {o.cxx: o for o in proto.OPS}
+    codes_py = {o.cxx: o.code for o in proto.OPS}
+    cxx = {name: code for name, code, _ in entries}
+    lines = {name: line for name, code, line in entries}
+
+    # bijection: names
+    for name, code, line in entries:
+        if name not in ops:
+            bad(CC_PATH, line,
+                f"C++ op {name} = {code} has no row in "
+                f"{PY_PATH} OPS — declare it (and decide its idempotency) "
+                "before shipping")
+    for o in proto.OPS:
+        if o.cxx not in cxx:
+            bad(PY_PATH, 1,
+                f"Python op {o.name!r} ({o.cxx} = {o.code}) is missing "
+                f"from the C++ enum in {CC_PATH}")
+    # bijection: codes agree + unique
+    for name, code, line in entries:
+        if name in codes_py and codes_py[name] != code:
+            bad(CC_PATH, line,
+                f"{name} = {code} in C++ but {codes_py[name]} in "
+                f"{PY_PATH} — the wire would desync")
+    seen = {}
+    for name, code, line in entries:
+        if code in seen:
+            bad(CC_PATH, line,
+                f"duplicate op code {code}: {name} clashes with "
+                f"{seen[code]}")
+        seen[code] = name
+    py_codes_seen = {}
+    for o in proto.OPS:
+        if o.code in py_codes_seen:
+            bad(PY_PATH, 1,
+                f"duplicate op code {o.code}: {o.name!r} clashes with "
+                f"{py_codes_seen[o.code]!r}")
+        py_codes_seen[o.code] = o.name
+
+    # numeric declaration order (the shared canonical anchor)
+    codes_in_order = [code for _, code, _ in entries]
+    if codes_in_order != sorted(codes_in_order):
+        first_bad = next(
+            (i for i in range(1, len(codes_in_order))
+             if codes_in_order[i] < codes_in_order[i - 1]), 0)
+        name, code, line = entries[first_bad]
+        bad(CC_PATH, line,
+            f"enum Op declarations out of numeric order at {name} = {code} "
+            "— keep the C++ enum sorted so diffs against the Python mirror "
+            "stay reviewable")
+
+    # retry-safety cross-check: IsDedupOp == idempotent=False rows
+    unsafe_py = {o.cxx for o in proto.OPS if not o.idempotent}
+    for name in sorted(dedup - unsafe_py):
+        bad(CC_PATH, dedup_line,
+            f"{name} rides the kSeqPre dedup path in C++ but is declared "
+            f"idempotent in {PY_PATH} — reconcile the classification")
+    for name in sorted(unsafe_py - dedup):
+        bad(CC_PATH, dedup_line,
+            f"{name} is declared retry-UNSAFE (idempotent=False) in "
+            f"{PY_PATH} but missing from IsDedupOp — a retried "
+            f"{ops[name].name} after a lost reply would be applied twice")
+    # every C++ dedup case must at least be a known enum entry
+    for name in sorted(dedup - set(cxx)):
+        bad(CC_PATH, dedup_line,
+            f"IsDedupOp names {name}, which is not in enum Op")
+    _ = lines
+    return out

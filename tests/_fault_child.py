@@ -9,7 +9,6 @@ hanging in a collective, then leave without waiting on the corpse.
 """
 
 import os
-import sys
 import time
 
 import numpy as np

@@ -1,6 +1,5 @@
 """Runtime lifecycle + topology management (model: test/torch_basics_test.py)."""
 
-import numpy as np
 import jax.numpy as jnp
 import pytest
 

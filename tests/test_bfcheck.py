@@ -1,0 +1,480 @@
+"""bfcheck self-tests: the real tree must be clean, and each analyzer must
+catch its seeded violation with a file:line diagnostic.
+
+The seeded fixtures are miniature repository roots written to tmp_path —
+one violation each for: a C++ op missing its Python mirror, a code
+mismatch, a retry-unsafe op absent from IsDedupOp, an undeclared knob
+read, a per-site default contradicting the registry, a lock-order
+inversion, a joinless daemon thread, a blocking call under a local lock,
+and an unused import (the lint fallback).
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+import bfcheck  # noqa: E402
+from bfcheck import (knob_check, lint_check, lock_check,  # noqa: E402
+                     protocol_check)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the real tree is clean (tier-1's `make check` equivalence)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("analyzer", bfcheck.ANALYZERS)
+def test_real_tree_is_clean(analyzer):
+    findings = bfcheck.run(analyzer, ROOT)
+    assert findings == [], "\n".join(str(d) for d in findings)
+
+
+def test_cli_runs_clean():
+    import subprocess
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bfcheck")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# fixture scaffolding
+# ---------------------------------------------------------------------------
+
+MINI_PROTOCOL = textwrap.dedent('''
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class OpSpec:
+        name: str
+        code: int
+        cxx: str
+        idempotent: bool
+        doc: str = ""
+
+    OPS = (
+        OpSpec("barrier", 1, "kBarrier", False),
+        OpSpec("get", 2, "kGet", True),
+        OpSpec("fetch_add", 3, "kFetchAdd", False),
+    )
+    OP_CODES = {o.name: o.code for o in OPS}
+    OP_NAMES = {o.code: o.name for o in OPS}
+    RETRY_UNSAFE = frozenset(o.name for o in OPS if not o.idempotent)
+''')
+
+MINI_CC = textwrap.dedent('''
+    // fixture control plane
+    enum Op : uint8_t {
+      kBarrier = 1, kGet = 2, kFetchAdd = 3,
+    };
+    struct Client {
+      static bool IsDedupOp(uint8_t op) {
+        switch (op) {
+          case kBarrier:
+          case kFetchAdd:
+            return true;
+          default:
+            return false;
+        }
+      }
+    };
+''')
+
+
+def make_proto_tree(tmp_path, cc=MINI_CC, proto=MINI_PROTOCOL):
+    (tmp_path / "csrc").mkdir()
+    (tmp_path / "bluefog_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "csrc" / "bf_runtime.cc").write_text(cc)
+    (tmp_path / "bluefog_tpu" / "runtime" / "protocol.py").write_text(proto)
+    return str(tmp_path)
+
+
+MINI_CONFIG = textwrap.dedent('''
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class Knob:
+        name: str
+        type: str
+        default: object
+        doc: str
+        scope: str = "python"
+
+    KNOBS = (
+        Knob("BLUEFOG_DEMO_TIMEOUT", "float", 30.0, "demo timeout"),
+        Knob("BLUEFOG_DEMO_FLAG", "bool", False, "demo flag"),
+    )
+''')
+
+
+def make_knob_tree(tmp_path, reader_src, config=MINI_CONFIG):
+    rt = tmp_path / "bluefog_tpu" / "runtime"
+    rt.mkdir(parents=True)
+    (rt / "config.py").write_text(config)
+    (tmp_path / "bluefog_tpu" / "reader.py").write_text(reader_src)
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    import importlib
+
+    importlib.reload(knob_check)
+    table = knob_check.render_knob_table(
+        {k.name: k for k in _load_knobs(str(rt / "config.py"))})
+    (docs / "env_variables.md").write_text(
+        "# Environment variables\n\n" + table)
+    return str(tmp_path)
+
+
+def _load_knobs(path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_fix_cfg", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return mod.KNOBS
+
+
+def findings_for(diags, path_part):
+    return [d for d in diags if path_part in d.path]
+
+
+# ---------------------------------------------------------------------------
+# protocol analyzer fixtures
+# ---------------------------------------------------------------------------
+
+def test_protocol_clean_fixture(tmp_path):
+    root = make_proto_tree(tmp_path)
+    assert protocol_check.check(root) == []
+
+
+def test_protocol_missing_python_mirror(tmp_path):
+    cc = MINI_CC.replace("kFetchAdd = 3,", "kFetchAdd = 3, kNewOp = 4,")
+    root = make_proto_tree(tmp_path, cc=cc)
+    diags = protocol_check.check(root)
+    assert any("kNewOp" in d.message and "no row" in d.message
+               for d in diags)
+    d = next(d for d in diags if "kNewOp" in d.message)
+    assert d.path.endswith("bf_runtime.cc") and d.line > 1
+
+
+def test_protocol_missing_cxx_mirror(tmp_path):
+    proto = MINI_PROTOCOL.replace(
+        'OpSpec("fetch_add", 3, "kFetchAdd", False),',
+        'OpSpec("fetch_add", 3, "kFetchAdd", False),\n'
+        '    OpSpec("new_op", 4, "kNewOp", True),')
+    root = make_proto_tree(tmp_path, proto=proto)
+    diags = protocol_check.check(root)
+    assert any("missing from the C++ enum" in d.message for d in diags)
+
+
+def test_protocol_code_mismatch(tmp_path):
+    cc = MINI_CC.replace("kFetchAdd = 3", "kFetchAdd = 9")
+    root = make_proto_tree(tmp_path, cc=cc)
+    diags = protocol_check.check(root)
+    assert any("desync" in d.message for d in diags)
+
+
+def test_protocol_out_of_numeric_order(tmp_path):
+    cc = MINI_CC.replace("kBarrier = 1, kGet = 2, kFetchAdd = 3,",
+                         "kBarrier = 1, kFetchAdd = 3, kGet = 2,")
+    root = make_proto_tree(tmp_path, cc=cc)
+    diags = protocol_check.check(root)
+    assert any("numeric order" in d.message for d in diags)
+
+
+def test_protocol_retry_unsafe_not_in_dedup(tmp_path):
+    # fetch_add declared retry-unsafe in Python but dropped from IsDedupOp:
+    # the exact "ships retry-unsafe" hole the analyzer exists for
+    cc = MINI_CC.replace("      case kFetchAdd:\n", "")
+    root = make_proto_tree(tmp_path, cc=cc)
+    diags = protocol_check.check(root)
+    assert any("missing from IsDedupOp" in d.message
+               and "applied twice" in d.message for d in diags)
+
+
+def test_protocol_dedup_of_idempotent_op(tmp_path):
+    cc = MINI_CC.replace("case kBarrier:", "case kBarrier:\n      case kGet:")
+    root = make_proto_tree(tmp_path, cc=cc)
+    diags = protocol_check.check(root)
+    assert any("kGet" in d.message and "declared idempotent" in d.message
+               for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# knob analyzer fixtures
+# ---------------------------------------------------------------------------
+
+def test_knobs_clean_fixture(tmp_path):
+    root = make_knob_tree(tmp_path, textwrap.dedent('''
+        import os
+        t = float(os.environ.get("BLUEFOG_DEMO_TIMEOUT", "30"))
+        f = os.environ.get("BLUEFOG_DEMO_FLAG", "0") == "1"
+    '''))
+    assert knob_check.check(root) == []
+
+
+def test_knobs_undeclared_read(tmp_path):
+    root = make_knob_tree(tmp_path, textwrap.dedent('''
+        import os
+        x = os.environ.get("BLUEFOG_NOT_DECLARED", "1")
+    '''))
+    diags = knob_check.check(root)
+    hits = findings_for(diags, "reader.py")
+    assert hits and "undeclared knob BLUEFOG_NOT_DECLARED" in hits[0].message
+    assert hits[0].line == 3
+
+
+def test_knobs_contradicting_default(tmp_path):
+    root = make_knob_tree(tmp_path, textwrap.dedent('''
+        import os
+        t = float(os.environ.get("BLUEFOG_DEMO_TIMEOUT", "45"))
+    '''))
+    diags = knob_check.check(root)
+    hits = findings_for(diags, "reader.py")
+    assert hits and "contradicts the registry default" in hits[0].message
+    assert "45" in hits[0].message and hits[0].line == 3
+
+
+def test_knobs_subscript_and_membership_reads_are_seen(tmp_path):
+    root = make_knob_tree(tmp_path, textwrap.dedent('''
+        import os
+        if "BLUEFOG_MYSTERY" in os.environ:
+            y = os.environ["BLUEFOG_MYSTERY2"]
+    '''))
+    diags = knob_check.check(root)
+    msgs = "\n".join(d.message for d in findings_for(diags, "reader.py"))
+    assert "BLUEFOG_MYSTERY" in msgs and "BLUEFOG_MYSTERY2" in msgs
+
+
+def test_knobs_writes_are_ignored(tmp_path):
+    root = make_knob_tree(tmp_path, textwrap.dedent('''
+        import os
+        os.environ["BLUEFOG_SOME_WRITE"] = "1"
+        del os.environ["BLUEFOG_SOME_WRITE"]
+    '''))
+    assert findings_for(knob_check.check(root), "reader.py") == []
+
+
+def test_knobs_stale_docs_table(tmp_path):
+    root = make_knob_tree(tmp_path, "x = 1\n")
+    docs = os.path.join(root, "docs", "env_variables.md")
+    with open(docs) as f:
+        text = f.read()
+    with open(docs, "w") as f:
+        f.write(text.replace("demo timeout", "stale words"))
+    diags = knob_check.check(root)
+    assert any("stale" in d.message for d in diags)
+    # --write-docs repairs it
+    knob_check.write_docs(root)
+    assert knob_check.check(root) == []
+
+
+# ---------------------------------------------------------------------------
+# lock analyzer fixtures
+# ---------------------------------------------------------------------------
+
+def make_lock_tree(tmp_path, src):
+    pkg = tmp_path / "bluefog_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    return str(tmp_path)
+
+
+def test_locks_clean_fixture(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+        a_mu = threading.Lock()
+        b_mu = threading.Lock()
+
+        def fine():
+            with a_mu:
+                with b_mu:
+                    pass
+
+        def also_fine():
+            with a_mu:
+                with b_mu:
+                    pass
+    '''))
+    assert lock_check.check(root) == []
+
+
+def test_locks_order_inversion(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+        a_mu = threading.Lock()
+        b_mu = threading.Lock()
+
+        def one():
+            with a_mu:
+                with b_mu:
+                    pass
+
+        def other():
+            with b_mu:
+                with a_mu:
+                    pass
+    '''))
+    diags = lock_check.check(root)
+    assert any("lock-order inversion" in d.message for d in diags)
+    d = next(d for d in diags if "inversion" in d.message)
+    assert d.path.endswith("mod.py") and d.line > 0
+    assert "a_mu" in d.message and "b_mu" in d.message
+
+
+def test_locks_interprocedural_inversion(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+        a_mu = threading.Lock()
+        b_mu = threading.Lock()
+
+        def helper():
+            with b_mu:
+                pass
+
+        def one():
+            with a_mu:
+                helper()
+
+        def other():
+            with b_mu:
+                with a_mu:
+                    pass
+    '''))
+    diags = lock_check.check(root)
+    assert any("inversion" in d.message for d in diags)
+
+
+def test_locks_blocking_call_under_local_lock(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+        state_mu = threading.Lock()
+
+        def risky(client):
+            with state_mu:
+                client.barrier("default")
+    '''))
+    diags = lock_check.check(root)
+    assert any("blocking" in d.message and "barrier" in d.message
+               for d in diags)
+
+
+def test_locks_blocking_waiver_honored(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+        state_mu = threading.Lock()
+
+        def deliberate(client):
+            with state_mu:
+                # bfcheck: ok-blocking-under-lock (fixture reason)
+                client.barrier("default")
+    '''))
+    assert lock_check.check(root) == []
+
+
+def test_locks_joinless_daemon_thread(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+
+        def spawn():
+            threading.Thread(target=print, daemon=True).start()
+    '''))
+    diags = lock_check.check(root)
+    assert any("daemon thread" in d.message for d in diags)
+    d = next(d for d in diags if "daemon" in d.message)
+    assert d.line == 5
+
+
+def test_locks_daemon_with_join_is_fine(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+
+        class Loop:
+            def start(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._t.join(timeout=2.0)
+    '''))
+    assert lock_check.check(root) == []
+
+
+def test_locks_daemon_waiver_honored(tmp_path):
+    root = make_lock_tree(tmp_path, textwrap.dedent('''
+        import threading
+
+        def spawn():
+            # bfcheck: ok-daemon-no-join (fixture: exits with the process)
+            threading.Thread(target=print, daemon=True).start()
+    '''))
+    assert lock_check.check(root) == []
+
+
+# ---------------------------------------------------------------------------
+# lint fallback fixtures
+# ---------------------------------------------------------------------------
+
+def test_lint_unused_import(tmp_path):
+    pkg = tmp_path / "bluefog_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("import os\nimport sys\nprint(sys.argv)\n")
+    diags = lint_check.check(str(tmp_path))
+    assert any("'os' imported but unused" in d.message for d in diags)
+    assert not any("'sys'" in d.message for d in diags)
+
+
+def test_lint_noqa_and_future_exempt(tmp_path):
+    pkg = tmp_path / "bluefog_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from __future__ import annotations\n"
+        "import os  # noqa: F401\n")
+    assert lint_check.check(str(tmp_path)) == []
+
+
+def test_lint_duplicate_definition(tmp_path):
+    pkg = tmp_path / "bluefog_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "def f():\n    return 1\n\n\ndef f():\n    return 2\n")
+    diags = lint_check.check(str(tmp_path))
+    assert any("redefinition of 'f'" in d.message for d in diags)
+    d = next(d for d in diags if "redefinition" in d.message)
+    assert d.line == 5
+
+
+# ---------------------------------------------------------------------------
+# protocol module invariants (cheap, no fixtures)
+# ---------------------------------------------------------------------------
+
+def test_protocol_table_internally_consistent():
+    from bluefog_tpu.runtime import protocol
+
+    codes = [o.code for o in protocol.OPS]
+    assert len(codes) == len(set(codes))
+    assert codes == sorted(codes)
+    assert protocol.RETRY_UNSAFE == {
+        "barrier", "unlock", "fetch_add", "append_bytes",
+        "append_bytes_tagged", "take_bytes", "put_bytes_part"}
+    assert protocol.spec("barrier").cxx == "kBarrier"
+    with pytest.raises(KeyError):
+        protocol.spec("nope")
+
+
+def test_native_op_names_derive_from_protocol():
+    from bluefog_tpu.runtime import native, protocol
+
+    assert native._OP_NAMES is protocol.OP_NAMES
+    assert native.ControlPlaneClient._OP_APPEND_BYTES == \
+        protocol.OP_CODES["append_bytes"]

@@ -13,7 +13,6 @@ import socket
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 import optax
 

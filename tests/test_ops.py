@@ -6,11 +6,9 @@ against the known graph.
 """
 
 import numpy as np
-import jax
 import jax.numpy as jnp
 import pytest
 
-import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 
 

@@ -11,7 +11,6 @@ import sys
 from pathlib import Path
 
 import jax.numpy as jnp
-import numpy as np
 import pytest
 
 import bluefog_tpu as bf

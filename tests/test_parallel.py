@@ -6,7 +6,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-import bluefog_tpu as bf
 from bluefog_tpu import parallel as bfp
 
 N = 8

@@ -7,7 +7,6 @@ and checkpoint/resume must round-trip through an epoch boundary.
 import sys
 from pathlib import Path
 
-import numpy as np
 import pytest
 
 import bluefog_tpu as bf

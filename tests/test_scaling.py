@@ -12,7 +12,7 @@ import math
 
 import pytest
 
-from bluefog_tpu import scaling, topology
+from bluefog_tpu import scaling
 
 
 NS = (8, 16, 64, 128)

@@ -7,7 +7,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-import bluefog_tpu as bf
 from bluefog_tpu import parallel as bfp
 from bluefog_tpu.models import TransformerLM
 

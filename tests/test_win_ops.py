@@ -4,7 +4,6 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
-import bluefog_tpu as bf
 from bluefog_tpu import topology_util
 
 
