@@ -7,7 +7,8 @@ MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=
 
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
         test_launcher test_models bench chaos dryrun native scaling \
-        lm_bench metrics-smoke lint bfcheck check tsan asan
+        lm_bench metrics-smoke flight-smoke perf-gate lint bfcheck check \
+        tsan asan
 
 # Test files replayed under the sanitizers: the chaos suite (reconnect /
 # dedup / fencing churn) plus the striped-transport + hosted-window stress
@@ -50,6 +51,19 @@ metrics-smoke:   ## telemetry-plane acceptance: 2-rank in-process job with a
                  ## counter-increment microbench
 	JAX_PLATFORMS=cpu python scripts/metrics_smoke.py
 
+flight-smoke:    ## flight-recorder acceptance: < 1500 ns ring-record
+                 ## microbench, step-time attribution over a real hosted
+                 ## job, parseable dumps, and bfrun --dump retrieving a
+                 ## merged clock-synced trace from a separate process
+	JAX_PLATFORMS=cpu python scripts/flight_smoke.py
+
+perf-gate:       ## perf regression gate: quick win_microbench +
+                 ## opt_matrix_bench medians vs the committed
+                 ## PERF_BASELINE.json (red beyond the band; seeded
+                 ## slowdown self-check: BLUEFOG_PERF_GATE_DELAY_MS=50
+                 ## must turn this target RED)
+	JAX_PLATFORMS=cpu python scripts/perf_gate.py --quick
+
 lint:            ## ruff (curated rule set, pyproject.toml) when installed;
                  ## otherwise bfcheck's stdlib-only fallback linter
 	@if command -v ruff >/dev/null 2>&1; then \
@@ -86,7 +100,7 @@ asan:            ## AddressSanitizer build of csrc + the same replay.
 	    ASAN_OPTIONS="detect_leaks=0 exitcode=66" \
 	    JAX_PLATFORMS=cpu $(PYTEST) $(SANITIZE_TESTS) -q -m "not slow"
 
-chaos: check metrics-smoke  ## tier-1 chaos subset, fault injection replayed at TWO
+chaos: check metrics-smoke flight-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
                  ## seed offsets (BLUEFOG_CHAOS_SEED shifts every armed drop
                  ## point, so reconnect/dedup/fencing — and the telemetry
                  ## counters asserted against them — face different drop sites)

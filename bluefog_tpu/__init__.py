@@ -72,6 +72,20 @@ from .runtime.timeline import (
 from .runtime import metrics
 from .runtime.metrics import cluster_health
 
+# flight recorder: always-on black box + postmortem dumps + step-time
+# attribution (docs/flight_recorder.md)
+from .runtime import flight
+from .runtime.flight import step_report
+
+
+def flight_dump(reason: str = "explicit", path=None):
+    """Dump the flight recorder NOW (ring tail + native transport events +
+    metrics snapshot) to ``bf_flight_<rank>.json`` under
+    ``BLUEFOG_FLIGHT_DIR`` and, when a control plane is attached, publish
+    the packed tail under ``bf.flight.<rank>`` for ``bfrun --dump``.
+    Returns the local dump path (docs/flight_recorder.md)."""
+    return flight.dump(reason=reason, path=path, force=True)
+
 # ops
 from .ops import (
     allgather,

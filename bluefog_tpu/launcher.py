@@ -142,10 +142,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "authenticated jobs, BLUEFOG_CP_SECRET are set. "
                         "Ranks publish snapshots on the "
                         "BLUEFOG_METRICS_INTERVAL cadence (docs/metrics.md)")
+    p.add_argument("--strict", action="store_true",
+                   help="with --status: exit non-zero (2) when the health "
+                        "view shows findings — dead/stale ranks, "
+                        "stragglers, or push-sum mass drift — so CI and "
+                        "operator scripts can gate on cluster health; the "
+                        "default stays exit 0 regardless of findings")
+    p.add_argument("--dump", action="store_true",
+                   help="trigger a cluster-wide flight-recorder dump: bump "
+                        "the KV flag every rank's heartbeat/watchdog tick "
+                        "polls, wait for acks, retrieve each rank's packed "
+                        "ring tail over the control plane (no filesystem "
+                        "access to any worker needed), and write per-rank "
+                        "dumps plus a merged clock-synced chrome trace "
+                        "under --out (docs/flight_recorder.md)")
+    p.add_argument("--out", type=str, default="bf_flight_dump",
+                   metavar="DIR",
+                   help="output directory for --dump (default "
+                        "bf_flight_dump/)")
+    p.add_argument("--dump-timeout", type=float, default=60.0,
+                   metavar="SEC",
+                   help="how long --dump waits for rank acks (ranks poll "
+                        "the trigger on their heartbeat cadence, default "
+                        "5 s, so the default 60 covers slow ticks)")
     p.add_argument("--cp", type=str, default=None, metavar="HOST:PORT",
-                   help="control-plane address for --status (default: "
-                        "BLUEFOG_CP_HOST/BLUEFOG_CP_PORT, falling back to "
-                        "JAX_COORDINATOR_ADDRESS port + 17)")
+                   help="control-plane address for --status/--dump "
+                        "(default: BLUEFOG_CP_HOST/BLUEFOG_CP_PORT, "
+                        "falling back to JAX_COORDINATOR_ADDRESS port "
+                        "+ 17)")
     p.add_argument("--timeline-filename", type=str, default=None,
                    help="enable the timeline profiler, writing to this prefix")
     p.add_argument("--verbose", action="store_true",
@@ -454,21 +478,18 @@ def _fanout(args) -> int:
     return rc
 
 
-def _status(args) -> int:
-    """``bfrun --status``: the cluster-health view from outside the job.
-
-    Reads the packed per-rank snapshots the controllers publish under
-    ``bf.metrics.<rank>`` (runtime/metrics.py) over a plain control-plane
-    connection — no jax mesh, no membership registration, no job
-    interference (scalar gets only)."""
+def _cp_address(args, what: str):
+    """Resolve the control-plane address for --status/--dump: --cp wins,
+    then BLUEFOG_CP_HOST/PORT, then the jax coordinator + 17 convention.
+    Returns (host, port) or None after printing the error."""
     host = os.environ.get("BLUEFOG_CP_HOST")
     port = int(os.environ["BLUEFOG_CP_PORT"]) \
         if os.environ.get("BLUEFOG_CP_PORT") else None
     if args.cp:
         h, _, p = args.cp.partition(":")
         if not p:
-            print("bfrun --status: --cp wants HOST:PORT", file=sys.stderr)
-            return 1
+            print(f"bfrun {what}: --cp wants HOST:PORT", file=sys.stderr)
+            return None
         host, port = h, int(p)
     if host is None or port is None:
         coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
@@ -477,19 +498,58 @@ def _status(args) -> int:
             host = host or chost
             port = port or int(cport) + 17
     if not host or not port:
-        print("bfrun --status: control-plane address unknown; pass "
+        print(f"bfrun {what}: control-plane address unknown; pass "
               "--cp HOST:PORT or set BLUEFOG_CP_HOST/BLUEFOG_CP_PORT",
               file=sys.stderr)
-        return 1
-    from .runtime import metrics as _metrics
+        return None
+    return host, port
+
+
+def _raw_client(host: str, port: int, what: str):
     from .runtime.native import ControlPlaneClient
 
     secret = os.environ.get("BLUEFOG_CP_SECRET", "")
     try:
-        cl = ControlPlaneClient(host, port, 0, secret=secret, streams=1)
+        return ControlPlaneClient(host, port, 0, secret=secret, streams=1)
     except (OSError, RuntimeError) as exc:
-        print(f"bfrun --status: cannot reach the control plane at "
+        print(f"bfrun {what}: cannot reach the control plane at "
               f"{host}:{port} ({exc})", file=sys.stderr)
+        return None
+
+
+def _strict_findings(health: dict) -> List[str]:
+    """Health findings that make ``--status --strict`` exit non-zero."""
+    findings: List[str] = []
+    dead = sorted(p for p, r in health["ranks"].items() if not r["alive"])
+    if dead:
+        findings.append(f"stale/dead rank(s): {dead}")
+    if health["stragglers"]:
+        findings.append(f"straggler(s): {health['stragglers']}")
+    m = health.get("mass")
+    if m is not None and not m["conserved"]:
+        findings.append(
+            f"push-sum mass drift {m['drift']:.3g} exceeds tolerance "
+            f"{m['tolerance']:.3g}")
+    return findings
+
+
+def _status(args) -> int:
+    """``bfrun --status``: the cluster-health view from outside the job.
+
+    Reads the packed per-rank snapshots the controllers publish under
+    ``bf.metrics.<rank>`` (runtime/metrics.py) over a plain control-plane
+    connection — no jax mesh, no membership registration, no job
+    interference (scalar gets only). ``--strict`` turns findings into a
+    non-zero exit (2) for CI/operator scripting; the default exit stays 0
+    so dashboards polling a degraded job never mistake findings for a
+    broken probe."""
+    addr = _cp_address(args, "--status")
+    if addr is None:
+        return 1
+    from .runtime import metrics as _metrics
+
+    cl = _raw_client(*addr, what="--status")
+    if cl is None:
         return 1
     try:
         health = _metrics.read_cluster_health(cl)
@@ -497,6 +557,94 @@ def _status(args) -> int:
         if not health["ranks"]:
             print("  (no rank has published metrics — is "
                   "BLUEFOG_METRICS_INTERVAL set on the job?)")
+        if getattr(args, "strict", False):
+            findings = _strict_findings(health)
+            if findings:
+                for f in findings:
+                    print(f"  STRICT: {f}", file=sys.stderr)
+                return 2
+    finally:
+        cl.close()
+    return 0
+
+
+def _dump(args) -> int:
+    """``bfrun --dump``: cluster-wide flight-recorder retrieval.
+
+    Bumps the ``bf.flight.trigger`` KV counter; every rank's
+    heartbeat/watchdog tick sees it, dumps locally, and publishes its
+    packed ring tail under ``bf.flight.<rank>``. This side waits for the
+    per-rank acks (bounded by --dump-timeout), pulls the tails over the
+    same raw connection, and writes per-rank JSON dumps plus one merged,
+    clock-synced chrome trace — postmortem evidence with no filesystem
+    access to any worker."""
+    import json
+    import time as _time
+
+    addr = _cp_address(args, "--dump")
+    if addr is None:
+        return 1
+    from .runtime import flight as _flight
+
+    cl = _raw_client(*addr, what="--dump")
+    if cl is None:
+        return 1
+    try:
+        trig = int(cl.fetch_add(_flight.TRIGGER_KEY, 1)) + 1
+        world = int(cl.get("bf.metrics.world")) or \
+            int(os.environ.get("BLUEFOG_CP_WORLD") or 0)
+        if world <= 0:
+            # no world hint published: scan the heartbeat keys (multi-
+            # controller) and fall back to a single-rank probe window
+            world = 1
+            for r in range(256):
+                if int(cl.get(f"bf.hb.{r}")) == 0 and r > 0:
+                    break
+                world = r + 1
+        print(f"bfrun --dump: trigger #{trig} set; waiting for "
+              f"{world} rank(s) (timeout {args.dump_timeout:.0f}s)")
+        deadline = _time.monotonic() + max(1.0, args.dump_timeout)
+        acked: set = set()
+        while _time.monotonic() < deadline and len(acked) < world:
+            for r in range(world):
+                if r not in acked and \
+                        int(cl.get(_flight.ACK_KEY_FMT.format(rank=r))) \
+                        >= trig:
+                    acked.add(r)
+            if len(acked) < world:
+                _time.sleep(0.25)
+        docs = []
+        os.makedirs(args.out, exist_ok=True)
+        for r in sorted(acked):
+            try:
+                blob = cl.get_bytes(_flight.DATA_KEY_FMT.format(rank=r))
+                doc = _flight.unpack_dump(blob)
+            except (OSError, ValueError) as exc:
+                print(f"bfrun --dump: rank {r} tail unreadable ({exc})",
+                      file=sys.stderr)
+                continue
+            path = os.path.join(args.out, f"flight_{r}.json")
+            with open(path, "w") as f:
+                json.dump(doc, f)
+            n = len(doc.get("events", {}).get("kind", []))
+            print(f"  rank {r}: {n} events "
+                  f"(reason: {doc['meta'].get('reason')}) -> {path}")
+            docs.append(doc)
+        missing = sorted(set(range(world)) - acked)
+        if missing:
+            print(f"bfrun --dump: no ack from rank(s) {missing} — wedged "
+                  "hard (no heartbeat/watchdog tick) or already gone",
+                  file=sys.stderr)
+        if not docs:
+            print("bfrun --dump: no rank published a tail", file=sys.stderr)
+            return 1
+        merged = _flight.merge_dumps(docs)
+        mpath = os.path.join(args.out, "merged.json")
+        with open(mpath, "w") as f:
+            json.dump(merged, f)
+        flows = sum(1 for e in merged if e.get("ph") in ("s", "f"))
+        print(f"  merged: {len(merged)} events ({flows} flow events) -> "
+              f"{mpath}")
     finally:
         cl.close()
     return 0
@@ -506,6 +654,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.status:
         return _status(args)
+    if args.dump:
+        return _dump(args)
     if not args.command:
         build_parser().print_usage()
         return 1

@@ -59,8 +59,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import topology as topology_util
 from ..runtime import control_plane as _cp
+from ..runtime import flight as _flight
 from ..runtime import handles as _handles
 from ..runtime import metrics as _metrics
+from ..runtime.config import knob_env
 from ..runtime.logging import logger
 from ..runtime.state import _global_state
 from ..runtime.timeline import (timeline_context, timeline_counter,
@@ -75,6 +77,10 @@ def _op_timer(activity: str):
     """Step-phase latency histogram for one window op ('WIN_PUT' ->
     ``win.put_sec``): the quantitative complement of the timeline span
     emitted next to it (docs/metrics.md)."""
+    ms = knob_env("BLUEFOG_PERF_GATE_DELAY_MS")
+    if ms:
+        # testing-only seeded slowdown: scripts/perf_gate.py's red path
+        time.sleep(float(ms) / 1e3)
     return _metrics.timed(f"win.{activity[4:].lower()}_sec")
 
 
@@ -782,10 +788,15 @@ class Window:
         # (the 39-bit (origin << 32 | counter) tag sequence)
         timeline_flow_finish(_FLOW_DEPOSIT, pend.seq)
         _metrics.counter("win.deposits_drained").inc()
+        fl = _flight.recorder()
+        fl.rec(_flight.FLOW_F,
+               fl.intern(f"drain.{(pend.seq >> 32) & 0x7F}"),
+               pend.got, pend.seq)
         if pend.mode == _DEP_ACC:
             wire_t = _win_wire_dtype(self.mail_dtype)
             contrib = pend.staging.view(wire_t).reshape(self.row_shape)
-            self._fold_record(pair[0], pair[1], _DEP_ACC, contrib)
+            with fl.span("win.fold", a=pend.got):
+                self._fold_record(pair[0], pair[1], _DEP_ACC, contrib)
         if pend.has_p:
             if pend.mode == _DEP_ACC:
                 self.host.add_p_mail(pair[0], pair[1], pend.pc)
@@ -862,108 +873,117 @@ class Window:
 
         drained_records = 0
         drained_bytes = 0
-        fetch, fetch_pairs = sweep(pairs)
-        while True:
-            batches, owner = fetch.result()
-            cur_pairs, fetch = fetch_pairs, None
-            got = any(batches)
-            if got:
-                drained_records += sum(len(recs) for recs in batches)
-                drained_bytes += sum(
-                    len(r) for recs in batches for r in recs)
-                # Progress: sweep everything once more, streamed WHILE the
-                # records below fold (an empty extra sweep costs one RTT).
-                # Pool the next sweep only when THIS round hauled bulk
-                # bytes: fat backlogs stripe across the connection pool,
-                # while trickle rounds stay on one pipelined connection —
-                # a pooled sweep's extra round-trips would otherwise let a
-                # fast depositor outrun the drain loop indefinitely.
-                round_bytes = sum(len(r) for recs in batches for r in recs)
-                fetch, fetch_pairs = sweep(
-                    pairs,
-                    pooled=round_bytes >= getattr(
-                        cl, "_stripe_min", 1 << 22))
-            try:
-                for pair, records in zip(cur_pairs, batches):
-                    if not records:
-                        continue
-                    touched.add(pair)
-                    pend_map = partial.get(pair)
-                    if pend_map is None:
-                        pend_map = partial[pair] = {}
-                    # newest deposit counter seen per origin namespace this
-                    # round — anything older it supersedes is orphaned
-                    ns_max: Dict[int, int] = {}
-                    for rec in records:
-                        tag = int.from_bytes(rec[:_DEP_TAG], "little")
-                        seq, idx = tag >> 24, tag & 0xFFFFFF
-                        ns, ctr = seq >> 32, seq & 0xFFFFFFFF
-                        prev = ns_max.get(ns)
-                        if prev is None or _seq_newer(ctr, prev):
-                            ns_max[ns] = ctr
-                        if idx == 0:
-                            if seq in pend_map:
-                                # duplicate header: impossible from the
-                                # clear race; belt-and-braces for a
-                                # corrupted peer
+        # step-attribution span: the socket-sweep + reassembly leg of
+        # the drain; the numpy folds inside carve themselves out via
+        # nested win.fold spans (scripts/step_attribution.py subtracts
+        # the overlap so the phase buckets stay disjoint)
+        _fl = _flight.recorder()
+        _fl.begin("win.drain")
+        try:
+            fetch, fetch_pairs = sweep(pairs)
+            while True:
+                batches, owner = fetch.result()
+                cur_pairs, fetch = fetch_pairs, None
+                got = any(batches)
+                if got:
+                    drained_records += sum(len(recs) for recs in batches)
+                    drained_bytes += sum(
+                        len(r) for recs in batches for r in recs)
+                    # Progress: sweep everything once more, streamed WHILE the
+                    # records below fold (an empty extra sweep costs one RTT).
+                    # Pool the next sweep only when THIS round hauled bulk
+                    # bytes: fat backlogs stripe across the connection pool,
+                    # while trickle rounds stay on one pipelined connection —
+                    # a pooled sweep's extra round-trips would otherwise let a
+                    # fast depositor outrun the drain loop indefinitely.
+                    round_bytes = sum(len(r) for recs in batches for r in recs)
+                    fetch, fetch_pairs = sweep(
+                        pairs,
+                        pooled=round_bytes >= getattr(
+                            cl, "_stripe_min", 1 << 22))
+                try:
+                    for pair, records in zip(cur_pairs, batches):
+                        if not records:
+                            continue
+                        touched.add(pair)
+                        pend_map = partial.get(pair)
+                        if pend_map is None:
+                            pend_map = partial[pair] = {}
+                        # newest deposit counter seen per origin namespace this
+                        # round — anything older it supersedes is orphaned
+                        ns_max: Dict[int, int] = {}
+                        for rec in records:
+                            tag = int.from_bytes(rec[:_DEP_TAG], "little")
+                            seq, idx = tag >> 24, tag & 0xFFFFFF
+                            ns, ctr = seq >> 32, seq & 0xFFFFFFFF
+                            prev = ns_max.get(ns)
+                            if prev is None or _seq_newer(ctr, prev):
+                                ns_max[ns] = ctr
+                            if idx == 0:
+                                if seq in pend_map:
+                                    # duplicate header: impossible from the
+                                    # clear race; belt-and-braces for a
+                                    # corrupted peer
+                                    orphans += 1
+                                pend = pend_map[seq] = self._start_deposit(
+                                    pair, rec)
+                            else:
+                                pend = pend_map.get(seq)
+                                if pend is None:
+                                    # Orphaned continuation: every sender
+                                    # appends a deposit's header before any of
+                                    # its chunks reach the server (the striped
+                                    # append's phase split pins this), so a
+                                    # chunk whose header we never drained means
+                                    # a win_free/win_fence clear ate the
+                                    # deposit's prefix — discard the tail.
+                                    orphans += 1
+                                    continue
+                                self._place_chunk(pair, pend,
+                                                  idx, rec[_DEP_TAG:], expect)
+                            if pend.got == expect:
+                                self._finish_deposit(pair, pend)
+                                del pend_map[seq]
+                        # GC: per-origin deposit counters are monotonic and a
+                        # deposit is fully appended before its successor starts,
+                        # so a pending superseded by a NEWER counter in its own
+                        # namespace can never complete — its missing records
+                        # were consumed by a concurrent clear.
+                        for seq_o in list(pend_map):
+                            m = ns_max.get(seq_o >> 32)
+                            if m is not None and _seq_newer(m, seq_o & 0xFFFFFFFF):
+                                del pend_map[seq_o]
                                 orphans += 1
-                            pend = pend_map[seq] = self._start_deposit(
-                                pair, rec)
-                        else:
-                            pend = pend_map.get(seq)
-                            if pend is None:
-                                # Orphaned continuation: every sender
-                                # appends a deposit's header before any of
-                                # its chunks reach the server (the striped
-                                # append's phase split pins this), so a
-                                # chunk whose header we never drained means
-                                # a win_free/win_fence clear ate the
-                                # deposit's prefix — discard the tail.
-                                orphans += 1
-                                continue
-                            self._place_chunk(pair, pend,
-                                              idx, rec[_DEP_TAG:], expect)
-                        if pend.got == expect:
-                            self._finish_deposit(pair, pend)
-                            del pend_map[seq]
-                    # GC: per-origin deposit counters are monotonic and a
-                    # deposit is fully appended before its successor starts,
-                    # so a pending superseded by a NEWER counter in its own
-                    # namespace can never complete — its missing records
-                    # were consumed by a concurrent clear.
-                    for seq_o in list(pend_map):
-                        m = ns_max.get(seq_o >> 32)
-                        if m is not None and _seq_newer(m, seq_o & 0xFFFFFFFF):
-                            del pend_map[seq_o]
-                            orphans += 1
-                    if not pend_map:
-                        del partial[pair]
-            finally:
-                owner.close()
-            if not partial:
+                        if not pend_map:
+                            del partial[pair]
+                finally:
+                    owner.close()
+                if not partial:
+                    if not got:
+                        break  # no prefetch outstanding (got False issued none)
+                    continue
+                # Per-PARTIAL deadline, anchored when that chunk sequence first
+                # appeared: progress on unrelated keys must not keep a torn
+                # deposit alive forever (healthy gossip traffic would otherwise
+                # reset a shared clock on every round).
+                now = time.monotonic()
+                stale = sorted({p for p, pmap in partial.items()
+                                for pend in pmap.values()
+                                if now - pend.t0 > drain_timeout})
+                if stale:
+                    raise RuntimeError(
+                        f"window '{self.name}': deposit chunk sequence for "
+                        f"(rank, slot) {stale} never completed within "
+                        f"{drain_timeout:.0f}s — the origin died mid-deposit "
+                        "(BLUEFOG_WIN_DRAIN_TIMEOUT)")
                 if not got:
-                    break  # no prefetch outstanding (got False issued none)
-                continue
-            # Per-PARTIAL deadline, anchored when that chunk sequence first
-            # appeared: progress on unrelated keys must not keep a torn
-            # deposit alive forever (healthy gossip traffic would otherwise
-            # reset a shared clock on every round).
-            now = time.monotonic()
-            stale = sorted({p for p, pmap in partial.items()
-                            for pend in pmap.values()
-                            if now - pend.t0 > drain_timeout})
-            if stale:
-                raise RuntimeError(
-                    f"window '{self.name}': deposit chunk sequence for "
-                    f"(rank, slot) {stale} never completed within "
-                    f"{drain_timeout:.0f}s — the origin died mid-deposit "
-                    "(BLUEFOG_WIN_DRAIN_TIMEOUT)")
-            if not got:
-                # only the keys holding partial chunk sequences can produce
-                # the awaited continuations; don't sweep owned x d_max keys
-                # 200x/s while waiting on one slow origin
-                time.sleep(0.005)
-                fetch, fetch_pairs = sweep(sorted(partial), pooled=False)
+                    # only the keys holding partial chunk sequences can produce
+                    # the awaited continuations; don't sweep owned x d_max keys
+                    # 200x/s while waiting on one slow origin
+                    time.sleep(0.005)
+                    fetch, fetch_pairs = sweep(sorted(partial), pooled=False)
+        finally:
+            _fl.end("win.drain", a=drained_bytes)
         if drained_records:
             _metrics.counter("win.drain_records").inc(drained_records)
             _metrics.counter("win.drain_bytes").inc(drained_bytes)
@@ -1613,6 +1633,7 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 dep_edge_of: List[Tuple[int, int, int]] = []  # per record
                 dep_flows: List[Tuple[Tuple[int, int, int], int]] = []
                 deposited = set()
+                fl = _flight.recorder()
                 try:
                     for src in win.owned:
                         x = rows[src].astype(acc_t, copy=False)
@@ -1622,7 +1643,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                             contrib = x * np.asarray(wt, acc_t)
                             pc = float(p_own[src] * wt) if use_p else 0.0
                             if dst in owned:
-                                win._fold_record(dst, k, mode, contrib)
+                                with fl.span("win.fold", a=contrib.nbytes):
+                                    win._fold_record(dst, k, mode, contrib)
                                 if use_p:
                                     if accumulate:
                                         win.host.add_p_mail(dst, k, pc)
@@ -1633,10 +1655,10 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                 # wire payload stays a live numpy buffer:
                                 # _pack_deposit slices it zero-copy and the
                                 # native scatter-gather write streams it
+                                payload = np.ascontiguousarray(
+                                    contrib.astype(wire_t, copy=False))
                                 recs = _pack_deposit(
-                                    mode, int(use_p), pc,
-                                    np.ascontiguousarray(
-                                        contrib.astype(wire_t, copy=False)))
+                                    mode, int(use_p), pc, payload)
                                 key = win._dep_key(dst, k)
                                 win._dep_seq += 1
                                 dep_names.extend([key] * len(recs))
@@ -1650,7 +1672,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                 dep_flows.append((
                                     (src, dst, k),
                                     ((st.process_index & 0x7F) << 32)
-                                    | (win._dep_seq & 0xFFFFFFFF)))
+                                    | (win._dep_seq & 0xFFFFFFFF),
+                                    payload.nbytes))
                         # post-send self scaling (push-sum down-weighting)
                         win._rows[src] = (
                             rows[src].astype(acc_t) * np.asarray(
@@ -1667,8 +1690,10 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                             dep_tags = [dep_tags[i] for i in keep]
                             dep_edge_of = [dep_edge_of[i] for i in keep]
                     if dep_names:
-                        replies = _cp.client().append_bytes_tagged_many(
-                            dep_names, dep_blobs, dep_tags)
+                        with fl.span("win.wire",
+                                     a=sum(_blen(b) for b in dep_blobs)):
+                            replies = _cp.client().append_bytes_tagged_many(
+                                dep_names, dep_blobs, dep_tags)
                         # backstop only: the pre-check above keeps the
                         # server cap from ever tearing a multi-record
                         # deposit; a -2 here means the client's
@@ -1691,15 +1716,22 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                             "may be dead (check bf.dead_controllers())")
                     # cross-process trace correlation: one flow arrow per
                     # LANDED remote deposit, id = the tag sequence the
-                    # owner's drain recovers from the wire
+                    # owner's drain recovers from the wire. The flight ring
+                    # gets the same pairing (edge.<src>.<dst> flow starts,
+                    # drain.<origin> finishes) plus per-edge byte totals —
+                    # the input scripts/step_attribution.py aggregates.
                     sent = 0
-                    for edge, fid in dep_flows:
+                    for edge, fid, nbytes in dep_flows:
                         if edge in deposited:
                             timeline_flow_start(_FLOW_DEPOSIT, fid)
+                            fl.rec(_flight.FLOW_S,
+                                   fl.intern(f"edge.{edge[0]}.{edge[1]}"),
+                                   nbytes, fid)
                             sent += 1
                     if sent:
                         _metrics.counter("win.deposits_sent").inc(sent)
-                    win._publish_selves(win.owned)
+                    with fl.span("win.publish"):
+                        win._publish_selves(win.owned)
                 except Exception:
                     # un-bump the edges whose deposits never landed (e.g. a
                     # full mailbox for a dead owner) so healthy neighbors'
@@ -1729,6 +1761,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                     if src not in owned and table[src].get(dst) is not None})
                 pulled = []
 
+                fl = _flight.recorder()
+
                 def fold_src(src, val):
                     contrib_base = val.astype(acc_t, copy=False)
                     for dst in win.owned:
@@ -1736,8 +1770,10 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                         if wt is None:
                             continue
                         k = win.layout.slot_of[dst][src]
-                        win._fold_record(dst, k, _DEP_PUT,
-                                         contrib_base * np.asarray(wt, acc_t))
+                        with fl.span("win.fold", a=contrib_base.nbytes):
+                            win._fold_record(
+                                dst, k, _DEP_PUT,
+                                contrib_base * np.asarray(wt, acc_t))
                         if use_p:
                             win.host.set_p_mail(dst, k,
                                                 float(p_all[src] * wt))
@@ -1761,16 +1797,22 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                         lambda s=remote_srcs[j]:
                         win._read_remote_self_view(s))
 
-                for j in range(min(depth, len(remote_srcs))):
-                    launch(j)
-                for j, src in enumerate(remote_srcs):
-                    row, owner = fetches.pop(j).result()
-                    if j + depth < len(remote_srcs):
-                        launch(j + depth)
-                    try:
-                        fold_src(src, row)
-                    finally:
-                        owner.close()
+                # the pull leg is the get path's wire phase: the fold spans
+                # inside carve themselves out of it for attribution
+                fl.begin("win.wire")
+                try:
+                    for j in range(min(depth, len(remote_srcs))):
+                        launch(j)
+                    for j, src in enumerate(remote_srcs):
+                        row, owner = fetches.pop(j).result()
+                        if j + depth < len(remote_srcs):
+                            launch(j + depth)
+                        try:
+                            fold_src(src, row)
+                        finally:
+                            owner.close()
+                finally:
+                    fl.end("win.wire")
                 win.host.bump_versions(pulled)
     finally:
         if require_mutex:

@@ -76,13 +76,26 @@ from .ops import windows as _windows
 from .ops.neighbors import _dynamic_weight_matrix, _static_weight_matrix
 from .ops.plan import CombinePlan, spmd_combine
 from .runtime import control_plane as _cp
+from .runtime import flight as _flight
 from .runtime import heartbeat as _hb
 from .runtime import metrics as _metrics
+from .runtime.config import knob_env
 from .runtime.logging import logger
 from .runtime.native import PeerLostError
 from .runtime.state import _global_state
 from .runtime.timeline import timeline_context
 from .utils.compat import shard_map
+
+
+def _perf_gate_delay() -> None:
+    """Testing-only seeded slowdown (`BLUEFOG_PERF_GATE_DELAY_MS`): every
+    optimizer step eats an artificial delay so `make perf-gate`'s red path
+    is deterministically exercisable (scripts/perf_gate.py). Off (0) on
+    every real job — the knob's doc says so and the gate's self-check is
+    the only sanctioned user."""
+    ms = knob_env("BLUEFOG_PERF_GATE_DELAY_MS")
+    if ms:
+        time.sleep(float(ms) / 1e3)
 
 
 @struct.dataclass
@@ -318,10 +331,19 @@ class _FusedOptimizer:
         if fn is None:
             fn = self._build(key, plan, do_comm)
             self._step_cache[key] = fn
-        with timeline_context(self.name, "STEP"), \
-                _metrics.timed("opt.step_sec"):
-            params, opt_state, model_state, metrics = fn(
-                w, state.params, state.opt_state, state.model_state, batch)
+        _perf_gate_delay()
+        try:
+            with timeline_context(self.name, "STEP"), \
+                    _metrics.timed("opt.step_sec"), \
+                    _flight.recorder().span("opt.step", b=self._counter):
+                params, opt_state, model_state, metrics = fn(
+                    w, state.params, state.opt_state, state.model_state,
+                    batch)
+        except Exception as exc:
+            # black-box dump before the stack unwinds: the ring's tail IS
+            # the postmortem evidence (rate-limited; never raises)
+            _flight.fatal("opt.step", exc)
+            raise
         _metrics.gauge("opt.step").set(self._counter)
         return TrainState(params, opt_state, model_state), metrics
 
@@ -880,9 +902,24 @@ class _WindowOptimizer(_FusedOptimizer):
         self._counter += 1
         do_comm = (self._counter % self.num_steps_per_communication) == 0
         _metrics.gauge("opt.step").set(self._counter)
+        _perf_gate_delay()
+        try:
+            return self._step_body(state, batch, do_comm)
+        except Exception as exc:
+            # the always-on black box: a fatal gossip step (PeerLostError
+            # included, once the healed-topology retry is exhausted) dumps
+            # the ring before the exception unwinds (rate-limited)
+            _flight.fatal("opt.step", exc)
+            raise
+
+    def _step_body(self, state: TrainState, batch,
+                   do_comm: bool) -> Tuple[TrainState, Dict]:
+        fl = _flight.recorder()
         with timeline_context(self.name, "STEP"), \
-                _metrics.timed("opt.step_sec"):
-            state, metrics = self._local_step(state, batch)
+                _metrics.timed("opt.step_sec"), \
+                fl.span("opt.step", b=self._counter):
+            with fl.span("opt.local"):
+                state, metrics = self._local_step(state, batch)
             if not do_comm:
                 return state, metrics
             if _windows._get_window(self._win_names[0]).hosted:
@@ -901,12 +938,12 @@ class _WindowOptimizer(_FusedOptimizer):
             # mesh: the in-program concat defeats the donated in-place
             # optimizer update.)
             with timeline_context(self.name, "PACK"), \
-                    _metrics.timed("opt.pack_sec"):
+                    _metrics.timed("opt.pack_sec"), fl.span("opt.pack"):
                 packed = [
                     _fusion.pack_jit([leaves[i] for i in idxs], spec)
                     for idxs, spec in zip(self._groups, self._specs)
                 ]
-            with _metrics.timed("opt.gossip_sec"):
+            with _metrics.timed("opt.gossip_sec"), fl.span("opt.gossip"):
                 if self._fused_pack:
                     # Single window: one mutex acquisition spans the whole
                     # put+update pair (inner acquires are local depth
@@ -933,7 +970,7 @@ class _WindowOptimizer(_FusedOptimizer):
                 else:
                     mixed = self._gossip(packed)
             with timeline_context(self.name, "UNPACK"), \
-                    _metrics.timed("opt.unpack_sec"):
+                    _metrics.timed("opt.unpack_sec"), fl.span("opt.unpack"):
                 out = list(leaves)
                 for idxs, spec, buf in zip(self._groups, self._specs,
                                            mixed):

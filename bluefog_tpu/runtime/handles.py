@@ -218,7 +218,13 @@ def synchronize(handle: int, timeout: Optional[float] = None) -> Any:
         # keep working) from a plain slow-op timeout
         from .native import PeerLostError
 
-        raise PeerLostError(msg)
+        exc = PeerLostError(msg)
+        # black-box dump before the caller decides what to do with the
+        # dead peer: the ring's tail is the evidence of what hung
+        from . import flight as _flight
+
+        _flight.fatal("synchronize", exc)
+        raise exc
     raise RuntimeError(msg)
 
 

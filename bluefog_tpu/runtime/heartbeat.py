@@ -37,6 +37,7 @@ import time
 from typing import Dict, Optional
 
 from . import control_plane as _cp
+from . import flight as _flight
 from . import metrics as _metrics
 from .logging import logger
 from .timeline import timeline_instant
@@ -246,6 +247,10 @@ class PeerMonitor:
         _metrics.gauge("hb.dead_peers").set(len(self._dead))
         _metrics.gauge("hb.suspect_peers").set(len(self._suspect))
         _metrics.maybe_publish(cl)
+        # cluster-wide postmortem trigger (`bfrun --dump`): one KV read per
+        # tick; on a bump this rank dumps locally and publishes its packed
+        # tail under bf.flight.<rank> (docs/flight_recorder.md)
+        _flight.poll_remote_trigger(cl)
         if not self._shutdown_seen.is_set() and any(
                 cl.get(f"{_FLAG}{p}") for p in range(self.world)
                 if p != self.me):

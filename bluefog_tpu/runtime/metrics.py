@@ -164,27 +164,36 @@ class Registry:
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._hists: Dict[str, Histogram] = {}
+        self._docs: Dict[str, str] = {}
         self._native_base: Dict[str, float] = {}
 
-    def counter(self, name: str) -> Counter:
+    def _register_doc(self, name: str, doc: Optional[str]) -> None:
+        if doc:
+            self._docs[name] = doc
+
+    def counter(self, name: str, doc: Optional[str] = None) -> Counter:
         c = self._counters.get(name)
         if c is None:
             with self._mu:
                 c = self._counters.setdefault(name, Counter(name))
+                self._register_doc(name, doc)
         return c
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str, doc: Optional[str] = None) -> Gauge:
         g = self._gauges.get(name)
         if g is None:
             with self._mu:
                 g = self._gauges.setdefault(name, Gauge(name))
+                self._register_doc(name, doc)
         return g
 
-    def histogram(self, name: str, bounds=DEFAULT_BUCKETS) -> Histogram:
+    def histogram(self, name: str, bounds=DEFAULT_BUCKETS,
+                  doc: Optional[str] = None) -> Histogram:
         h = self._hists.get(name)
         if h is None:
             with self._mu:
                 h = self._hists.setdefault(name, Histogram(name, bounds))
+                self._register_doc(name, doc)
         return h
 
     def timed(self, name: str, bounds=DEFAULT_BUCKETS) -> _Timed:
@@ -416,6 +425,83 @@ def unpack_snapshot(blob: bytes) -> dict:
 
 # -- Prometheus text exposition ----------------------------------------------
 
+# HELP text registry: instrument creation sites may pass ``doc=`` (stored
+# per-registry); this curated table covers the fleet of implicitly-created
+# names (subsystems create instruments by name on their hot paths, where a
+# doc string per call would be noise). Prefix rules catch the generated
+# families (per-op-class transport counters). Scrapes are self-describing:
+# every sample gets a ``# HELP`` line (prom-lint asserts it).
+_HELP_EXACT: Dict[str, str] = {
+    "opt.step": "optimizer step counter of this rank",
+    "opt.step_sec": "wall seconds per optimizer step",
+    "opt.pack_sec": "seconds packing the fusion buffer per gossip step",
+    "opt.gossip_sec": "seconds in window gossip ops per step",
+    "opt.unpack_sec": "seconds unpacking the fusion buffer per step",
+    "opt.healed_rebuilds": "healed edge-table rebuilds after membership "
+                           "changes",
+    "opt.gossip_retries": "gossip steps retried once on a self-healed "
+                          "topology after PeerLostError",
+    "pushsum.mass": "this rank's share of global push-sum de-bias mass",
+    "pushsum.minted": "push-sum mass minted (created, not transferred) by "
+                      "this rank",
+    "pushsum.debias_drift": "max |p - 1| over owned ranks (de-bias scalar "
+                            "wander)",
+    "membership.epoch": "membership epoch mirror (bumps on join/leave/"
+                        "re-admission)",
+    "hb.dead_peers": "controllers currently considered dead",
+    "hb.suspect_peers": "resumed-but-unfenced controllers (still out of "
+                        "membership)",
+    "hb.dead_transitions": "live->dead membership transitions observed",
+    "hb.suspect_transitions": "dead->suspect transitions (heartbeat "
+                              "resumed without re-attach)",
+    "hb.readmissions": "suspects re-admitted after fenced rejoin + "
+                       "quarantine",
+    "hb.quarantine_entries": "times this rank entered rejoin quarantine",
+    "hb.quarantine_sec": "seconds spent in rejoin quarantine",
+    "watchdog.stalls": "ops flagged stalled by the watchdog",
+    "win.deposits_sent": "remote window deposits sent",
+    "win.deposits_drained": "window deposits folded by this owner",
+    "win.deposits_rejected": "deposits rejected by the server mailbox cap",
+    "win.drain_records": "mailbox records drained",
+    "win.drain_bytes": "mailbox bytes drained",
+    "win.drain_orphans": "orphaned deposit chunks discarded",
+    "cp.client.redials": "successful transparent control-plane reconnects",
+    "cp.client.redial_attempts": "control-plane reconnect dials attempted",
+    "cp.client.stale_frames": "incarnation-fence verdicts observed",
+    "cp.client.striped_transfers": "whole striped put/get transfers",
+    "cp.fault.ops": "client ops seen by the fault injector since arm",
+    "cp.fault.drops": "connections killed by the fault injector since arm",
+}
+
+_HELP_PREFIX = (
+    ("cp.client.ops.", "control-plane client requests sent, by op class"),
+    ("cp.client.bytes_out.", "control-plane client request bytes, by op "
+                             "class"),
+    ("cp.client.bytes_in.", "control-plane client reply bytes, by op "
+                            "class"),
+    ("cp.server.ops.", "control-plane server dispatches, by op class"),
+    ("cp.server.", "control-plane server state/event counter"),
+    ("win.", "hosted window data-plane op latency (seconds)"),
+)
+
+
+def help_for(name: str) -> str:
+    """HELP text for a metric: the creating site's ``doc=`` wins, then the
+    curated table, then the prefix rules, then a generic fallback — every
+    scraped sample is self-describing either way."""
+    doc = _REGISTRY._docs.get(name) or _HELP_EXACT.get(name)
+    if doc:
+        return doc
+    for prefix, text in _HELP_PREFIX:
+        if name.startswith(prefix):
+            return text
+    return f"bluefog metric {name}"
+
+
+def _prom_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _prom_name(name: str) -> str:
     out = []
     for ch in name:
@@ -443,16 +529,19 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
     lines: List[str] = []
     for name in sorted(snap.get("counters", {})):
         m = _prom_name(name)
+        lines.append(f"# HELP {m} {_prom_help(help_for(name))}")
         lines.append(f"# TYPE {m} counter")
         lines.append(f"{m}{label} "
                      f"{_prom_value(snap['counters'][name])}")
     for name in sorted(snap.get("gauges", {})):
         m = _prom_name(name)
+        lines.append(f"# HELP {m} {_prom_help(help_for(name))}")
         lines.append(f"# TYPE {m} gauge")
         lines.append(f"{m}{label} {_prom_value(snap['gauges'][name])}")
     for name in sorted(snap.get("hists", {})):
         h = snap["hists"][name]
         m = _prom_name(name)
+        lines.append(f"# HELP {m} {_prom_help(help_for(name))}")
         lines.append(f"# TYPE {m} histogram")
         cum = 0
         for bound, cnt in zip(h["bounds"], h["counts"]):

@@ -232,6 +232,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_cp_fault_ops.argtypes = []
     lib.bf_cp_server_drop_conns.restype = None
     lib.bf_cp_server_drop_conns.argtypes = [ctypes.c_void_p]
+    # transport flight ring (r12 observability)
+    lib.bf_flight_ring.restype = ctypes.c_int
+    lib.bf_flight_ring.argtypes = [
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
     return lib
 
 
@@ -341,6 +345,25 @@ def client_stats() -> dict:
         "stale_frames": int(buf[98]),
         "striped_transfers": int(buf[99]),
     }
+
+
+_FLIGHT_RING_MAX = 1024  # csrc kFlightCap
+
+
+def flight_events() -> list:
+    """The native transport's flight ring, oldest -> newest: a list of
+    ``[wall_us, kind, a, b]`` rows (kinds: 1 redial attempt, 2 redial
+    success, 3 stale frame, 4 per-stripe timing, 5 whole striped
+    transfer; a/b are bytes/micros for the timed kinds). Spliced into
+    flight-recorder dumps (runtime/flight.py); empty when the native
+    runtime is unavailable."""
+    lib = load()
+    if lib is None:
+        return []
+    buf = (ctypes.c_longlong * (4 * _FLIGHT_RING_MAX))()
+    n = lib.bf_flight_ring(buf, _FLIGHT_RING_MAX)
+    return [[int(buf[4 * j]), int(buf[4 * j + 1]), int(buf[4 * j + 2]),
+             int(buf[4 * j + 3])] for j in range(max(0, n))]
 
 
 def _arm_fault_from_env(lib) -> None:

@@ -155,6 +155,16 @@ def init(
     # counter block re-baselines, so snapshots report this job's deltas.
     from . import metrics as _metrics
     _metrics.reset_for_job()
+    # Fresh flight-recorder ring + wall-clock anchor (a postmortem dump
+    # belongs to THIS job), and the abnormal-exit hook so an uncaught
+    # exception leaves a dump behind (docs/flight_recorder.md).
+    from . import flight as _flight
+    _flight.reset_for_job()
+    _flight.install_excepthook()
+    if _cp.active():
+        # eager remote-trigger latch: bumps AFTER this point fire even if
+        # they land before the first heartbeat/watchdog poll tick
+        _flight.latch_trigger(_cp.client())
     if devices is None and st.config.simulate_devices > 0:
         # bfrun --simulate N: rank over forced-CPU devices even when an
         # accelerator backend registered (launcher.py:62-68). N counts

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import threading
 
+from . import flight as _flight
 from . import handles
 from . import metrics as _metrics
 from .logging import logger
@@ -44,6 +45,7 @@ class StallWatchdog:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.cycle_sec):
+            self._poll_flight_trigger()
             try:
                 handles.sweep_completed_spans()
                 pending = handles.outstanding()
@@ -73,3 +75,22 @@ class StallWatchdog:
                     "likely a hung multi-host collective (some host absent)",
                     name, h, age,
                 )
+            if stalled:
+                # black-box evidence of what led INTO the silence — the
+                # wedge may never surface a Python exception to dump on
+                # (rate-limited; one dump covers the whole stalled batch)
+                _flight.recorder().instant("fatal.watchdog.stall")
+                _flight.dump(reason="watchdog-stall", force=False)
+
+    def _poll_flight_trigger(self) -> None:
+        """`bfrun --dump` trigger poll for jobs without a heartbeat monitor
+        (single-controller): the watchdog is the only always-on cadence
+        thread there. Multi-controller jobs poll on the heartbeat tick."""
+        try:
+            from . import control_plane as _cp
+            from .state import _global_state
+
+            if _cp.active() and _global_state().peer_monitor is None:
+                _flight.poll_remote_trigger(_cp.client())
+        except Exception:  # noqa: BLE001 — observability thread
+            pass

@@ -343,6 +343,43 @@ inline void ClIn(uint8_t op, long long bytes) {
   g_cl_bytes_in[op & 31].fetch_add(bytes, std::memory_order_relaxed);
 }
 
+// -- transport flight ring (r12 observability) -------------------------------
+//
+// Fixed ring of transport-level events — redials, stale frames, striped
+// transfers with per-stripe timings — read by Python (bf_flight_ring) and
+// spliced into flight-recorder postmortem dumps (runtime/flight.py). The
+// counters above say HOW MANY; this ring says WHEN, which is what a
+// postmortem needs. Events are rare (reconnects and bulk ops, never the
+// per-op path), so a mutex-guarded write is the simple correct choice.
+// Timestamps are wall-clock microseconds: dumps merge across processes on
+// the shared wall-clock axis without a per-process anchor.
+constexpr long long kFlightRedialAttempt = 1;  // a = attempt index
+constexpr long long kFlightRedial = 2;         // a = attempt index
+constexpr long long kFlightStaleFrame = 3;
+constexpr long long kFlightStripe = 4;         // a = bytes, b = micros
+constexpr long long kFlightStripedXfer = 5;    // a = bytes, b = micros
+constexpr int kFlightCap = 1024;  // power of two
+struct FlightEv { long long t_us, kind, a, b; };
+FlightEv g_flight[kFlightCap];
+long long g_flight_n = 0;
+std::mutex g_flight_mu;
+
+long long WallNowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+void FlightRec(long long kind, long long a, long long b) {
+  std::lock_guard<std::mutex> g(g_flight_mu);
+  FlightEv& e = g_flight[g_flight_n & (kFlightCap - 1)];
+  e.t_us = WallNowUs();
+  e.kind = kind;
+  e.a = a;
+  e.b = b;
+  ++g_flight_n;
+}
+
 // -- SHA-256 / HMAC-SHA256 (self-contained; no OpenSSL in the image) --------
 //
 // Used only for the connection handshake below — the analog of the
@@ -1545,6 +1582,7 @@ struct ControlClient {
       // flag so every later op fails fast without touching the wire.
       stale = true;
       g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
+      FlightRec(kFlightStaleFrame, 0, 0);
       *reply = kStaleIncarnationReply;
       return true;
     }
@@ -1596,6 +1634,7 @@ struct ControlClient {
         if (got && rlen == kStaleFrame) {
           stale = true;
           g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
+      FlightRec(kFlightStaleFrame, 0, 0);
           return kStaleIncarnationReply;
         }
         if (got && rlen <= kMaxMsg) {
@@ -1635,6 +1674,7 @@ struct ControlClient {
           if (rlen == kStaleFrame) {
             stale = true;
             g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
+      FlightRec(kFlightStaleFrame, 0, 0);
             return kStaleIncarnationReply;
           }
           if (rlen > cap) return -1;  // oversized: a real protocol error
@@ -1793,6 +1833,7 @@ struct ControlClient {
           // retry loop below sees the flag and stops.
           stale = true;
           g_cl_stale_frames.fetch_add(1, std::memory_order_relaxed);
+      FlightRec(kFlightStaleFrame, 0, 0);
           std::free(payload);
           return false;
         }
@@ -1917,10 +1958,12 @@ bool ControlClient::Reconnect(int attempt) {
   if (ms > 2000) ms = 2000;
   if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
   g_cl_redial_attempts.fetch_add(1, std::memory_order_relaxed);
+  FlightRec(kFlightRedialAttempt, attempt, 0);
   int nfd = DialAndHandshake(host, port, secret, sockbuf);
   if (nfd < 0) return false;
   fd = nfd;
   g_cl_redials.fetch_add(1, std::memory_order_relaxed);
+  FlightRec(kFlightRedial, attempt, 0);
   // A rebuilt stream must re-register its incarnation before any op rides
   // it — an unregistered reconnect would dodge the server's fence. A stale
   // verdict here latches `stale` and fails the reconnect: the caller's op
@@ -2155,6 +2198,26 @@ int bf_cp_client_counters(long long* out, int n) {
   return want;
 }
 
+// Transport flight ring readout (runtime/flight.py splices this into
+// postmortem dumps): copies up to max_events events oldest -> newest, four
+// int64 per event [wall_us, kind, a, b]; returns the count copied. Kinds
+// mirror the kFlight* constants above (native.py keeps the name table).
+int bf_flight_ring(long long* out, int max_events) {
+  if (!out || max_events <= 0) return 0;
+  std::lock_guard<std::mutex> g(g_flight_mu);
+  long long count = g_flight_n < kFlightCap ? g_flight_n : kFlightCap;
+  if (count > max_events) count = max_events;
+  long long start = g_flight_n - count;
+  for (long long j = 0; j < count; ++j) {
+    const FlightEv& e = g_flight[(start + j) & (kFlightCap - 1)];
+    out[j * 4] = e.t_us;
+    out[j * 4 + 1] = e.kind;
+    out[j * 4 + 2] = e.a;
+    out[j * 4 + 3] = e.b;
+  }
+  return static_cast<int>(count);
+}
+
 // Server block: [0..31] per-op dispatch counts, [32] live connections,
 // [33] queued mailbox records, [34] queued mailbox payload bytes,
 // [35] locks currently held, [36] lock force-releases, [37] barrier
@@ -2266,8 +2329,12 @@ int64_t bf_cp_put_bytes_striped(void** handles, int nh, const char* key,
                                 const void* data, int64_t len) {
   if (nh <= 0) return -1;
   g_cl_striped_xfers.fetch_add(1, std::memory_order_relaxed);
-  if (nh == 1 || len < nh)
-    return bf_cp_put_bytes_part(handles[0], key, 0, len, data, len);
+  long long xfer_t0 = WallNowUs();
+  if (nh == 1 || len < nh) {
+    int64_t r = bf_cp_put_bytes_part(handles[0], key, 0, len, data, len);
+    FlightRec(kFlightStripedXfer, len, WallNowUs() - xfer_t0);
+    return r;
+  }
   int64_t per = (len + nh - 1) / nh;
   std::vector<std::thread> ts;
   std::atomic<bool> ok{true};
@@ -2275,13 +2342,16 @@ int64_t bf_cp_put_bytes_striped(void** handles, int nh, const char* key,
     int64_t off = per * i;
     int64_t n = off + per > len ? len - off : per;
     if (n <= 0) return;
+    long long t0 = WallNowUs();
     if (bf_cp_put_bytes_part(handles[i], key, off, len,
                              static_cast<const char*>(data) + off, n) < 0)
       ok.store(false);
+    FlightRec(kFlightStripe, n, WallNowUs() - t0);
   };
   for (int i = 1; i < nh; ++i) ts.emplace_back(run, i);
   run(0);
   for (auto& t : ts) t.join();
+  FlightRec(kFlightStripedXfer, len, WallNowUs() - xfer_t0);
   return ok.load() ? 1 : -1;
 }
 
@@ -2294,6 +2364,7 @@ int64_t bf_cp_get_bytes_striped(void** handles, int nh, const char* key,
                                 void** out, int64_t* out_len) {
   if (nh <= 0) return -1;
   g_cl_striped_xfers.fetch_add(1, std::memory_order_relaxed);
+  long long xfer_t0 = WallNowUs();
   for (int attempt = 0; attempt < 3; ++attempt) {
     int64_t total = bf_cp_bytes_len(handles[0], key);
     if (total < 0) return -1;
@@ -2307,12 +2378,14 @@ int64_t bf_cp_get_bytes_striped(void** handles, int nh, const char* key,
         int64_t off = per * i;
         int64_t n = off + per > total ? total - off : per;
         if (n <= 0) return;
+        long long t0 = WallNowUs();
         int64_t got =
             bf_cp_get_bytes_part(handles[i], key, off, n, payload + off);
         if (got < 0)
           failed.store(true);
         else if (got != n)
           short_read.store(true);  // value shrank mid-read: retry
+        FlightRec(kFlightStripe, n, WallNowUs() - t0);
       };
       for (int i = 1; i < nh; ++i) ts.emplace_back(run, i);
       run(0);
@@ -2328,6 +2401,7 @@ int64_t bf_cp_get_bytes_striped(void** handles, int nh, const char* key,
     }
     *out = payload;
     *out_len = total;
+    FlightRec(kFlightStripedXfer, total, WallNowUs() - xfer_t0);
     return total;
   }
   return -1;

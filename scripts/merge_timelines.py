@@ -37,29 +37,39 @@ def load_events(path: str) -> list:
     return events
 
 
-def wall_offset_us(events: list, path: str) -> float:
-    """wall_us - trace_ts for this file (from its clock-sync counter)."""
+def wall_offset_us(events: list, path: str):
+    """wall_us - trace_ts for this file (from its clock-sync counter), or
+    None when the anchor is missing (old build / truncated file) — the
+    caller warns and leaves that file's timestamps unshifted rather than
+    silently misaligning every rank."""
     for ev in events:
         if ev.get("name") == CLOCK_SYNC and ev.get("ph") == "C":
             value = ev.get("args", {}).get("value")
             if value is None:
                 break
             return float(value) - float(ev.get("ts", 0.0))
-    raise ValueError(
-        f"{path}: no '{CLOCK_SYNC}' clock-sync event — produced by an old "
-        "build? Re-record the trace, or merge by hand at your own risk")
+    return None
 
 
 def merge(paths) -> list:
     per_file = []
     for p in paths:
         events = load_events(p)
-        per_file.append((p, events, wall_offset_us(events, p)))
-    base = min(off for _, _, off in per_file)
+        off = wall_offset_us(events, p)
+        if off is None:
+            print(
+                f"WARNING: {p}: no '{CLOCK_SYNC}' clock-sync anchor "
+                "(produced by an old build, or the trace was truncated "
+                "before its first event) — leaving its timestamps "
+                "UNSHIFTED; cross-rank ordering against this file is not "
+                "meaningful", file=sys.stderr)
+        per_file.append((p, events, off))
+    anchored = [off for _, _, off in per_file if off is not None]
+    base = min(anchored) if anchored else 0.0
     merged = []
     pids = set()
     for path, events, off in per_file:
-        shift = off - base
+        shift = (off - base) if off is not None else 0.0
         for ev in events:
             if "ts" in ev:
                 ev = dict(ev)

@@ -121,11 +121,19 @@ def main() -> int:
     check(text.strip(), "prometheus scrape file is empty")
     metric_re = re.compile(
         r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+(\s+\d+)?$")
-    for line in text.strip().splitlines():
-        if line.startswith("#"):
+    prom_lines = text.strip().splitlines()
+    for i, line in enumerate(prom_lines):
+        if line.startswith("# TYPE"):
             check(re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
                            r"(counter|gauge|histogram)$", line),
                   f"bad TYPE line: {line!r}")
+            # every family must be self-describing: HELP precedes TYPE
+            m = line.split()[2]
+            check(i > 0 and prom_lines[i - 1].startswith(f"# HELP {m} "),
+                  f"TYPE without a preceding HELP line: {line!r}")
+        elif line.startswith("#"):
+            check(re.match(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S", line),
+                  f"bad HELP line: {line!r}")
         else:
             check(metric_re.match(line), f"bad metric line: {line!r}")
     check("bluefog_opt_step" in text, "opt.step missing from the scrape")
@@ -140,6 +148,15 @@ def main() -> int:
     check("rank 0" in out.stdout and "step 4" in out.stdout,
           f"--status output missing rank/step: {out.stdout!r}")
     check("conserved" in out.stdout, "--status output missing mass check")
+
+    # --strict on a HEALTHY job must still exit 0 (it only reds on
+    # dead/straggler/mass-drift findings)
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--status",
+         "--strict"],
+        env=env, capture_output=True, text=True, timeout=120)
+    check(out.returncode == 0,
+          f"--status --strict nonzero on a healthy job: {out.stderr}")
 
     opt.free()
     bf.shutdown()

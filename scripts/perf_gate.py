@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Performance regression gate (`make perf-gate`).
+
+Runs the quick modes of the two standing benchmark harnesses —
+``win_microbench`` (hosted window data plane, 4 real controller processes)
+and ``opt_matrix_bench`` (full optimizer step over the 8-device simulated
+mesh) — ``--repeats`` times, takes the per-metric **median**, and compares
+against the committed baseline (``PERF_BASELINE.json``) with a
+**percentage band**: a metric whose median lands below
+``baseline * (1 - band)`` reds the gate. Median-of-N plus a generous band
+is the noise tolerance: quick-mode numbers on a shared CI box jitter tens
+of percent run to run, a real regression (a serialization bug, an extra
+copy, a lost overlap) costs 2-10x.
+
+Only the *stable* quick-mode series gate: the hosted window ops
+(win_put / win_accumulate / win_update / win_get MB/s) and the optimizer
+step rates. Sub-millisecond raw-socket probes are reported in the JSON but
+never gate — their quick-mode medians swing 3x on scheduler whim.
+
+Exit codes: 0 pass, 1 regression (or a bench failed), 2 usage/baseline
+problems.
+
+Usage:
+    python scripts/perf_gate.py [--quick] [--repeats N] [--band FRAC]
+    python scripts/perf_gate.py --update-baseline   # rewrite the baseline
+    BLUEFOG_PERF_GATE_DELAY_MS=50 make perf-gate    # seeded slowdown: RED
+
+The seeded-slowdown knob (declared in runtime/config.py) injects an
+artificial delay into every hosted window op and optimizer step, which is
+how the gate's red path is exercised deterministically — if that run ever
+passes, the gate is broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import statistics
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+BASELINE = REPO / "PERF_BASELINE.json"
+
+# metrics that GATE (stable quick-mode series); everything else collected
+# is informational
+_GATING_OPS = ("win_put", "win_accumulate", "win_update", "win_get")
+_OPT_MODES = ("neighbor_allreduce", "win_put")
+
+
+def _run(cmd, timeout) -> str:
+    r = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                       timeout=timeout,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"bench failed ({' '.join(map(str, cmd))}):\n"
+            + (r.stdout + r.stderr)[-2000:])
+    return r.stdout
+
+
+def collect_once() -> dict:
+    """One pass over both harnesses -> {metric: value} (higher = better)."""
+    out: dict = {}
+    text = _run([sys.executable, "scripts/win_microbench.py", "--quick"],
+                timeout=900)
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        row = json.loads(line)
+        if row.get("mbps") is not None:
+            out[f"win.{row['config']}.{row['op']}.mbps"] = row["mbps"]
+    text = _run([sys.executable, "scripts/opt_matrix_bench.py", "--quick",
+                 "--modes", *_OPT_MODES], timeout=1800)
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        row = json.loads(line)
+        if "error" in row:
+            raise RuntimeError(
+                f"opt_matrix_bench mode {row['mode']} failed: "
+                f"{row['error']}")
+        out[f"opt.{row['mode']}.img_per_sec"] = row["img_per_sec"]
+    return out
+
+
+def collect(repeats: int) -> dict:
+    """Median over ``repeats`` full passes, per metric."""
+    runs = []
+    for i in range(repeats):
+        t0 = time.time()
+        runs.append(collect_once())
+        print(f"perf-gate: pass {i + 1}/{repeats} done "
+              f"({time.time() - t0:.0f}s, {len(runs[-1])} metrics)",
+              flush=True)
+    metrics = {}
+    for name in sorted({k for r in runs for k in r}):
+        vals = [r[name] for r in runs if name in r]
+        metrics[name] = statistics.median(vals)
+    return metrics
+
+
+def gating(metrics: dict) -> dict:
+    keep = {}
+    for name, v in metrics.items():
+        if name.startswith("opt.") or \
+                any(name.endswith(f"{op}.mbps") or f".{op}." in name
+                    for op in _GATING_OPS):
+            keep[name] = v
+    return keep
+
+
+def compare(metrics: dict, baseline: dict, band: float):
+    """-> (failures, report lines) against the baseline's gating set."""
+    failures = []
+    lines = []
+    for name in sorted(baseline):
+        base = baseline[name]
+        got = metrics.get(name)
+        if got is None:
+            failures.append(name)
+            lines.append(f"  MISSING  {name}: baseline {base:g}, no "
+                         "measurement this run")
+            continue
+        ratio = got / base if base else float("inf")
+        verdict = "ok"
+        if ratio < 1.0 - band:
+            verdict = "REGRESSION"
+            failures.append(name)
+        lines.append(f"  {verdict:<10} {name}: {got:g} vs baseline "
+                     f"{base:g} ({(ratio - 1) * 100:+.0f}%, band "
+                     f"-{band * 100:.0f}%)")
+    for name in sorted(set(metrics) - set(baseline)):
+        lines.append(f"  info      {name}: {metrics[name]:g} "
+                     "(not a gating metric)")
+    return failures, lines
+
+
+def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
+    """BENCH_rXX-style JSON document."""
+    return {
+        "meta": {
+            "kind": "perf_gate",
+            "host": platform.node(),
+            "repeats": repeats,
+            "band": band,
+            "harnesses": ["win_microbench --quick",
+                          "opt_matrix_bench --quick --modes "
+                          + " ".join(_OPT_MODES)],
+            "note": "quick-mode numbers: gate-relative only, meaningless "
+                    "as absolute throughput (see PERF.md for real runs)",
+        },
+        "metrics": metrics,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--quick", action="store_true",
+                    help="accepted for Makefile symmetry (the gate always "
+                         "runs the harnesses' quick modes)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="full passes to median over (default 3)")
+    ap.add_argument("--band", type=float, default=0.40,
+                    help="allowed fractional drop below baseline before "
+                         "red (default 0.40 — quick modes are noisy; real "
+                         "regressions are larger)")
+    ap.add_argument("--baseline", type=str, default=str(BASELINE))
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="measure and REWRITE the baseline file instead of "
+                         "comparing")
+    ap.add_argument("--json", type=str, default=None,
+                    help="also write this run's BENCH-style JSON here")
+    args = ap.parse_args(argv)
+
+    if os.environ.get("BLUEFOG_PERF_GATE_DELAY_MS") and \
+            args.update_baseline:
+        print("perf-gate: refusing to bake a seeded slowdown "
+              "(BLUEFOG_PERF_GATE_DELAY_MS is set) into the baseline",
+              file=sys.stderr)
+        return 2
+
+    try:
+        metrics = collect(max(1, args.repeats))
+    except (RuntimeError, subprocess.TimeoutExpired) as exc:
+        print(f"perf-gate: bench run failed:\n{exc}", file=sys.stderr)
+        return 1
+    doc = bench_doc(metrics, args.repeats, args.band)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+
+    if args.update_baseline:
+        base_doc = bench_doc(gating(metrics), args.repeats, args.band)
+        with open(args.baseline, "w") as f:
+            json.dump(base_doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"perf-gate: baseline updated -> {args.baseline} "
+              f"({len(base_doc['metrics'])} gating metrics)")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)["metrics"]
+    except (OSError, KeyError, json.JSONDecodeError) as exc:
+        print(f"perf-gate: cannot read baseline {args.baseline} ({exc}); "
+              "run `python scripts/perf_gate.py --update-baseline` on a "
+              "healthy tree first", file=sys.stderr)
+        return 2
+    failures, lines = compare(metrics, baseline, args.band)
+    print("perf-gate comparison (median of "
+          f"{args.repeats} pass(es) vs {args.baseline}):")
+    for line in lines:
+        print(line)
+    if failures:
+        print(f"perf-gate: RED — {len(failures)} metric(s) regressed "
+              f"beyond the {args.band * 100:.0f}% band: {failures}",
+              file=sys.stderr)
+        return 1
+    print("perf-gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Step-time attribution: where does each gossip step's wall time go?
+
+Consumes flight-recorder dumps (``bf_flight_<rank>.json``, ``bfrun
+--dump`` output — per-rank or merged) or a merged chrome trace, and prints
+per rank the LAST complete optimizer step's phase breakdown — pack / wire
+/ drain / fold (plus local compute, unpack, and the unattributed
+remainder) — the per-edge deposit totals with byte-weighted wire-time
+estimates, and the dominant phase/edge. This is the input the per-edge
+plane planner needs (ROADMAP: on-device gossip fast path): the edges whose
+wire+drain share dominates the step are the ones to move in-program.
+
+Cross-rank (multiple dumps / a merged trace): deposit→drain flow pairs are
+matched by id, reporting per-edge transit latency — the one number a
+single rank cannot measure about itself.
+
+Usage:
+    python scripts/step_attribution.py bf_flight_0.json [bf_flight_1.json ...]
+    python scripts/step_attribution.py bf_flight_dump/merged.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bluefog_tpu.runtime import flight  # noqa: E402
+
+# chrome ph -> flight kind (for merged-trace input)
+_PH_KIND = {"B": flight.SPAN_B, "E": flight.SPAN_E, "i": flight.INSTANT,
+            "C": flight.COUNTER, "s": flight.FLOW_S, "f": flight.FLOW_F}
+# legacy timeline span names -> the flight vocabulary
+_TIMELINE_NAMES = {"STEP": "opt.step", "PACK": "opt.pack",
+                   "UNPACK": "opt.unpack"}
+
+
+def _docs_from_chrome(events: list) -> dict:
+    """Regroup a merged chrome trace into per-pid pseudo-dumps that
+    :func:`flight.analyze_dump` understands."""
+    per_pid: dict = {}
+    for e in events:
+        ph = e.get("ph")
+        kind = _PH_KIND.get(ph)
+        if kind is None:
+            continue
+        pid = e.get("pid", 0)
+        doc = per_pid.setdefault(pid, {"names": [], "_ids": {},
+                                       "events": {"kind": [], "name": [],
+                                                  "t_wall_us": [], "a": [],
+                                                  "b": []}})
+        name = e.get("name", "")
+        name = _TIMELINE_NAMES.get(name, name)
+        if ph == "E" and not name:
+            # timeline E events carry no name; un-analyzable — skip
+            continue
+        nid = doc["_ids"].get(name)
+        if nid is None:
+            nid = doc["_ids"][name] = len(doc["names"])
+            doc["names"].append(name)
+        args = e.get("args", {})
+        a = args.get("a", args.get("bytes", args.get("value", 0.0)))
+        b = e.get("id", args.get("b", 0))
+        ev = doc["events"]
+        ev["kind"].append(kind)
+        ev["name"].append(nid)
+        ev["t_wall_us"].append(float(e.get("ts", 0.0)))
+        ev["a"].append(float(a or 0.0))
+        ev["b"].append(int(b or 0))
+    for doc in per_pid.values():
+        doc.pop("_ids")
+    return per_pid
+
+
+def load(paths) -> dict:
+    """{rank: dump-doc} from flight dumps and/or merged chrome traces."""
+    docs: dict = {}
+    for p in paths:
+        with open(p) as f:
+            data = json.load(f)
+        if isinstance(data, list):  # merged chrome trace
+            for pid, doc in _docs_from_chrome(data).items():
+                docs[pid] = doc
+        elif "events" in data:      # flight dump
+            docs[data.get("meta", {}).get("rank", len(docs))] = data
+        else:
+            raise ValueError(f"{p}: neither a flight dump nor a chrome "
+                             "trace")
+    return docs
+
+
+def flow_pairs(docs: dict) -> dict:
+    """Cross-rank deposit→drain transit latency per edge: flow id matched
+    between any rank's FLOW_S and any rank's FLOW_F."""
+    starts: dict = {}
+    finishes: dict = {}
+    for doc in docs.values():
+        names = doc.get("names", [])
+        ev = doc.get("events", {})
+        for k, n, t, a, b in zip(ev["kind"], ev["name"], ev["t_wall_us"],
+                                 ev["a"], ev["b"]):
+            name = names[n] if 0 <= n < len(names) else ""
+            if k == flight.FLOW_S and name.startswith("edge."):
+                starts[b] = (name[5:].replace(".", "->"), t, a)
+            elif k == flight.FLOW_F:
+                finishes[b] = t
+    per_edge: dict = {}
+    for fid, (edge, t0, nbytes) in starts.items():
+        t1 = finishes.get(fid)
+        if t1 is None:
+            continue
+        d = per_edge.setdefault(edge, {"pairs": 0, "bytes": 0.0,
+                                       "transit_us": []})
+        d["pairs"] += 1
+        d["bytes"] += nbytes
+        d["transit_us"].append(t1 - t0)
+    return per_edge
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("files", nargs="+",
+                    help="flight dumps and/or merged chrome traces")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (one JSON object)")
+    args = ap.parse_args(argv)
+    docs = load(args.files)
+    reports = {}
+    for rank in sorted(docs):
+        rep = flight.analyze_dump(docs[rank])
+        if rep is not None:
+            reports[rank] = rep
+    if not reports:
+        print("no complete optimizer step found in the input "
+              "(did the job run a window optimizer?)", file=sys.stderr)
+        return 1
+    pairs = flow_pairs(docs)
+    if args.json:
+        print(json.dumps({"ranks": {str(r): rep
+                                    for r, rep in reports.items()},
+                          "flow_pairs": {e: {**d, "transit_us":
+                                             sorted(d["transit_us"])}
+                                         for e, d in pairs.items()}}))
+        return 0
+    for rank, rep in reports.items():
+        print(f"== rank {rank} ==")
+        print(flight.format_report(rep))
+        # the critical path: the dominant attributed phase and edge
+        dom_phase = max(rep["phases"], key=lambda p: rep["phases"][p])
+        line = (f"  dominant phase: {dom_phase} "
+                f"({rep['phases'][dom_phase] * 1e3:.3f} ms of "
+                f"{rep['step_sec'] * 1e3:.3f} ms)")
+        if rep["edges"]:
+            dom_edge = max(rep["edges"],
+                           key=lambda e: rep["edges"][e]["bytes"])
+            line += f"; dominant edge: {dom_edge}"
+        print(line)
+    if pairs:
+        print("== cross-rank deposit→drain transit (flow pairs) ==")
+        for edge in sorted(pairs):
+            d = pairs[edge]
+            ts = sorted(d["transit_us"])
+            med = ts[len(ts) // 2]
+            print(f"  {edge:<8} {d['pairs']:4d} pairs, "
+                  f"{d['bytes'] / 1e6:8.2f} MB, median transit "
+                  f"{med / 1e3:.2f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,16 @@ os.environ["JAX_PLATFORMS"] = (
     "cpu" if (os.environ.get("BLUEFOG_TESTS_CPU_ONLY") == "1"
               or os.environ.get("JAX_PLATFORMS") == "cpu") else "")
 
+# Flight-recorder dumps default to the cwd; tests that deliberately stall
+# handles or crash optimizer steps would litter the repo root, so the
+# suite's automatic dumps land in a throwaway dir instead (tests that
+# assert on dump files monkeypatch their own BLUEFOG_FLIGHT_DIR).
+if "BLUEFOG_FLIGHT_DIR" not in os.environ:
+    import tempfile
+
+    os.environ["BLUEFOG_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="bf_flight_tests_")
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 

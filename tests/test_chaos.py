@@ -636,6 +636,72 @@ def test_gossip_step_retries_after_dead_mutex_holder(bf_hosted_cp):
         opt.free()
 
 
+def test_flight_dump_after_injected_peer_lost_under_drops(
+        bf_hosted_cp, tmp_path, monkeypatch):
+    """ISSUE r12 satellite: an injected PeerLostError under armed
+    BLUEFOG_CP_FAULT leaves a parseable flight dump — fatal instant in the
+    tail, the drop-churn transport events spliced in from the native ring.
+    Rides `make chaos`: the armed drop points shift with the seed offset,
+    so the dump is produced under different wire damage each replay."""
+    import json
+
+    import jax.numpy as jnp
+
+    from bluefog_tpu.runtime import flight as flight_mod
+    from bluefog_tpu.runtime import handles
+
+    bf = bf_hosted_cp
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("BLUEFOG_FLIGHT_MIN_INTERVAL", "0")
+    flight_mod.reset_for_job()
+
+    # hosted gossip traffic while connections are being killed under it:
+    # the transparent redials land in the NATIVE flight ring every dump
+    # splices in
+    x = jnp.arange(8.0).reshape(8, 1) + 1.0
+    assert bf.win_create(x, "chaos.fl", zero_init=True)
+    native.fault_arm(f"drop_after=5,seed={_seed(17)}")
+    for _ in range(3):
+        bf.win_accumulate(x, "chaos.fl")
+        bf.win_update("chaos.fl")
+    drops = native.fault_stats()["drops"]
+    native.fault_disarm()
+    assert drops >= 2, f"only {drops} drops injected"
+    bf.win_free("chaos.fl")
+
+    # injected PeerLostError through the runtime's own synchronize path:
+    # a handle that can never complete while the failure detector names a
+    # dead controller — the typed raise must leave a dump behind
+    class _NeverReady:
+        def is_ready(self):
+            return False
+
+    monkeypatch.setattr(heartbeat, "dead_controllers", lambda: {1})
+    h = handles.allocate("op.fl", _NeverReady())
+    try:
+        with pytest.raises(native.PeerLostError):
+            handles.synchronize(h, timeout=0.1)
+        path = tmp_path / "bf_flight_0.json"
+        assert path.exists(), "injected PeerLostError left no flight dump"
+        doc = json.loads(path.read_text())
+        assert "PeerLostError" in doc["meta"]["exception"]
+        names = doc["names"]
+        instants = [names[n]
+                    for k, n in zip(doc["events"]["kind"],
+                                    doc["events"]["name"])
+                    if k == flight_mod.INSTANT]
+        assert "fatal.synchronize" in instants
+        # the spliced native ring carries the redial churn the armed
+        # drops just caused (kind 1 = attempt, 2 = success)
+        kinds = {row[1] for row in doc["native"]}
+        assert kinds & {1, 2}, f"native ring missing redials: {kinds}"
+        # (cp.fault.* counters reset on disarm by design — the drops>=2
+        # assertion above is the churn evidence)
+    finally:
+        handles.clear()
+        flight_mod.reset_for_job()
+
+
 # ---------------------------------------------------------------------------
 # kill a peer mid-gossip: survivors renormalize and keep training (slow)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,550 @@
+"""Flight recorder: ring, dumps, triggers, attribution, cluster retrieval.
+
+Covers the r12 acceptance surface in-process:
+
+  * ring mechanics — wraparound with a dropped count, name interning, the
+    disable knob, the packed-tail roundtrip, rate limiting;
+  * chrome conversion + merged cross-rank view: a deposit on "controller
+    A" and its drain on "controller B" (the split-ownership trick from
+    test_metrics) bind as a flow pair, and a fatal optimizer step's
+    instant is present in the merged view;
+  * step-time attribution: ``bf.step_report()`` phases cover the step
+    span (10% bound) and scripts/step_attribution.py agrees;
+  * triggers — fatal optimizer-step exceptions, the excepthook chain,
+    and the ``bfrun --dump`` remote-trigger poll (faked KV);
+  * ``bfrun --status --strict`` findings.
+
+The watchdog-stall trigger lives in test_watchdog.py and the
+PeerLostError-under-chaos trigger in test_chaos.py (riding `make chaos`
+seed offsets).
+"""
+
+import json
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.runtime import control_plane as cp
+from bluefog_tpu.runtime import flight as flight_mod
+from bluefog_tpu.runtime import native
+from bluefog_tpu.runtime.state import _global_state
+
+from conftest import cpu_devices
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+# ---------------------------------------------------------------------------
+
+def test_ring_wraps_and_counts_drops():
+    r = flight_mod.FlightRecorder(capacity=256)
+    assert r.capacity == 256
+    nid = r.intern("ev")
+    for i in range(300):
+        r.rec(flight_mod.INSTANT, nid, b=i)
+    s = r.snapshot()
+    assert s["recorded"] == 300
+    assert s["dropped"] == 44
+    assert len(s["events"]["kind"]) == 256
+    # oldest surviving event is #44, newest #299, in order
+    assert s["events"]["b"][0] == 44
+    assert s["events"]["b"][-1] == 299
+    ts = s["events"]["t_wall_us"]
+    assert ts == sorted(ts)
+
+
+def test_capacity_rounds_up_to_power_of_two():
+    assert flight_mod.FlightRecorder(capacity=1000).capacity == 1024
+    assert flight_mod.FlightRecorder(capacity=1).capacity == 256  # floor
+
+
+def test_intern_is_stable_and_threadsafe_enough():
+    r = flight_mod.FlightRecorder(capacity=256)
+    a = r.intern("x")
+    b = r.intern("y")
+    assert r.intern("x") == a and r.intern("y") == b and a != b
+    s = r.snapshot()
+    assert s["names"] == ["x", "y"]
+
+
+def test_disable_knob_installs_null_recorder(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DISABLE", "1")
+    flight_mod.reset_for_job()
+    try:
+        r = flight_mod.recorder()
+        r.begin("a")
+        r.end("a")
+        with r.span("b"):
+            pass
+        assert r.snapshot()["recorded"] == 0
+        assert flight_mod.step_report() is None
+    finally:
+        monkeypatch.delenv("BLUEFOG_FLIGHT_DISABLE")
+        flight_mod.reset_for_job()
+
+
+def test_span_context_and_snapshot_kinds():
+    r = flight_mod.FlightRecorder(capacity=256)
+    with r.span("op", a=7.5, b=3):
+        r.instant("mark")
+    r.counter("gauge", 42)
+    s = r.snapshot()
+    kinds = s["events"]["kind"]
+    assert kinds == [flight_mod.SPAN_B, flight_mod.INSTANT,
+                     flight_mod.SPAN_E, flight_mod.COUNTER]
+    assert s["events"]["a"][0] == 7.5 and s["events"]["b"][0] == 3
+
+
+def test_record_hot_path_is_cheap():
+    """In-suite sanity bound; the strict 1500 ns gate runs in
+    `make flight-smoke` (CI boxes share cores with the runner)."""
+    import timeit
+
+    r = flight_mod.FlightRecorder(capacity=4096)
+    nid = r.intern("bench")
+    n = 20_000
+    per = min(timeit.repeat("rec(3, nid)",
+                            globals={"rec": r.rec, "nid": nid},
+                            number=n, repeat=5)) / n
+    assert per < 5e-6, f"ring record costs {per * 1e9:.0f} ns"
+
+
+# ---------------------------------------------------------------------------
+# dump document + packed tail
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_roundtrip_and_bad_magic():
+    doc = flight_mod.build_dump("unit-test")
+    blob = flight_mod.pack_dump(doc)
+    back = flight_mod.unpack_dump(blob)
+    assert back["meta"]["reason"] == "unit-test"
+    assert back["events"] == doc["events"]
+    with pytest.raises(ValueError):
+        flight_mod.unpack_dump(b"XXXX" + blob[4:])
+    with pytest.raises(ValueError):
+        flight_mod.unpack_dump(b"")
+
+
+def test_dump_rate_limit_and_force(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("BLUEFOG_FLIGHT_MIN_INTERVAL", "3600")
+    flight_mod.reset_for_job()
+    try:
+        p1 = flight_mod.dump(reason="auto-1", publish=False, force=False)
+        assert p1 is not None and os.path.exists(p1)
+        # second automatic dump inside the window is suppressed...
+        assert flight_mod.dump(reason="auto-2", publish=False,
+                               force=False) is None
+        # ...but an explicit dump goes through
+        assert flight_mod.dump(reason="explicit", publish=False,
+                               force=True) is not None
+        assert json.load(open(p1))["meta"]["reason"] == "explicit"
+    finally:
+        flight_mod.reset_for_job()
+
+
+def test_fatal_records_instant_then_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("BLUEFOG_FLIGHT_MIN_INTERVAL", "0")
+    flight_mod.reset_for_job()
+    try:
+        path = flight_mod.fatal("unit", RuntimeError("boom"))
+        doc = json.load(open(path))
+        assert "RuntimeError: boom" in doc["meta"]["exception"]
+        names = doc["names"]
+        fatals = [i for k, n in zip(doc["events"]["kind"],
+                                    doc["events"]["name"])
+                  for i in ([n] if k == flight_mod.INSTANT else [])]
+        assert any(names[n] == "fatal.unit" for n in fatals)
+    finally:
+        flight_mod.reset_for_job()
+
+
+def test_excepthook_chain_dumps_and_calls_prev(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("BLUEFOG_FLIGHT_MIN_INTERVAL", "0")
+    flight_mod.reset_for_job()
+    called = []
+    prev_hook = sys.excepthook
+    monkeypatch.setattr(sys, "excepthook",
+                        lambda *a: called.append(a))
+    monkeypatch.setattr(flight_mod, "_hook_installed", False)
+    try:
+        flight_mod.install_excepthook()
+        assert sys.excepthook is not prev_hook
+        exc = ValueError("unhandled")
+        sys.excepthook(ValueError, exc, None)
+        assert called, "previous hook not chained"
+        dump = json.load(open(tmp_path / "bf_flight_0.json"))
+        assert "unhandled" in dump["meta"]["exception"]
+        # idempotent: a second install must not re-wrap
+        hook = sys.excepthook
+        flight_mod.install_excepthook()
+        assert sys.excepthook is hook
+    finally:
+        flight_mod.reset_for_job()
+
+
+# ---------------------------------------------------------------------------
+# remote trigger poll (faked KV)
+# ---------------------------------------------------------------------------
+
+class _FakeKV:
+    def __init__(self):
+        self.kv = {}
+        self.blobs = {}
+
+    def get(self, key):
+        return self.kv.get(key, 0)
+
+    def put(self, key, value):
+        self.kv[key] = int(value)
+
+    def put_bytes(self, key, blob):
+        self.blobs[key] = bytes(blob)
+
+
+def test_remote_trigger_latches_then_fires(tmp_path, monkeypatch):
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DIR", str(tmp_path))
+    flight_mod.reset_for_job()
+    cl = _FakeKV()
+    cl.kv[flight_mod.TRIGGER_KEY] = 7  # pre-existing trigger from the past
+    try:
+        # first poll only latches — a joining rank must not replay history
+        assert flight_mod.poll_remote_trigger(cl) is False
+        assert not cl.blobs
+        # no movement -> no dump
+        assert flight_mod.poll_remote_trigger(cl) is False
+        # a bump fires exactly once and acks with the trigger value
+        cl.kv[flight_mod.TRIGGER_KEY] = 8
+        assert flight_mod.poll_remote_trigger(cl) is True
+        assert cl.kv[flight_mod.ACK_KEY_FMT.format(rank=0)] == 8
+        doc = flight_mod.unpack_dump(
+            cl.blobs[flight_mod.DATA_KEY_FMT.format(rank=0)])
+        assert doc["meta"]["reason"] == "remote-trigger #8"
+        assert flight_mod.poll_remote_trigger(cl) is False
+    finally:
+        flight_mod.reset_for_job()
+
+
+# ---------------------------------------------------------------------------
+# attribution over synthetic events
+# ---------------------------------------------------------------------------
+
+def _synth_doc(events):
+    """events: list of (kind, name, t_us, a, b) -> dump-doc shape."""
+    names = []
+    ids = {}
+    cols = {"kind": [], "name": [], "t_wall_us": [], "a": [], "b": []}
+    for kind, name, t, a, b in events:
+        nid = ids.setdefault(name, len(names))
+        if nid == len(names):
+            names.append(name)
+        cols["kind"].append(kind)
+        cols["name"].append(nid)
+        cols["t_wall_us"].append(float(t))
+        cols["a"].append(float(a))
+        cols["b"].append(int(b))
+    return {"names": names, "events": cols}
+
+
+def test_analyze_dump_phases_and_overlap_subtraction():
+    B, E, S, F = (flight_mod.SPAN_B, flight_mod.SPAN_E, flight_mod.FLOW_S,
+                  flight_mod.FLOW_F)
+    doc = _synth_doc([
+        (B, "opt.step", 0, 0, 5),
+        (B, "opt.local", 0, 0, 0), (E, "opt.local", 100, 0, 0),
+        (B, "opt.pack", 100, 0, 0), (E, "opt.pack", 200, 0, 0),
+        (B, "opt.gossip", 200, 0, 0),
+        (B, "win.wire", 200, 0, 0), (E, "win.wire", 400, 0, 0),
+        (S, "edge.0.2", 390, 1000, 77),
+        (S, "edge.0.3", 395, 3000, 78),
+        # drain 400-700 with a nested fold 500-600: drain's exclusive
+        # share is 200us, fold keeps its own 100
+        (B, "win.drain", 400, 0, 0),
+        (B, "win.fold", 500, 0, 0), (E, "win.fold", 600, 0, 0),
+        (F, "drain.1", 600, 500, 99),
+        (E, "win.drain", 700, 0, 0),
+        (E, "opt.gossip", 700, 0, 0),
+        (B, "opt.unpack", 700, 0, 0), (E, "opt.unpack", 800, 0, 0),
+        (E, "opt.step", 1000, 0, 5),
+    ])
+    rep = flight_mod.analyze_dump(doc)
+    assert rep["step"] == 5
+    assert rep["step_sec"] == pytest.approx(1000e-6)
+    ph = rep["phases"]
+    assert ph["local"] == pytest.approx(100e-6)
+    assert ph["pack"] == pytest.approx(100e-6)
+    assert ph["wire"] == pytest.approx(200e-6)
+    assert ph["drain"] == pytest.approx(200e-6)  # 300 minus nested fold
+    assert ph["fold"] == pytest.approx(100e-6)
+    assert ph["unpack"] == pytest.approx(100e-6)
+    assert rep["other_sec"] == pytest.approx(200e-6)
+    assert rep["coverage"] == pytest.approx(0.8)
+    # per-edge totals + byte-weighted wire estimate
+    assert rep["edges"]["0->2"]["bytes"] == 1000
+    assert rep["edges"]["0->3"]["bytes"] == 3000
+    assert rep["edges"]["0->3"]["wire_sec_est"] == \
+        pytest.approx(0.75 * 200e-6)
+    assert rep["drains"]["1"]["deposits"] == 1
+    text = flight_mod.format_report(rep)
+    assert "dominant" not in text  # dominance is the script's addition
+    for token in ("pack", "wire", "drain", "fold", "edges"):
+        assert token in text
+
+
+def test_analyze_dump_needs_a_complete_step():
+    doc = _synth_doc([(flight_mod.SPAN_B, "opt.step", 0, 0, 1)])
+    assert flight_mod.analyze_dump(doc) is None
+    assert flight_mod.analyze_dump(_synth_doc([])) is None
+
+
+def test_chrome_events_and_merge():
+    B, E, S = flight_mod.SPAN_B, flight_mod.SPAN_E, flight_mod.FLOW_S
+    doc0 = _synth_doc([(B, "opt.step", 1000, 0, 1),
+                       (S, "edge.0.1", 1500, 64, 42),
+                       (E, "opt.step", 2000, 0, 1)])
+    doc0["meta"] = {"rank": 0}
+    doc1 = _synth_doc([(flight_mod.FLOW_F, "drain.0", 1800, 64, 42)])
+    doc1["meta"] = {"rank": 1}
+    merged = flight_mod.merge_dumps([doc0, doc1])
+    # earliest event rebased to ts=0; clock anchors present per rank
+    assert min(e["ts"] for e in merged if "ts" in e) == 0.0
+    anchors = [e for e in merged if e["name"] == "bf.clock_sync_us"]
+    assert {a["pid"] for a in anchors} == {0, 1}
+    starts = {e["id"]: e for e in merged if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in merged if e.get("ph") == "f"}
+    assert set(starts) & set(finishes) == {42}
+    assert starts[42]["pid"] == 0 and finishes[42]["pid"] == 1
+    assert finishes[42]["ts"] >= starts[42]["ts"]
+    metas = [e for e in merged if e.get("ph") == "M"]
+    assert {m["pid"] for m in metas} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end over the hosted plane
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def bf_hosted_flight(monkeypatch, tmp_path):
+    """4-rank job, forced control plane + hosted plane, dumps to tmp."""
+    if native.load() is None:
+        pytest.skip("native runtime unavailable")
+    port = _free_port()
+    for k, v in {
+        "BLUEFOG_CP_HOST": "127.0.0.1",
+        "BLUEFOG_CP_PORT": str(port),
+        "BLUEFOG_CP_WORLD": "1",
+        "BLUEFOG_CP_RANK": "0",
+        "BLUEFOG_WIN_HOST_PLANE": "1",
+        "BLUEFOG_FLIGHT_DIR": str(tmp_path),
+        "BLUEFOG_FLIGHT_MIN_INTERVAL": "0",
+    }.items():
+        monkeypatch.setenv(k, v)
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(4))
+    assert cp.active()
+    yield bf
+    bf.shutdown()
+    cp.reset_for_test()
+
+
+def _run_winput_steps(bf_, steps=3):
+    import jax.numpy as jnp
+    import optax
+
+    def loss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf_.DistributedWinPutOptimizer(optax.sgd(0.1), loss)
+    state = opt.init({"w": jnp.ones((32,), jnp.float32)})
+    for _ in range(steps):
+        state, _ = opt.step(state, jnp.zeros((4, 1), jnp.float32))
+    return opt, state
+
+
+def test_step_report_covers_the_step(bf_hosted_flight):
+    """Acceptance: the phase breakdown (with the explicit remainder) sums
+    to the measured step time within 10%, and the drain/fold phases are
+    real (non-zero) on a hosted window job."""
+    opt, _ = _run_winput_steps(bf_hosted_flight, steps=3)
+    try:
+        rep = bf.step_report()
+        assert rep is not None and rep["step"] == 3
+        total = sum(rep["phases"].values()) + rep["other_sec"]
+        assert abs(total - rep["step_sec"]) <= 0.10 * rep["step_sec"]
+        assert rep["phases"]["drain"] > 0
+        assert rep["phases"]["fold"] > 0
+        assert rep["phases"]["local"] > 0
+        assert rep["gossip_sec"] > 0
+    finally:
+        opt.free()
+
+
+def test_step_attribution_script_over_dump(bf_hosted_flight, tmp_path):
+    opt, _ = _run_winput_steps(bf_hosted_flight, steps=2)
+    try:
+        path = bf.flight_dump(path=str(tmp_path / "dump.json"))
+        assert path is not None
+        import subprocess
+
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "step_attribution.py"), path, "--json"],
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        rep = json.loads(out.stdout)["ranks"]["0"]
+        assert rep["step"] == 2
+        total = sum(rep["phases"].values()) + rep["other_sec"]
+        assert abs(total - rep["step_sec"]) <= 0.10 * rep["step_sec"]
+    finally:
+        opt.free()
+
+
+def test_fatal_step_dump_and_merged_flow_pair(bf_hosted_flight, tmp_path,
+                                              monkeypatch):
+    """The in-process analog of the kill-a-peer acceptance: controller A
+    (owning ranks 0..1) deposits and then dies mid-gossip (injected fatal
+    in its optimizer step); controller B (owning 2..3) drains. A's dump
+    must exist, be parseable, and carry the fatal instant; the merged
+    A+B view must contain >= 1 deposit->drain flow pair."""
+    import jax.numpy as jnp
+
+    from bluefog_tpu.ops import windows as win_mod
+
+    bf_ = bf_hosted_flight
+    st = _global_state()
+    x = bf_.shard_rank_stacked(bf_.mesh(), np.ones((4, 16),
+                                                   np.float32))
+
+    # controller A owns 0..1; its win_put deposits into 2..3's mailboxes
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [0, 1])
+    assert bf_.win_create(x, "fl.win", zero_init=True)
+    win_a = st.windows["fl.win"]
+    assert win_a.hosted and set(win_a.owned) == {0, 1}
+
+    # controller B's window half must exist BEFORE A deposits (creation
+    # defensively clears a crashed predecessor's pending records)
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [2, 3])
+    win_b = win_mod.Window("fl.win", np.ones((4, 16), np.float32),
+                           zero_init=True)
+    assert set(win_b.owned) == {2, 3}
+
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [0, 1])
+    bf_.win_put(x, "fl.win")
+
+    # A "dies": a fatal error escapes its optimizer step -> dump A
+    import optax
+
+    def bad_loss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf_.DistributedWinPutOptimizer(optax.sgd(0.1), bad_loss)
+    state = opt.init({"w": jnp.ones((8,), jnp.float32)})
+    monkeypatch.setattr(opt, "_gossip",
+                        lambda leaves: (_ for _ in ()).throw(
+                            native.PeerLostError("peer 1 died", dead=[1])))
+    with pytest.raises(native.PeerLostError):
+        opt.step(state, jnp.zeros((4, 1), jnp.float32))
+    dump_a_path = tmp_path / "bf_flight_0.json"
+    assert dump_a_path.exists(), "fatal step left no dump"
+    dump_a = json.load(open(dump_a_path))
+    assert "PeerLostError" in dump_a["meta"]["exception"]
+    names_a = dump_a["names"]
+    assert any(names_a[n] == "fatal.opt.step"
+               for k, n in zip(dump_a["events"]["kind"],
+                               dump_a["events"]["name"])
+               if k == flight_mod.INSTANT)
+
+    # controller B: fresh recorder (its own "process") drains A's
+    # deposits, dumps with rank identity 1
+    flight_mod.reset_for_job()
+    with win_b.state_mu:
+        win_b._drain_deposits()
+    dump_b = flight_mod.build_dump("drain-side")
+    dump_b["meta"]["rank"] = 1
+
+    merged = flight_mod.merge_dumps([dump_a, dump_b])
+    starts = {e["id"]: e for e in merged if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in merged if e.get("ph") == "f"}
+    pairs = set(starts) & set(finishes)
+    assert pairs, "no deposit->drain flow pair in the merged view"
+    for fid in pairs:
+        assert starts[fid]["pid"] == 0 and finishes[fid]["pid"] == 1
+        assert finishes[fid]["ts"] >= starts[fid]["ts"]
+    assert any(e.get("name") == "fatal.opt.step" for e in merged), \
+        "fatal instant missing from the merged view"
+    # cleanup: only the registered window (A's) is in the registry
+    opt.free()
+
+
+def test_bfrun_dump_external_process(bf_hosted_flight, tmp_path):
+    """`bfrun --dump` from a separate process retrieves this job's packed
+    tail over the control plane (watchdog-poll path: no peer monitor)."""
+    import subprocess
+
+    opt, _ = _run_winput_steps(bf_hosted_flight, steps=2)
+    try:
+        out_dir = tmp_path / "remote"
+        env = dict(os.environ)
+        out = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.launcher", "--dump",
+             "--cp", f"127.0.0.1:{os.environ['BLUEFOG_CP_PORT']}",
+             "--out", str(out_dir), "--dump-timeout", "30"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr + out.stdout
+        doc = json.load(open(out_dir / "flight_0.json"))
+        assert doc["meta"]["reason"].startswith("remote-trigger")
+        assert doc["events"]["kind"], "remote tail is empty"
+        merged = json.load(open(out_dir / "merged.json"))
+        assert any(e.get("name") == "bf.clock_sync_us" for e in merged)
+    finally:
+        opt.free()
+
+
+# ---------------------------------------------------------------------------
+# bfrun --status --strict findings
+# ---------------------------------------------------------------------------
+
+def test_strict_findings_classification():
+    from bluefog_tpu.launcher import _strict_findings
+
+    healthy = {"ranks": {0: {"alive": True}}, "stragglers": [],
+               "mass": {"conserved": True, "drift": 0.0,
+                        "tolerance": 1e-12}}
+    assert _strict_findings(healthy) == []
+    sick = {"ranks": {0: {"alive": True}, 1: {"alive": False}},
+            "stragglers": [2],
+            "mass": {"conserved": False, "drift": -0.5,
+                     "tolerance": 1e-12}}
+    findings = _strict_findings(sick)
+    assert len(findings) == 3
+    assert any("stale/dead" in f for f in findings)
+    assert any("straggler" in f for f in findings)
+    assert any("mass drift" in f for f in findings)
+    # mass=None (no push-sum job) is not a finding
+    assert _strict_findings({"ranks": {}, "stragglers": [],
+                             "mass": None}) == []
+
+
+def test_launcher_parser_accepts_new_flags():
+    from bluefog_tpu.launcher import build_parser
+
+    args = build_parser().parse_args(["--status", "--strict"])
+    assert args.status and args.strict
+    args = build_parser().parse_args(
+        ["--dump", "--cp", "h:1", "--out", "d", "--dump-timeout", "5"])
+    assert args.dump and args.out == "d" and args.dump_timeout == 5.0

@@ -132,10 +132,17 @@ def test_prometheus_exposition_lints():
     text = metrics_mod.prometheus_text(snap)
     lines = text.strip().splitlines()
     assert lines, "empty exposition"
-    for line in lines:
-        if line.startswith("#"):
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE"):
             assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
                             r"(counter|gauge|histogram)$", line), line
+            # self-describing scrape: every family carries a HELP line
+            m = line.split()[2]
+            assert i > 0 and lines[i - 1].startswith(f"# HELP {m} "), \
+                f"TYPE without HELP: {line!r}"
+        elif line.startswith("#"):
+            assert re.match(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S", line), \
+                line
         else:
             assert _METRIC_RE.match(line), line
     # histogram structure: cumulative buckets + +Inf + sum/count

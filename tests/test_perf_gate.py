@@ -1,0 +1,144 @@
+"""Perf regression gate: comparison logic + the seeded-slowdown knob.
+
+Tier-1-safe: the expensive end (actually running win_microbench /
+opt_matrix_bench) happens only in `make perf-gate`; here the gate's
+decision logic runs over synthetic measurements, the committed baseline is
+validated structurally, and the injected-delay knob is verified to bite at
+the two injection points (optimizer step, hosted window op) — the
+mechanism `BLUEFOG_PERF_GATE_DELAY_MS=50 make perf-gate` relies on to turn
+the gate red.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+
+_REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _load_perf_gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(_REPO, "scripts", "perf_gate.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# comparison logic
+# ---------------------------------------------------------------------------
+
+def test_compare_passes_within_band():
+    pg = _load_perf_gate()
+    base = {"win.a.win_put.mbps": 100.0, "opt.x.img_per_sec": 50.0}
+    run = {"win.a.win_put.mbps": 80.0, "opt.x.img_per_sec": 49.0}
+    failures, lines = pg.compare(run, base, band=0.40)
+    assert failures == []
+    assert any("ok" in line for line in lines)
+
+
+def test_compare_reds_on_regression_and_missing():
+    pg = _load_perf_gate()
+    base = {"win.a.win_put.mbps": 100.0, "opt.x.img_per_sec": 50.0,
+            "win.gone.win_get.mbps": 10.0}
+    run = {"win.a.win_put.mbps": 55.0,   # -45% < -40% band
+           "opt.x.img_per_sec": 60.0}    # improvement: fine
+    failures, lines = pg.compare(run, base, band=0.40)
+    assert set(failures) == {"win.a.win_put.mbps", "win.gone.win_get.mbps"}
+    assert any("REGRESSION" in line for line in lines)
+    assert any("MISSING" in line for line in lines)
+
+
+def test_compare_improvements_and_new_metrics_never_fail():
+    pg = _load_perf_gate()
+    base = {"opt.x.img_per_sec": 50.0}
+    run = {"opt.x.img_per_sec": 500.0, "win.new.win_put.mbps": 1.0}
+    failures, lines = pg.compare(run, base, band=0.40)
+    assert failures == []
+    assert any("info" in line for line in lines)
+
+
+def test_gating_filter_keeps_stable_series_only():
+    pg = _load_perf_gate()
+    metrics = {
+        "win.f32.win_put.mbps": 1.0,
+        "win.f32.win_update.mbps": 1.0,
+        "win.f32.raw_put_bytes.mbps": 1.0,   # noisy: out
+        "win.f32.drain_fold.mbps": 1.0,      # noisy: out
+        "opt.win_put.img_per_sec": 1.0,
+    }
+    kept = pg.gating(metrics)
+    assert set(kept) == {"win.f32.win_put.mbps", "win.f32.win_update.mbps",
+                         "opt.win_put.img_per_sec"}
+
+
+# ---------------------------------------------------------------------------
+# the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_committed_baseline_is_sound():
+    pg = _load_perf_gate()
+    with open(os.path.join(_REPO, "PERF_BASELINE.json")) as f:
+        doc = json.load(f)
+    assert doc["meta"]["kind"] == "perf_gate"
+    metrics = doc["metrics"]
+    assert metrics, "empty baseline"
+    # every baseline metric is a positive gating metric (no noisy series
+    # baked in, nothing the gate would ignore)
+    assert all(v > 0 for v in metrics.values())
+    assert set(pg.gating(metrics)) == set(metrics)
+    # the exact series make perf-gate red on a seeded slowdown
+    assert any(k.startswith("opt.") for k in metrics)
+    assert any(".win_put.mbps" in k for k in metrics)
+    assert any(".win_update.mbps" in k for k in metrics)
+
+
+# ---------------------------------------------------------------------------
+# seeded-slowdown knob (the red path's mechanism)
+# ---------------------------------------------------------------------------
+
+def test_delay_knob_bites_optimizer_step(monkeypatch):
+    from bluefog_tpu import optimizers
+
+    monkeypatch.setenv("BLUEFOG_PERF_GATE_DELAY_MS", "30")
+    t0 = time.perf_counter()
+    optimizers._perf_gate_delay()
+    assert time.perf_counter() - t0 >= 0.025
+    monkeypatch.delenv("BLUEFOG_PERF_GATE_DELAY_MS")
+    t0 = time.perf_counter()
+    optimizers._perf_gate_delay()
+    assert time.perf_counter() - t0 < 0.02  # off: no sleep
+
+
+def test_delay_knob_bites_window_op_timer(monkeypatch):
+    from bluefog_tpu.ops import windows
+
+    monkeypatch.setenv("BLUEFOG_PERF_GATE_DELAY_MS", "30")
+    t0 = time.perf_counter()
+    with windows._op_timer("WIN_PUT"):
+        pass
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_update_baseline_refuses_seeded_slowdown(monkeypatch, tmp_path):
+    pg = _load_perf_gate()
+    monkeypatch.setenv("BLUEFOG_PERF_GATE_DELAY_MS", "30")
+    rc = pg.main(["--update-baseline",
+                  "--baseline", str(tmp_path / "b.json")])
+    assert rc == 2
+    assert not (tmp_path / "b.json").exists()
+
+
+def test_bench_doc_shape():
+    pg = _load_perf_gate()
+    doc = pg.bench_doc({"m": 1.0}, repeats=3, band=0.4)
+    assert doc["meta"]["kind"] == "perf_gate"
+    assert doc["metrics"] == {"m": 1.0}
+    json.dumps(doc)  # serializable

@@ -244,3 +244,90 @@ def test_phase_subspans_land_in_file(tmp_path):
         "plan cache missed on an identical dynamic step"
     names = {e["name"] for e in starts}
     assert "PACK" in names and "UNPACK" in names
+
+
+# ---------------------------------------------------------------------------
+# merge_timelines: clock-sync anchors, missing-anchor fallback (ISSUE r12)
+# ---------------------------------------------------------------------------
+
+def _merge_mod():
+    import importlib
+    import sys
+
+    scripts = os.path.join(os.path.dirname(__file__), "..", "scripts")
+    sys.path.insert(0, scripts)
+    try:
+        return importlib.import_module("merge_timelines")
+    finally:
+        sys.path.pop(0)
+
+
+def _trace(path, events):
+    with open(path, "w") as f:
+        json.dump(events, f)
+    return str(path)
+
+
+def _anchor(ts, wall_us, pid):
+    return {"name": "bf.clock_sync_us", "cat": "bf", "ph": "C", "ts": ts,
+            "pid": pid, "tid": 0, "args": {"value": wall_us}}
+
+
+def test_merge_missing_anchor_warns_and_falls_back(tmp_path, capsys):
+    mt = _merge_mod()
+    a = _trace(tmp_path / "a.json", [
+        _anchor(0.0, 1_000_000.0, 0),
+        {"name": "x", "cat": "t", "ph": "i", "s": "t", "ts": 50.0,
+         "pid": 0, "tid": 0}])
+    # rank 1's trace lost its anchor (old build / truncated file)
+    b = _trace(tmp_path / "b.json", [
+        {"name": "y", "cat": "t", "ph": "i", "s": "t", "ts": 10.0,
+         "pid": 1, "tid": 0}])
+    merged = mt.merge([a, b])
+    err = capsys.readouterr().err
+    assert "clock-sync anchor" in err and "UNSHIFTED" in err
+    # anchored file rebases to its own offset (sole anchor -> shift 0);
+    # the anchorless file keeps raw timestamps instead of crashing
+    ys = [e for e in merged if e.get("name") == "y"]
+    assert ys and ys[0]["ts"] == 10.0
+    xs = [e for e in merged if e.get("name") == "x"]
+    assert xs and xs[0]["ts"] == 50.0
+    # process metadata still emitted for both pids
+    assert {e["pid"] for e in merged if e.get("ph") == "M"} == {0, 1}
+
+
+def test_merge_all_anchorless_is_identity(tmp_path, capsys):
+    mt = _merge_mod()
+    a = _trace(tmp_path / "a.json", [
+        {"name": "x", "cat": "t", "ph": "i", "s": "t", "ts": 5.0,
+         "pid": 0, "tid": 0}])
+    b = _trace(tmp_path / "b.json", [
+        {"name": "y", "cat": "t", "ph": "i", "s": "t", "ts": 7.0,
+         "pid": 1, "tid": 0}])
+    merged = mt.merge([a, b])
+    assert capsys.readouterr().err.count("UNSHIFTED") == 2
+    assert [e["ts"] for e in merged if "ts" in e][:2] == [5.0, 7.0]
+
+
+def test_merge_large_skew_still_aligns(tmp_path):
+    """Two ranks whose perf_counter origins differ by ~an hour (3.6e9 us)
+    must land on one axis: the anchors carry the skew, the merge removes
+    it. The drain event (wall 1000s + 100us) must sort AFTER the deposit
+    (wall 1000s + 50us) even though its raw trace ts is far smaller."""
+    mt = _merge_mod()
+    wall = 1_000_000_000.0  # shared wall clock at trace start, us
+    a = _trace(tmp_path / "a.json", [
+        _anchor(3_600_000_000.0, wall, 0),  # origin 1h before its anchor
+        {"name": "deposit", "cat": "t", "ph": "i", "s": "t",
+         "ts": 3_600_000_050.0, "pid": 0, "tid": 0}])
+    b = _trace(tmp_path / "b.json", [
+        _anchor(0.0, wall, 1),
+        {"name": "drain", "cat": "t", "ph": "i", "s": "t", "ts": 100.0,
+         "pid": 1, "tid": 0}])
+    merged = mt.merge([a, b])
+    dep = next(e for e in merged if e.get("name") == "deposit")
+    dra = next(e for e in merged if e.get("name") == "drain")
+    # on the common axis the pair is 50us apart, drain after deposit —
+    # the raw traces had them 3.6e9us apart in the WRONG order
+    assert dra["ts"] - dep["ts"] == 50.0
+    assert merged.index(dep) < merged.index(dra)

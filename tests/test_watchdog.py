@@ -115,6 +115,39 @@ def test_warned_set_pruned_after_completion():
     handles.synchronize(h)
 
 
+def test_stall_triggers_flight_dump(tmp_path, monkeypatch):
+    """ISSUE r12: a watchdog-detected stall leaves a flight-recorder dump
+    behind — the wedge may never raise a Python exception to dump on, so
+    the watchdog is the trigger of last resort."""
+    import json
+
+    from bluefog_tpu.runtime import flight as flight_mod
+
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("BLUEFOG_FLIGHT_MIN_INTERVAL", "0")
+    flight_mod.reset_for_job()
+    h = handles.allocate("op.wedged", _NeverReady())
+    wd = StallWatchdog(warning_sec=0.05, cycle_ms=1.0)
+    try:
+        wd.start()
+        dump_path = tmp_path / "bf_flight_0.json"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not dump_path.exists():
+            time.sleep(0.1)
+        assert dump_path.exists(), "stall produced no flight dump"
+        doc = json.loads(dump_path.read_text())
+        assert doc["meta"]["reason"] == "watchdog-stall"
+        names = doc["names"]
+        instants = [names[n] for k, n in zip(doc["events"]["kind"],
+                                             doc["events"]["name"])
+                    if k == flight_mod.INSTANT]
+        assert "fatal.watchdog.stall" in instants
+    finally:
+        wd.stop()
+        flight_mod.reset_for_job()
+    handles.synchronize(h)
+
+
 def test_poll_and_synchronize_contract():
     h = handles.allocate("op.x", _Ready())
     assert handles.poll(h) is True
