@@ -32,7 +32,7 @@ zero per-step host work after warmup.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Dict, FrozenSet, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -153,6 +153,162 @@ def apply_plan(plan: CombinePlan, mesh: Mesh, axis: str, tree):
     # different backend) on every call.
     outs = fn(plan.weight_array(), tuple(leaves))
     return jax.tree_util.tree_unflatten(treedef, list(outs))
+
+
+# ---------------------------------------------------------------------------
+# Per-edge plane planner (hybrid gossip; ISSUE r13)
+# ---------------------------------------------------------------------------
+#
+# The hosted window plane made the plane choice per WINDOW; the planner makes
+# it per EDGE. An edge is *compiled-eligible* when it can ride one fused
+# shard_map/ppermute program this controller may dispatch unilaterally: both
+# endpoints live (no compiled program may name a dead rank), the topology
+# static (the window's edge set is frozen at creation), and the edge
+# mesh-local — src and dst hosted by the SAME controller process, because a
+# cross-controller collective dispatch would need the lockstep the hosted
+# plane exists to avoid. Everything else — cross-controller-boundary edges,
+# dead/suspect-adjacent edges, sub-floor windows — stays on the hosted
+# mailbox residual with its deposit/drain semantics intact.
+#
+# Planner inputs: the frozen edge set, the rank→controller ownership map,
+# the heartbeat dead set, the window's per-edge wire bytes (one full row per
+# deposit), and — when ingested — the measured per-edge byte/wire-cost
+# attribution that ``scripts/step_attribution.py --json`` emits (r12's
+# step-time attribution, now a machine interface with a stable
+# ``schema_version``). Partitions are cached keyed on
+# (edge set, dead set, membership epoch), so elastic rejoin and self-healing
+# re-plan exactly when r9's epoch fences bump and never re-derive per step.
+
+ATTRIBUTION_SCHEMA_VERSION = 1
+
+Edge = Tuple[int, int]
+
+
+def load_attribution(doc: dict) -> Dict[Edge, dict]:
+    """Per-edge cost hints from a ``step_attribution.py --json`` document.
+
+    Returns ``{(src, dst): {"bytes": ..., "wire_sec_est": ...}}`` summed
+    over ranks. Raises ValueError on a missing/unknown ``schema_version``
+    — the dump is a machine interface now, and silently consuming a future
+    incompatible layout would mis-plan every edge.
+    """
+    ver = doc.get("schema_version")
+    if ver != ATTRIBUTION_SCHEMA_VERSION:
+        raise ValueError(
+            f"step-attribution document has schema_version={ver!r}, "
+            f"expected {ATTRIBUTION_SCHEMA_VERSION} — regenerate it with "
+            "this tree's scripts/step_attribution.py --json")
+    hints: Dict[Edge, dict] = {}
+    for rep in doc.get("ranks", {}).values():
+        for label, e in rep.get("edges", {}).items():
+            try:
+                src, dst = (int(x) for x in label.split("->"))
+            except ValueError:
+                continue
+            h = hints.setdefault((src, dst),
+                                 {"bytes": 0.0, "wire_sec_est": 0.0})
+            h["bytes"] += float(e.get("bytes", 0.0))
+            h["wire_sec_est"] += float(e.get("wire_sec_est", 0.0))
+    return hints
+
+
+class PlanePartition(NamedTuple):
+    """One planning verdict: every frozen edge lands in exactly one plane."""
+
+    compiled: FrozenSet[Edge]
+    hosted: FrozenSet[Edge]
+    dead: FrozenSet[int]
+    epoch: int
+
+    @property
+    def key(self):
+        """Stable identity of the compiled sub-topology (jit-cache key for
+        the fused program: re-jit happens only when the partition itself
+        changes, never on weight changes)."""
+        return tuple(sorted(self.compiled))
+
+
+class PlanePlanner:
+    """Per-edge plane decisions for one hosted window.
+
+    ``policy`` mirrors ``BLUEFOG_WIN_PLANE``: only ``"auto"`` ever compiles
+    an edge; ``"hosted"`` pins everything to the mailbox plane (the r6/r7
+    wire, bit for bit) and ``"compiled"`` never reaches a planner at all
+    (the window itself is on the collective plane). ``hosted_override`` is
+    the test seam: edges forced onto the residual regardless of score.
+    """
+
+    def __init__(self, n: int, edges, owner_of: Dict[int, int],
+                 row_bytes: int, min_bytes: int = 0, policy: str = "auto",
+                 hosted_override=()) -> None:
+        self.n = n
+        self.edges: FrozenSet[Edge] = frozenset(
+            (int(s), int(d)) for s, d in edges)
+        self.owner_of = dict(owner_of)
+        self.row_bytes = int(row_bytes)
+        self.min_bytes = int(min_bytes)
+        self.policy = policy
+        self.hosted_override = frozenset(hosted_override)
+        self.hints: Optional[Dict[Edge, dict]] = None
+        self.rebuilds = 0  # cache misses — asserted by the re-plan tests
+        self._cache: Dict[Tuple, PlanePartition] = {}
+
+    def ingest_attribution(self, doc: dict) -> int:
+        """Feed a real ``step_attribution.py --json`` dump; its measured
+        per-edge bytes replace the static row-size estimate in
+        :meth:`edge_cost`. Returns the number of edges with hints and
+        drops the partition cache (new inputs → new plans)."""
+        self.hints = load_attribution(doc)
+        self._cache.clear()
+        return len(self.hints)
+
+    def edge_cost(self, edge: Edge) -> float:
+        """Wire bytes one gossip step moves over this edge if it stays
+        hosted: the measured per-step attribution bytes when ingested,
+        else the window row size (every deposit ships one full row)."""
+        if self.hints is not None and edge in self.hints:
+            return float(self.hints[edge]["bytes"])
+        return float(self.row_bytes)
+
+    def _eligible(self, edge: Edge, dead: FrozenSet[int]) -> bool:
+        src, dst = edge
+        if src in dead or dst in dead:
+            return False  # dead/suspect-adjacent → hosted residual
+        if edge in self.hosted_override:
+            return False
+        owner_s = self.owner_of.get(src)
+        owner_d = self.owner_of.get(dst)
+        if owner_s is None or owner_s != owner_d:
+            return False  # cross-controller boundary → hosted residual
+        if self.edge_cost(edge) < self.min_bytes:
+            return False  # below the floor, hosted latency beats a re-jit
+        return True
+
+    def partition(self, dead=frozenset(), epoch: int = 0) -> PlanePartition:
+        """The cached per-edge plane split for (dead set, membership epoch).
+
+        The epoch rides the key even though the verdict depends only on
+        the dead set: an epoch bump (join/leave/re-admission, r9 fences)
+        is the externally visible "membership changed" signal, and keying
+        on it guarantees a re-plan exactly then — the property the
+        epoch-bump invalidation test pins."""
+        dead = frozenset(dead)
+        key = (dead, int(epoch))
+        part = self._cache.get(key)
+        if part is not None:
+            return part
+        self.rebuilds += 1
+        if self.policy != "auto":
+            compiled: FrozenSet[Edge] = frozenset()
+        else:
+            compiled = frozenset(
+                e for e in self.edges if self._eligible(e, dead))
+        part = PlanePartition(compiled, self.edges - compiled, dead,
+                              int(epoch))
+        if len(self._cache) > 32:  # dead sets churn at most with membership
+            self._cache.clear()
+        self._cache[key] = part
+        return part
 
 
 def rank_sharding(mesh: Mesh, axis: str = "rank") -> NamedSharding:

@@ -55,7 +55,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import topology as topology_util
 from ..runtime import control_plane as _cp
@@ -450,22 +450,71 @@ class _GraphLayout:
                     self.has_edge[si, dst] = True
 
 
-def _hosted_mode_enabled() -> bool:
+_legacy_plane_warned = False
+
+
+def _plane_policy() -> Tuple[str, Optional[bool]]:
+    """Resolve the window-plane policy: ``(policy, hosted_forced)``.
+
+    ``policy`` is ``BLUEFOG_WIN_PLANE`` — ``auto`` (per-edge planner over a
+    hosted window), ``compiled`` (collective plane forced), or ``hosted``
+    (mailbox plane forced, planner off: the r6/r7 wire bit for bit).
+    ``hosted_forced`` overrides the window-plane default (hosted iff
+    multi-controller): True/False force it, None keeps the default.
+
+    The legacy ``BLUEFOG_WIN_HOST_PLANE`` knob is an alias: ``1`` maps to
+    ``hosted`` and ``0`` to ``compiled`` (with a one-time deprecation
+    warning), so every existing config keeps its exact pre-planner
+    behavior. When BOTH knobs are set, the new knob's policy wins while
+    the legacy knob still forces window hostedness — that combination
+    (``BLUEFOG_WIN_PLANE=auto BLUEFOG_WIN_HOST_PLANE=1``) is how a
+    single-controller harness gets a hosted window WITH the planner, the
+    shape the hybrid bench and equivalence tests run (docs/window_planes.md).
+    """
+    global _legacy_plane_warned
+    raw = knob_env("BLUEFOG_WIN_PLANE")
+    legacy = knob_env("BLUEFOG_WIN_HOST_PLANE")  # True / False / None
+    if raw:
+        raw = str(raw).lower()
+        if raw not in ("auto", "compiled", "hosted"):
+            logger.warning(
+                "BLUEFOG_WIN_PLANE=%r is not auto|compiled|hosted; "
+                "treating it as auto", raw)
+            raw = "auto"
+        if raw == "hosted":
+            return "hosted", True
+        if raw == "compiled":
+            return "compiled", False
+        return "auto", legacy
+    if legacy is not None:
+        if not _legacy_plane_warned:
+            _legacy_plane_warned = True
+            logger.warning(
+                "BLUEFOG_WIN_HOST_PLANE is deprecated: use "
+                "BLUEFOG_WIN_PLANE=%s instead (see MIGRATION.md; the "
+                "legacy knob keeps its exact pre-r13 behavior — it also "
+                "pins the per-edge plane planner OFF)",
+                "hosted" if legacy else "compiled")
+        return ("hosted" if legacy else "compiled"), legacy
+    return "auto", None
+
+
+def _hosted_mode_enabled(policy: Optional[Tuple[str, Optional[bool]]] = None
+                         ) -> bool:
     """Whether new windows use the hosted (host-tensor-transport) data plane.
 
     Default policy: ON for multi-controller jobs with a control plane (the
     deployments where the collective plane's all-controllers-must-dispatch
     contract breaks asynchrony), OFF for single-controller (the compiled
     ppermute plane is strictly faster on-device and the controller owns all
-    ranks anyway). ``BLUEFOG_WIN_HOST_PLANE=1/0`` forces either way.
+    ranks anyway). ``BLUEFOG_WIN_PLANE`` / the legacy
+    ``BLUEFOG_WIN_HOST_PLANE`` force either way (:func:`_plane_policy`).
     """
     if not _cp.active():
         return False
-    env = os.environ.get("BLUEFOG_WIN_HOST_PLANE")
-    if env == "1":
-        return True
-    if env == "0":
-        return False
+    _, forced = policy if policy is not None else _plane_policy()
+    if forced is not None:
+        return forced
     return _cp.world() > 1
 
 
@@ -535,7 +584,9 @@ class Window:
         # so the put path stays write-only (see _exchange_fn). The hosted
         # plane's host-side rows don't need it.
         mail_shape = (st.size, d + 1) + self.row_shape
-        self.hosted = _hosted_mode_enabled()
+        policy = getattr(st, "win_plane", None) or _plane_policy()
+        self.plane = policy[0]
+        self.hosted = _hosted_mode_enabled(policy)
         # Scalar protocols (versions / push-sum p / mutexes): controller-local
         # host memory, or the job-wide control plane when one is attached
         # (multi-controller; reference mpi_controller.cc:1281-1393, 1532-1602).
@@ -549,6 +600,25 @@ class Window:
             owned = list(range(st.size))
             self.host = _LocalWinHost(name, st.size, self.layout.d_max)
         self.owned = sorted(owned)
+        # Per-edge plane planner (hosted windows under the auto policy
+        # only): decides which frozen edges ride the compiled fast path
+        # and which stay on the mailbox residual (ops/plan.py).
+        self._planner = None
+        self._local_mesh = None
+        self._hybrid_cache: Dict[Tuple, object] = {}
+        if self.hosted and self.plane == "auto":
+            from .plan import PlanePlanner
+
+            min_mb = knob_env("BLUEFOG_WIN_PLAN_MIN_MB") or 0.0
+            self._planner = PlanePlanner(
+                st.size,
+                [(src, dst) for dst, srcs in self.in_neighbors.items()
+                 for src in srcs],
+                {r: getattr(st.devices[r], "process_index", 0)
+                 for r in range(st.size)},
+                row_bytes=int(np.prod(self.row_shape, dtype=np.int64))
+                * self.dtype.itemsize,
+                min_bytes=int(float(min_mb) * (1 << 20)))
 
         if self.hosted:
             # defensive: discard any deposit records a crashed predecessor
@@ -1059,6 +1129,29 @@ class Window:
                 self.dtype, copy=False).copy()
             self._publish_selves([rank])
 
+    # -- per-edge plane planner (hybrid gossip; ISSUE r13) -----------------
+
+    def plane_partition(self, dead=frozenset(), epoch=None):
+        """The planner's per-edge plane split for the current membership,
+        or None when no planner is active (collective plane, forced-hosted
+        plane, or a pre-``auto`` legacy config). Cached keyed on
+        (edge set, dead set, membership epoch) inside the planner, so a
+        gossip step pays a dict lookup, and r9's epoch fences are exactly
+        the re-plan trigger."""
+        if self._planner is None:
+            return None
+        if epoch is None:
+            from ..runtime.heartbeat import membership_epoch
+
+            epoch = membership_epoch()
+        before = self._planner.rebuilds
+        part = self._planner.partition(frozenset(dead), epoch)
+        if self._planner.rebuilds != before:
+            _metrics.counter("win.plan_rebuilds").inc()
+            _metrics.gauge("win.compiled_edges").set(len(part.compiled))
+            _metrics.gauge("win.hosted_edges").set(len(part.hosted))
+        return part
+
     # -- compiled programs -------------------------------------------------
 
     def _exchange_fn(self, accumulate: bool, donate_source: bool = False,
@@ -1192,6 +1285,229 @@ class Window:
         fn = jax.jit(mapped, donate_argnums=(1,))
         self._update_cache[key] = fn
         return fn
+
+
+# ---------------------------------------------------------------------------
+# Hybrid gossip: the compiled partition's fused program (ISSUE r13)
+# ---------------------------------------------------------------------------
+#
+# One gossip step over a hybrid window splits its frozen edge set by the
+# planner's verdict (Window.plane_partition): the COMPILED partition runs as
+# ONE fused shard_map/ppermute program below — the in-neighbor exchange idiom
+# of ops/neighbors.py:_gather_exchange_fn, with the mailbox-slot blend and
+# weighted combine of _exchange_fn/_update_fn inlined behind it — while the
+# HOSTED residual keeps the mailbox deposit/drain semantics via
+# _residual_update. The fused program replicates the collective plane's op
+# sequence exactly (same per-shift contributions cast through the mail
+# dtype, same slot-ordered tensordot combine, same self term), so an
+# all-compiled partition is bit-exact against the pure collective plane —
+# the equivalence tests/test_win_planes.py pins.
+#
+# The program runs on the controller's LOCAL mesh (its owned devices): a
+# compiled edge is mesh-local by planner construction, so dispatch is
+# unilateral — no cross-controller lockstep, the asynchrony the hosted plane
+# exists for survives. Static inputs (perms, slots) come from the partition;
+# weights stay traced, so healed re-weights never re-jit — only a partition
+# change does (the BLUEFOG_WIN_PLAN_MIN_MB floor exists because that re-jit
+# is the cost hosted latency is traded against).
+
+
+def _hybrid_meta(win: Window, part) -> dict:
+    """Static tables for one partition's fused program: the local mesh,
+    global→local index map, per-shift local permutation lists (naming ONLY
+    live compiled edges — no compiled program may name a dead rank), and
+    the local slot table."""
+    key = ("meta", part.key)
+    meta = win._hybrid_cache.get(key)
+    if meta is not None:
+        return meta
+    st = _global_state()
+    owned = win.owned
+    k = len(owned)
+    li = {r: i for i, r in enumerate(owned)}
+    lay = win.layout
+    by_shift: Dict[int, list] = {}
+    for (src, dst) in sorted(part.compiled):
+        by_shift.setdefault((dst - src) % lay.n, []).append(
+            (li[src], li[dst]))
+    shifts = tuple(sorted(by_shift))
+    S = max(len(shifts), 1)
+    slot = np.zeros((S, k), np.int32)
+    perms = []
+    for si, s in enumerate(shifts):
+        perms.append(tuple(sorted(by_shift[s])))
+        for (ls, ld) in by_shift[s]:
+            slot[si, ld] = lay.slot_of[owned[ld]][owned[ls]]
+    if k == st.size:
+        mesh = st.mesh
+    else:
+        if win._local_mesh is None:
+            win._local_mesh = Mesh(
+                np.array([st.devices[r] for r in owned]), ("rank",))
+        mesh = win._local_mesh
+    meta = {"mesh": mesh, "li": li, "shifts": shifts,
+            "perms": tuple(perms), "slot": slot, "k": k}
+    if len(win._hybrid_cache) > 64:
+        win._hybrid_cache.clear()
+    win._hybrid_cache[key] = meta
+    return meta
+
+
+def _hybrid_fn(win: Window, meta: dict, accumulate: bool):
+    """The fused compiled-partition program, cached per (mode, perms).
+
+    Body = _exchange_fn's per-shift mailbox blend over a FRESH zero mailbox
+    + _update_fn's slot-ordered weighted combine, chained in one jit. The
+    intermediate mail values round-trip through the mail dtype exactly as
+    the two-program collective pair materializes them, which is what makes
+    the all-compiled case bit-exact against that plane.
+    """
+    key = ("fn", accumulate, meta["perms"], meta["k"])
+    fn = win._hybrid_cache.get(key)
+    if fn is not None:
+        return fn
+    d_max = win.layout.d_max
+    mail_dtype = win.mail_dtype
+    slot_c = np.asarray(meta["slot"])
+    perms = meta["perms"]
+
+    def per_rank(x, w, active, sw_put, sw_upd, nw):
+        me = lax.axis_index("rank")
+        xb = x[0]
+        acc_t = _win_acc_dtype(xb.dtype)
+        mb = jnp.zeros((d_max + 1,) + xb.shape, mail_dtype)
+        for si in range(len(perms)):
+            moved = lax.ppermute(xb, "rank", list(perms[si]))
+            ak = active[si, me]
+            wk = (w[si, me] * ak).astype(acc_t)
+            # inactive (no compiled edge on this shift for me): redirect the
+            # zero payload to the scratch row so real slots are write-only,
+            # the same discipline as _exchange_fn
+            kk = jnp.where(ak > 0, jnp.asarray(slot_c)[si, me], d_max)
+            contrib = moved.astype(acc_t) * wk
+            if accumulate:
+                cur = lax.dynamic_index_in_dim(mb, kk, axis=0,
+                                               keepdims=False)
+                val = (cur.astype(acc_t) + contrib).astype(mb.dtype)
+            else:
+                val = contrib.astype(mb.dtype)
+            mb = lax.dynamic_update_index_in_dim(mb, val, kk, axis=0)
+        new_self = (xb.astype(acc_t)
+                    * sw_put[me].astype(acc_t)).astype(xb.dtype)
+        w_me = jnp.concatenate(
+            [nw[me], jnp.zeros((1,), nw.dtype)]).astype(acc_t)
+        combined = sw_upd[me].astype(acc_t) * new_self.astype(acc_t) + \
+            jnp.tensordot(w_me, mb.astype(acc_t), axes=(0, 0))
+        return combined.astype(xb.dtype)[None]
+
+    mapped = shard_map(
+        per_rank,
+        mesh=meta["mesh"],
+        in_specs=(P("rank"), P(), P(), P(), P(), P()),
+        out_specs=P("rank"),
+    )
+    fn = jax.jit(mapped)
+    win._hybrid_cache[key] = fn
+    return fn
+
+
+def _local_view(win: Window, meta: dict, x):
+    """The rank-stacked buffer's owned rows as a local-mesh array (the
+    identity when this controller owns the whole mesh)."""
+    if meta["k"] == win.size:
+        return x
+    shards = {s.index[0].start or 0: s.data for s in x.addressable_shards}
+    sh = NamedSharding(meta["mesh"], P("rank"))
+    return jax.make_array_from_single_device_arrays(
+        (meta["k"],) + tuple(x.shape[1:]), sh,
+        [shards[r] for r in win.owned])
+
+
+def _globalize(win: Window, meta: dict, local):
+    """Local-mesh combined rows back to the global rank-stacked array
+    (metadata-only: each controller contributes its addressable shards)."""
+    st = _global_state()
+    if meta["k"] == st.size:
+        return local
+    sh = NamedSharding(st.mesh, P("rank"))
+    shards = sorted(((s.index[0].start or 0, s.data)
+                     for s in local.addressable_shards), key=lambda p: p[0])
+    # local row i is global rank owned[i]; reorder by global rank
+    per_rank = [d for _, d in shards]
+    return jax.make_array_from_single_device_arrays(
+        (st.size,) + tuple(local.shape[1:]), sh, per_rank)
+
+
+def _run_compiled_partition(win: Window, x, part, put_table, sw_put,
+                            sw_upd, nw_table, accumulate: bool = False):
+    """Run the compiled partition's fused program over the rank-stacked
+    buffer ``x``. Weight inputs are global-rank keyed (the same tables the
+    hosted ops take); only compiled edges contribute. Returns the combined
+    per-owned-rank rows as a local-mesh device array (``_globalize`` lifts
+    it back)."""
+    meta = _hybrid_meta(win, part)
+    li, k = meta["li"], meta["k"]
+    lay = win.layout
+    S = max(len(meta["perms"]), 1)
+    w = np.zeros((S, k), np.float32)
+    active = np.zeros((S, k), np.float32)
+    shift_index = {s: i for i, s in enumerate(meta["shifts"])}
+    nw_arr = np.zeros((k, lay.d_max), np.float32)
+    for (src, dst) in part.compiled:
+        wt = put_table.get(src, {}).get(dst)
+        uw = nw_table.get(dst, {}).get(src)
+        if wt is None or uw is None:
+            continue  # edge dropped by the (healed) weight tables
+        si = shift_index[(dst - src) % lay.n]
+        w[si, li[dst]] = wt
+        active[si, li[dst]] = 1.0
+        nw_arr[li[dst], lay.slot_of[dst][src]] = uw
+    sw_put_arr = np.asarray([sw_put[r] for r in win.owned], np.float32)
+    sw_upd_arr = np.asarray([sw_upd[r] for r in win.owned], np.float32)
+    fn = _hybrid_fn(win, meta, accumulate)
+    fl = _flight.recorder()
+    with timeline_context(win.name, "WIN_COMPILED"), \
+            fl.span("win.compiled"):
+        out = fn(_local_view(win, meta, x), w, active, sw_put_arr,
+                 sw_upd_arr, nw_arr)
+    return out, meta
+
+
+def _combine_with_residual(win: Window, meta: dict, comp, rows):
+    """comp (local-mesh device rows) + the hosted residual's folded rows
+    (numpy per owned rank, or None when the residual contributed nothing).
+    Adding exactly 0.0 would still be bit-transparent, but skipping the add
+    keeps the all-compiled fast path a single program."""
+    if rows is None:
+        return comp
+    stacked = np.stack([np.asarray(rows[r]) for r in win.owned])
+    dev = jax.device_put(stacked.astype(np.dtype(comp.dtype), copy=False),
+                         NamedSharding(meta["mesh"], P("rank")))
+    return comp + dev
+
+
+def _residual_update(win: Window, nw_table, reset: bool = False,
+                     require_mutex: bool = True):
+    """The hosted residual's combine leg: drain + fold pending deposits,
+    then contract ONLY the residual in-edges' mailbox slots (no self term
+    — the compiled program owns it). Returns ``(rows, p_sums)``: the
+    per-owned-rank weighted residual contribution (numpy) and, when
+    associated-p is on, the matching p-mailbox contraction. Window rows
+    stay untouched (clone semantics) — the put leg's publish is the
+    step's visible state."""
+    st = _global_state()
+    n = st.size
+    lay = win.layout
+    nw = np.zeros((n, lay.d_max), np.float32)
+    read_mask = np.zeros((n, lay.d_max), np.float32)
+    for r, wmap in nw_table.items():
+        for src, wt in wmap.items():
+            kslot = lay.slot_of[r][src]
+            nw[r, kslot] = wt
+            read_mask[r, kslot] = 1.0
+    return _hosted_update(win, [0.0] * n, nw_table, nw, read_mask,
+                          reset=reset, clone=True,
+                          require_mutex=require_mutex, return_rows=True)
 
 
 # Deposit record (hosted plane wire format):
@@ -2067,13 +2383,20 @@ def win_update(
 
 
 def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
-                   reset: bool, clone: bool, require_mutex: bool):
+                   reset: bool, clone: bool, require_mutex: bool,
+                   return_rows: bool = False):
     """Owner-local combine for the hosted plane.
 
     Drains this controller's pending server deposits, folds them, then runs
     the weighted combine for OWNED ranks only — other controllers' ranks are
     their own business (that is what makes a sleeping peer harmless). The
     result is the rank-stacked global array assembled from owned shards.
+
+    ``return_rows`` (the hybrid residual leg): skip the global assembly and
+    return ``(rows, p_sums)`` — the per-owned-rank combined numpy rows and,
+    when associated-p is on, the per-rank p-mailbox contraction
+    ``sum(nw[r] * p_mail[r])`` (None otherwise). Used with ``clone=True``
+    so the stored window rows and p scalars stay untouched.
     """
     st = _global_state()
     acc_t = np.dtype(_win_acc_dtype(win.mail_dtype))
@@ -2137,7 +2460,15 @@ def _hosted_update(win: Window, sw_list, nw_table, nw, read_mask,
                 # readers still see the new value strictly after this
                 # update
                 pub = _Prefetch(lambda: win._publish_selves(win.owned))
-            out = _assemble_global(win, results)
+            if return_rows:
+                p_sums = None
+                if use_p:
+                    p_sums = {r: float(np.sum(nw[r].astype(np.float64)
+                                              * p_mail[r]))
+                              for r in win.owned}
+                out = (results, p_sums)
+            else:
+                out = _assemble_global(win, results)
             if pub is not None:
                 pub.result()
         finally:

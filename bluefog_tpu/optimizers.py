@@ -620,6 +620,19 @@ class _WindowOptimizer(_FusedOptimizer):
         # rebuild and the donor-side rejoin-request scan.
         self._healed_cache: Dict[frozenset, tuple] = {}
         self._serve_epoch: Optional[int] = None
+        # Hybrid per-edge gossip plane (ISSUE r13): the planner's compiled
+        # partition runs as one fused local-mesh program; the hosted
+        # residual keeps mailbox semantics. BLUEFOG_WIN_OVERLAP=1
+        # double-buffers the residual: its deposit/drain for step t runs on
+        # a worker thread and folds into step t+1 (one-step-stale neighbor
+        # contributions — the asynchrony window algorithms tolerate by
+        # design; docs/window_planes.md).
+        self._overlap_on = bool(knob_env("BLUEFOG_WIN_OVERLAP"))
+        self._overlap_pending = None
+        self._cur_epoch = 0
+        self._rows_epoch: Optional[int] = None
+        self._rows_sync_count = 0
+        self._last_row_value = None
 
     def init(self, params, model_state=None) -> TrainState:
         state = super().init(params, model_state)
@@ -662,10 +675,76 @@ class _WindowOptimizer(_FusedOptimizer):
         return state
 
     def free(self) -> None:
+        if self._overlap_pending is not None:
+            # drain the in-flight residual leg: win_free under it would
+            # race the drain against the mailbox clear
+            try:
+                self._overlap_pending.result()
+            except Exception:  # noqa: BLE001 — teardown must not raise
+                pass
+            self._overlap_pending = None
         for nm in self._win_names:
             _windows.win_free(nm)
         self._win_names = []
         self._restore_flags()
+
+    # -- hybrid per-edge plane plumbing (ISSUE r13) ------------------------
+
+    def _hybrid_part(self, dead):
+        """``(window, partition)`` when this step takes the hybrid path:
+        one fused window on the hosted plane whose planner found at least
+        one compiled edge. None falls back to the pure hosted flow."""
+        if not self._fused_pack:
+            return None
+        win = _windows._get_window(self._win_names[0])
+        if not win.hosted or win._planner is None:
+            return None
+        self._cur_epoch = _hb.membership_epoch()
+        part = win.plane_partition(dead, epoch=self._cur_epoch)
+        if part is None or not part.compiled:
+            return None
+        return win, part
+
+    def _harvest_overlap(self):
+        """Collect the previous step's deferred hosted-residual leg (the
+        one-step-stale contributions). Cleared BEFORE the result is
+        examined, so a PeerLostError propagating out of here leaves no
+        wedged pending for the healed-topology retry to trip over."""
+        pend, self._overlap_pending = self._overlap_pending, None
+        if pend is None:
+            return None
+        return pend.result()
+
+    def _start_overlap(self, fn) -> None:
+        self._overlap_pending = _windows._Prefetch(fn)
+
+    def _flush_rows(self) -> None:
+        """Install + publish the window's host rows from the last hybrid
+        step's combined value. The all-compiled fast path has no hosted
+        put leg to publish rows every step, so donors' one-sided reads
+        (rejoin state transfer, win_get) see a bounded-stale copy
+        refreshed here on the sync cadence and on membership-epoch change
+        (a rejoin bumps the epoch before anyone reads)."""
+        if self._last_row_value is None or not self._win_names:
+            return
+        win = _windows._get_window(self._win_names[0])
+        rows = _windows._owned_rows(self._last_row_value, win.owned)
+        with win.state_mu:
+            for r in win.owned:
+                win._rows[r] = np.asarray(rows[r]).astype(
+                    win.dtype, copy=False).copy()
+            win._publish_selves(win.owned)
+
+    _ROWS_SYNC_EVERY = 16  # fast-path publish cadence (steps)
+
+    def _sync_rows_cadence(self, value) -> None:
+        self._last_row_value = value
+        self._rows_sync_count += 1
+        if self._cur_epoch == self._rows_epoch and \
+                self._rows_sync_count % self._ROWS_SYNC_EVERY:
+            return
+        self._rows_epoch = self._cur_epoch
+        self._flush_rows()
 
     def _restore_flags(self) -> None:
         pass  # push-sum restores the global associated-p toggle
@@ -1001,16 +1080,21 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
         # bump on join/leave/re-admission is what moves it), not re-derived
         # every step.
         dead = self._dead_ranks()
+        hyb = self._hybrid_part(dead)
         dst_weights, self_weight = self.dst_weights, self.self_weight
         neighbor_weights = self.neighbor_weights
-        if dead:
+        if dead or hyb is not None:
+            # the hybrid path needs the tables materialized even with an
+            # empty dead set (the fused program takes explicit weights);
+            # same cache, same per-dead-set rebuild discipline
             win = _windows._get_window(self._win_names[0])
             custom = (dst_weights is not None or self_weight is not None
                       or neighbor_weights is not None)
             key = ("put", frozenset(dead))
             cached = None if custom else self._healed_cache.get(key)
             if cached is None:
-                _metrics.counter("opt.healed_rebuilds").inc()
+                if dead:
+                    _metrics.counter("opt.healed_rebuilds").inc()
                 sw, nw = _healed_recv_weights(win, dead, self_weight,
                                               neighbor_weights)
                 cached = (_healed_send_table(win, dead, dst_weights), sw, nw)
@@ -1019,6 +1103,9 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
                         self._healed_cache.clear()
                     self._healed_cache[key] = cached
             dst_weights, self_weight, neighbor_weights = cached
+        if hyb is not None:
+            return self._gossip_hybrid(hyb, leaves[0], dst_weights,
+                                       self_weight, neighbor_weights)
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             # donate_source: the packed fusion buffer is dead after the
@@ -1032,6 +1119,57 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
                 neighbor_weights=neighbor_weights,
                 require_mutex=self.require_mutex))
         return out
+
+    def _gossip_hybrid(self, hyb, leaf, dst_weights, self_weight,
+                       neighbor_weights):
+        """One hybrid gossip step: compiled partition in one fused program
+        + hosted mailbox residual (deposit/drain semantics unchanged on
+        its edges). With overlap on, the residual leg of step t runs on a
+        worker thread and its contributions fold into step t+1."""
+        win, part = hyb
+        nm = self._win_names[0]
+        host_dst = {s: {d: w for d, w in m.items() if (s, d) in part.hosted}
+                    for s, m in dst_weights.items()}
+        host_nw = {r: {s: w for s, w in m.items() if (s, r) in part.hosted}
+                   for r, m in neighbor_weights.items()}
+        have_out = any(host_dst.values())
+        have_in = any(host_nw.values())
+        ones = {r: 1.0 for r in range(win.size)}
+
+        def hosted_leg():
+            rows = None
+            if have_out:
+                # deposits + row publish + post-send self scaling ride the
+                # unchanged hosted put
+                _windows.win_put(leaf, nm, dst_weights=host_dst,
+                                 require_mutex=self.require_mutex)
+            if have_in:
+                rows, _ = _windows._residual_update(
+                    win, host_nw, reset=False,
+                    require_mutex=self.require_mutex)
+            return rows
+
+        prev_rows = None
+        if self._overlap_on:
+            prev = self._harvest_overlap()
+            prev_rows = prev if prev is not None else None
+        comp, meta = _windows._run_compiled_partition(
+            win, leaf, part, dst_weights, ones, self_weight,
+            neighbor_weights, accumulate=False)
+        if self._overlap_on:
+            if have_out or have_in:
+                self._start_overlap(hosted_leg)
+            rows = prev_rows
+        else:
+            rows = hosted_leg() if (have_out or have_in) else None
+        mixed = _windows._globalize(
+            win, meta, _windows._combine_with_residual(win, meta, comp,
+                                                       rows))
+        if have_out:
+            self._last_row_value = mixed  # put leg already published
+        else:
+            self._sync_rows_cadence(mixed)
+        return [mixed]
 
 
 class DistributedPullGetOptimizer(_WindowOptimizer):
@@ -1052,16 +1190,18 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
     def _gossip(self, leaves):
         st = _global_state()
         dead = self._dead_ranks()
+        hyb = self._hybrid_part(dead)
         src_weights, self_weight = self.src_weights, self.self_weight
         neighbor_weights = self.neighbor_weights
-        if dead:
+        if dead or hyb is not None:
             win = _windows._get_window(self._win_names[0])
             custom = (src_weights is not None or self_weight is not None
                       or neighbor_weights is not None)
             key = ("get", frozenset(dead))
             cached = None if custom else self._healed_cache.get(key)
             if cached is None:
-                _metrics.counter("opt.healed_rebuilds").inc()
+                if dead:
+                    _metrics.counter("opt.healed_rebuilds").inc()
                 # pull only from LIVE sources (a dead peer's published
                 # tensor goes stale, and at re-publish races it could tear
                 # mass) and renormalize the combine over the live in-sets
@@ -1084,6 +1224,9 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
                         self._healed_cache.clear()
                     self._healed_cache[key] = cached
             src_weights, self_weight, neighbor_weights = cached
+        if hyb is not None:
+            return self._gossip_hybrid(hyb, leaves[0], src_weights,
+                                       self_weight, neighbor_weights)
         out = []
         for nm, leaf in zip(self._win_names, leaves):
             st.windows[nm].self_value = jnp.asarray(leaf)  # publish
@@ -1094,6 +1237,65 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
                 neighbor_weights=neighbor_weights,
                 require_mutex=self.require_mutex))
         return out
+
+    def _gossip_hybrid(self, hyb, leaf, src_weights, self_weight,
+                       neighbor_weights):
+        """Pull-style hybrid: compiled in-edges move w*x_src in-program
+        (the pull of a mesh-local source IS a ppermute); hosted residual
+        sources keep publish → win_get → combine. The edge weight
+        structure mirrors the put path with src_weights in the
+        dst-weight position (a pull from s with weight w is the wire
+        edge s→r carrying w*x_s, exactly _hosted_exchange's from_get
+        table transposition)."""
+        win, part = hyb
+        nm = self._win_names[0]
+        # src_weights is dst-keyed {r: {s: w}}; the fused program (and the
+        # precheck split) want the src->dst orientation
+        host_src = {r: {s: w for s, w in m.items() if (s, r) in part.hosted}
+                    for r, m in src_weights.items()}
+        pull_table = {s: {} for s in range(win.size)}
+        for r, m in src_weights.items():
+            for s, w in m.items():
+                pull_table[s][r] = w
+        host_nw = {r: {s: w for s, w in m.items() if (s, r) in part.hosted}
+                   for r, m in neighbor_weights.items()}
+        have_host = any(host_src.values()) or any(host_nw.values())
+        ones = {r: 1.0 for r in range(win.size)}
+
+        def hosted_leg():
+            # publish first: hosted pulls (ours and remote peers') read the
+            # published rows / owned host rows
+            win.self_value = jnp.asarray(leaf)
+            if any(host_src.values()):
+                _windows.win_get(nm, src_weights=host_src,
+                                 require_mutex=self.require_mutex)
+            rows = None
+            if any(host_nw.values()):
+                rows, _ = _windows._residual_update(
+                    win, host_nw, reset=False,
+                    require_mutex=self.require_mutex)
+            return rows
+
+        prev_rows = None
+        if self._overlap_on:
+            prev_rows = self._harvest_overlap()
+        comp, meta = _windows._run_compiled_partition(
+            win, leaf, part, pull_table, ones, self_weight,
+            neighbor_weights, accumulate=False)
+        if self._overlap_on:
+            if have_host:
+                self._start_overlap(hosted_leg)
+            rows = prev_rows
+        else:
+            rows = hosted_leg() if have_host else None
+        mixed = _windows._globalize(
+            win, meta, _windows._combine_with_residual(win, meta, comp,
+                                                       rows))
+        if have_host and not self._overlap_on:
+            self._last_row_value = mixed  # publish already ran this step
+        else:
+            self._sync_rows_cadence(mixed)
+        return [mixed]
 
 
 class DistributedPushSumOptimizer(_WindowOptimizer):
@@ -1170,6 +1372,9 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
             self._healed_cache[key] = (sw, dw)
         else:
             sw, dw = cached
+        hyb = self._hybrid_part(dead)
+        if hyb is not None:
+            return self._gossip_hybrid(hyb, leaves[0], sw, dw)
         out = []
         mass = 0.0
         drift = 0.0
@@ -1199,6 +1404,76 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         _metrics.gauge("pushsum.mass").set(mass)
         _metrics.gauge("pushsum.debias_drift").set(drift)
         return out
+
+    def _gossip_hybrid(self, hyb, leaf, sw, dw):
+        """Hybrid push-sum: compiled edges move mass IN-PROGRAM (the fused
+        accumulate-mode program sums dw*numer contributions next to the
+        numer*sw self term), hosted edges via the mailbox. The p channel
+        splits the same way — p*sw self down-weight plus compiled
+        contributions computed host-side plus the residual collect's
+        p-mailbox contraction — so ``sum(p)`` over live ranks is exactly
+        the column-stochastic total either plane alone would conserve
+        (the partition-boundary conservation contract, ISSUE r13).
+
+        BLUEFOG_WIN_OVERLAP is deliberately IGNORED here: deferring the
+        residual would let a later step's p*sw rescale race the deposits'
+        p contributions, breaking exact conservation — push-sum keeps the
+        synchronous residual (docs/window_planes.md)."""
+        win, part = hyb
+        nm = self._win_names[0]
+        n = win.size
+        p_col = np.asarray(win.host.read_p())
+        numer = leaf * np.asarray(p_col, leaf.dtype).reshape(
+            (n,) + (1,) * (leaf.ndim - 1))
+        host_dw = {s: {d: w for d, w in m.items() if (s, d) in part.hosted}
+                   for s, m in dw.items()}
+        host_in = {r: {s: 1.0 for s in win.in_neighbors[r]
+                       if (s, r) in part.hosted and s not in part.dead}
+                   for r in range(n)}
+        ones = {r: 1.0 for r in range(n)}
+        collect_nw = {r: {s: 1.0 for s in win.in_neighbors[r]}
+                      for r in range(n)}
+        rows = p_sums = None
+        if any(host_dw.values()):
+            _windows.win_accumulate(numer, nm, self_weight=sw,
+                                    dst_weights=host_dw,
+                                    require_mutex=self.require_mutex)
+        else:
+            # the self down-weight normally rides the accumulate leg;
+            # without one, scale p directly (rows follow on the sync
+            # cadence — the numerator rows are re-derived below anyway)
+            win.host.write_p_entries(
+                {r: float(p_col[r] * sw[r]) for r in win.owned})
+        if any(host_in.values()):
+            rows, p_sums = _windows._residual_update(
+                win, host_in, reset=True, require_mutex=self.require_mutex)
+        comp, meta = _windows._run_compiled_partition(
+            win, numer, part, dw, sw, ones, collect_nw, accumulate=True)
+        collected = _windows._globalize(
+            win, meta, _windows._combine_with_residual(win, meta, comp,
+                                                       rows))
+        # p across the partition boundary: self down-weight + compiled
+        # in-contributions (host-side — p is a tiny scalar channel) +
+        # the residual collect's p-mailbox contraction
+        p_new = {}
+        for r in win.owned:
+            p_comp = sum(dw[s].get(r, 0.0) * float(p_col[s])
+                         for s in range(n) if (s, r) in part.compiled)
+            p_new[r] = float(p_col[r] * sw[r]) + p_comp + \
+                float((p_sums or {}).get(r, 0.0))
+        win.host.write_p_entries(p_new)
+        p_all = np.asarray(win.host.read_p())
+        owned = list(win.owned)
+        p_own = p_all[owned]
+        _metrics.gauge("pushsum.mass").set(float(np.sum(p_own)))
+        _metrics.gauge("pushsum.debias_drift").set(
+            float(np.max(np.abs(p_own - 1.0))) if owned else 0.0)
+        # window rows = the collected numerator (what a donor's mass split
+        # halves); cadence-published, and _serve_rejoin_requests flushes
+        # them before serving so rows/p stay a consistent pair
+        self._sync_rows_cadence(collected)
+        return [collected / np.asarray(p_all, collected.dtype).reshape(
+            (n,) + (1,) * (collected.ndim - 1))]
 
     # -- elastic rejoin with exact mass conservation -----------------------
     #
@@ -1261,6 +1536,11 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         if ep == self._serve_epoch:
             return
         self._serve_epoch = ep
+        # Hybrid fast path: host rows are cadence-stale between publishes.
+        # A mass split halves win._rows, so install the last collected
+        # numerator first — rows and p must be a consistent pair or the
+        # rejoiner's de-biased x would be torn (docs/window_planes.md).
+        self._flush_rows()
         cl = _cp.client()
         for nm in self._win_names:
             win = _windows._get_window(nm)

@@ -526,6 +526,9 @@ _PHASE_OF = {
     "win.publish": "wire",
     "win.drain": "drain",
     "win.fold": "fold",
+    # the hybrid plane's fused compiled-partition program (ISSUE r13):
+    # gossip time that moved OFF the wire/drain phases shows up here
+    "win.compiled": "compiled",
 }
 
 
@@ -587,7 +590,8 @@ def analyze_dump(doc: dict) -> Optional[dict]:
     triples = [(k, name, t) for k, name, t, _, _ in rows]
     spans = _spans_in(triples, set(_PHASE_OF) | {"opt.gossip"}, t0, t1)
     phases = {p: 0.0 for p in
-              ("local", "pack", "wire", "drain", "fold", "unpack")}
+              ("local", "pack", "wire", "drain", "fold", "unpack",
+               "compiled")}
     for name, ivs in spans.items():
         p = _PHASE_OF.get(name)
         if p:
@@ -647,9 +651,10 @@ def format_report(rep: dict) -> str:
     lines = [f"step {rep['step']}: {rep['step_sec'] * 1e3:.2f} ms "
              f"(gossip {rep['gossip_sec'] * 1e3:.2f} ms, attribution "
              f"coverage {rep['coverage'] * 100:.0f}%)"]
-    for p in ("local", "pack", "wire", "drain", "fold", "unpack"):
-        v = rep["phases"][p]
-        lines.append(f"  {p:<7} {v * 1e3:9.3f} ms")
+    for p in ("local", "pack", "wire", "drain", "fold", "unpack",
+              "compiled"):
+        v = rep["phases"].get(p, 0.0)
+        lines.append(f"  {p:<8} {v * 1e3:9.3f} ms")
     lines.append(f"  {'other':<7} {rep['other_sec'] * 1e3:9.3f} ms")
     if rep["edges"]:
         lines.append("  edges (deposits sent):")

@@ -465,6 +465,12 @@ _HELP_EXACT: Dict[str, str] = {
     "win.drain_records": "mailbox records drained",
     "win.drain_bytes": "mailbox bytes drained",
     "win.drain_orphans": "orphaned deposit chunks discarded",
+    "win.plan_rebuilds": "per-edge plane partitions recomputed (membership "
+                         "epoch / dead-set changes)",
+    "win.compiled_edges": "edges on the compiled ppermute plane in the "
+                          "latest partition",
+    "win.hosted_edges": "edges on the hosted mailbox residual in the "
+                        "latest partition",
     "cp.client.redials": "successful transparent control-plane reconnects",
     "cp.client.redial_attempts": "control-plane reconnect dials attempted",
     "cp.client.stale_frames": "incarnation-fence verdicts observed",

@@ -52,6 +52,11 @@ class BluefogTPUState:
         # Window registry: name -> bluefog_tpu.ops.windows.Window
         self.windows: Dict[str, Any] = {}
         self.win_mutex_lock = threading.RLock()
+        # Window gossip plane policy (policy, hosted_forced), resolved once
+        # per init from BLUEFOG_WIN_PLANE / the legacy alias — every window
+        # created in this job sees one consistent verdict even if the env
+        # mutates mid-run (ops/windows._plane_policy).
+        self.win_plane = None
         # Global toggle: win ops also move the associated push-sum scalar p
         # (reference: mpi_ops.py:1339-1363).
         self.win_ops_with_associated_p = False
@@ -227,6 +232,15 @@ def init(
     enter_quarantine(st.process_index)
     st.skip_negotiate = st.config.skip_negotiate
     st.windows = {}
+    # One plane-policy verdict per job (ISSUE r13): windows consult this
+    # instead of re-reading the env per creation, so a mid-job env change
+    # can't give two windows of one optimizer different planes.
+    from ..ops.windows import _plane_policy
+
+    st.win_plane = _plane_policy()
+    if st.win_plane[0] != "auto" or st.win_plane[1] is not None:
+        logger.info("window plane policy: %s (hosted forced: %s)",
+                    st.win_plane[0], st.win_plane[1])
     st.win_ops_with_associated_p = False
     st._plan_cache = {}
     st._topo_check_agreed = set()

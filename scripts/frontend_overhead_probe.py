@@ -75,11 +75,27 @@ def probe_torch(rounds: int) -> dict:
         t_stack.append(t1 - t0)
         t_comm.append(t2 - t1)
         t_wb.append(t3 - t2)
+    # device-resident mode (ISSUE r13): parameters live in jax-owned
+    # buffers behind dlpack views — the whole communicate is one call, so
+    # the comparable number is the full-communicate wall time
+    dmods = [MLP() for _ in range(N)]
+    dplan = bft._comm_plan(dmods)
+    t_dev = []
+    if bft._install_device_rows(dplan):
+        bft._device_communicate(dplan)  # warmup (jit)
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            bft._device_communicate(dplan)
+            t1 = time.perf_counter()
+            t_dev.append(t1 - t0)
     return {
         "frontend": "torch", "params_mb": round(param_bytes / 1e6, 2),
         "stack_ms": _med(t_stack), "comm_ms": _med(t_comm),
         "write_back_ms": _med(t_wb),
         "host_overhead_ms": _med([a + b for a, b in zip(t_stack, t_wb)]),
+        "device_resident_comm_ms": _med(t_dev) if t_dev else None,
+        "legacy_total_ms": _med([a + b + c for a, b, c in
+                                 zip(t_stack, t_comm, t_wb)]),
     }
 
 

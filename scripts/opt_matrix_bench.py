@@ -13,12 +13,22 @@ Usage:  python scripts/opt_matrix_bench.py [--chip] [--quick] [--modes ...]
   --quick: 1 warmup / 2 batches / 1 iter per mode — the CI smoke setting
            (tests/test_benchmark_smoke.py); exercises every mode's full
            launch+step path in seconds, numbers NOT meaningful for PERF.md.
+  --hybrid: sweep the window-plane policy x overlap matrix (ISSUE r13) on
+           the single-host multi-controller harness (world-1 control plane,
+           forced-hosted window, static exp2 topology — every edge
+           compiled-eligible under `auto`): `hosted` is the mailbox-plane
+           baseline, `auto` the per-edge hybrid plane, `auto`+overlap the
+           double-buffered residual. Auto rows report `speedup_vs_hosted`;
+           the acceptance bar is >= 1.5x. Then replays the plane
+           equivalence suite (tests/test_win_planes.py) so the speedup and
+           the bit-exactness/mass-conservation proofs come from one run.
 """
 
 import argparse
 import json
 import os
 import re
+import socket
 import subprocess
 import sys
 from pathlib import Path
@@ -60,6 +70,76 @@ def run_mode(mode: str, simulate: int, extra=(), quick: bool = False) -> dict:
             "ci": float(m.group(2))}
 
 
+# (plane, overlap) sweep of the hybrid harness; "hosted"/ov0 is the baseline
+HYBRID_SWEEP = [("hosted", "0"), ("auto", "0"), ("auto", "1")]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_hybrid_mode(mode: str, plane: str, overlap: str,
+                    quick: bool = False) -> dict:
+    """One benchmark child on the world-1 control-plane harness with the
+    window plane pinned: the hosted window is forced (legacy knob) so the
+    same mailbox machinery serves as baseline (`hosted`) and as the hybrid
+    residual (`auto`) — only the plane policy and overlap knob move."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        BLUEFOG_CP_HOST="127.0.0.1", BLUEFOG_CP_PORT=str(_free_port()),
+        BLUEFOG_CP_WORLD="1", BLUEFOG_CP_RANK="0",
+        BLUEFOG_WIN_HOST_PLANE="1", BLUEFOG_WIN_PLANE=plane,
+        BLUEFOG_WIN_OVERLAP=overlap)
+    env.pop("BLUEFOG_CP_FAULT", None)  # never bench under fault injection
+    cmd = [sys.executable, "-m", "bluefog_tpu.launcher",
+           "--simulate", "8", "--"]
+    reps = ("1", "2", "1") if quick else ("3", "5", "3")
+    cmd += [sys.executable, str(REPO / "examples" / "benchmark.py"),
+            "--model", "mlp", "--batch-size", "8",
+            "--num-warmup-batches", reps[0], "--num-batches-per-iter",
+            reps[1], "--num-iters", reps[2], "--dist-optimizer", mode,
+            "--disable-dynamic-topology"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
+                       cwd=REPO, env=env)
+    m = RATE_RE.search(r.stdout)
+    base = {"mode": mode, "plane": plane, "overlap": int(overlap)}
+    if r.returncode != 0 or not m:
+        return {**base, "error": (r.stdout + r.stderr)[-500:]}
+    return {**base, "img_per_sec": float(m.group(1)),
+            "ci": float(m.group(2))}
+
+
+def run_hybrid(modes, quick: bool) -> int:
+    rc = 0
+    for mode in modes:
+        baseline = None
+        for plane, overlap in HYBRID_SWEEP:
+            res = run_hybrid_mode(mode, plane, overlap, quick=quick)
+            res["where"] = "cpu-mesh-8dev-mlp-b8-cp1-hosted-win"
+            if "error" in res:
+                rc = 1
+            elif plane == "hosted":
+                baseline = res["img_per_sec"]
+            elif baseline:
+                res["speedup_vs_hosted"] = round(
+                    res["img_per_sec"] / baseline, 2)
+            print(json.dumps(res), flush=True)
+    # the acceptance criterion couples the speedup to the equivalence
+    # proofs: replay the plane suite in the same run
+    t = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_win_planes.py", "-q"],
+        capture_output=True, text=True, timeout=1200, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    print(json.dumps({
+        "mode": "win_planes_equivalence",
+        "passed": t.returncode == 0,
+        "tail": t.stdout.strip().splitlines()[-1] if t.stdout else ""}),
+        flush=True)
+    return rc or int(t.returncode != 0)
+
+
 def run_chip_mode(mode: str) -> dict:
     cmd = [sys.executable, str(REPO / "examples" / "benchmark.py"),
            "--model", "resnet50", "--batch-size", "64",
@@ -78,9 +158,12 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--chip", action="store_true")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--hybrid", action="store_true")
     ap.add_argument("--modes", nargs="*", default=None)
     args = ap.parse_args()
     rc = 0
+    if args.hybrid:
+        return run_hybrid(args.modes or ["win_put"], quick=args.quick)
     if args.chip:
         for mode in (args.modes or CHIP_MODES):
             res = run_chip_mode(mode)

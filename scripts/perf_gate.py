@@ -87,6 +87,29 @@ def collect_once() -> dict:
                 f"opt_matrix_bench mode {row['mode']} failed: "
                 f"{row['error']}")
         out[f"opt.{row['mode']}.img_per_sec"] = row["img_per_sec"]
+    # hybrid plane sweep (ISSUE r13): reported as `hybrid.*` series, which
+    # are INFO-ONLY per the stable-series rule — they join the gating set
+    # only after two stable rounds (move them out of the exclusion in
+    # gating() and re-run --update-baseline then)
+    text = _run([sys.executable, "scripts/opt_matrix_bench.py", "--quick",
+                 "--hybrid"], timeout=1800)
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        row = json.loads(line)
+        if row.get("mode") == "win_planes_equivalence":
+            if not row.get("passed"):
+                raise RuntimeError(
+                    "win-plane equivalence tests failed during the hybrid "
+                    f"sweep: {row.get('tail')}")
+            continue
+        if "error" in row:
+            raise RuntimeError(
+                f"opt_matrix_bench --hybrid {row.get('plane')}/ov"
+                f"{row.get('overlap')} failed: {row['error']}")
+        out[f"hybrid.{row['mode']}.{row['plane']}.ov{row['overlap']}"
+            ".img_per_sec"] = row["img_per_sec"]
     return out
 
 
@@ -109,6 +132,11 @@ def collect(repeats: int) -> dict:
 def gating(metrics: dict) -> dict:
     keep = {}
     for name, v in metrics.items():
+        if name.startswith("hybrid."):
+            # r13 hybrid-plane series: info-only until two stable rounds
+            # (the gate's stable-series rule) — then delete this branch
+            # and refresh the baseline
+            continue
         if name.startswith("opt.") or \
                 any(name.endswith(f"{op}.mbps") or f".{op}." in name
                     for op in _GATING_OPS):
@@ -152,7 +180,8 @@ def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
             "band": band,
             "harnesses": ["win_microbench --quick",
                           "opt_matrix_bench --quick --modes "
-                          + " ".join(_OPT_MODES)],
+                          + " ".join(_OPT_MODES),
+                          "opt_matrix_bench --quick --hybrid (info-only)"],
             "note": "quick-mode numbers: gate-relative only, meaningless "
                     "as absolute throughput (see PERF.md for real runs)",
         },

@@ -140,7 +140,12 @@ def main(argv=None) -> int:
         return 1
     pairs = flow_pairs(docs)
     if args.json:
-        print(json.dumps({"ranks": {str(r): rep
+        # --json is a MACHINE interface now: the per-edge plane planner
+        # consumes it (bluefog_tpu.ops.plan.load_attribution). The literal
+        # must match plan.ATTRIBUTION_SCHEMA_VERSION — kept inline so this
+        # script stays importable without jax; a test pins the pair.
+        print(json.dumps({"schema_version": 1,
+                          "ranks": {str(r): rep
                                     for r, rep in reports.items()},
                           "flow_pairs": {e: {**d, "transit_us":
                                              sorted(d["transit_us"])}

@@ -597,6 +597,85 @@ def test_gossip_weights_renormalize_around_dead_ranks(bf_hosted_cp,
         opt.free()
 
 
+def test_peer_death_demotes_edges_to_hosted_partition(monkeypatch):
+    """ISSUE r13: under the hybrid per-edge plane (BLUEFOG_WIN_PLANE=auto),
+    an injected peer death re-plans the partition — the dead ranks' edges
+    leave the COMPILED set (no compiled program may name a dead rank),
+    land on the hosted residual, get dropped there by the healed tables,
+    and the step COMPLETES on the healed partition matching the
+    shrunken-graph numpy oracle."""
+    import bluefog_tpu as bf
+    import jax.numpy as jnp
+    import optax
+
+    from bluefog_tpu.ops import windows as W
+    from bluefog_tpu.runtime import heartbeat as hb
+    from conftest import cpu_devices
+
+    port = _free_port()
+    for k, v in {
+        "BLUEFOG_CP_HOST": "127.0.0.1",
+        "BLUEFOG_CP_PORT": str(port),
+        "BLUEFOG_CP_WORLD": "1",
+        "BLUEFOG_CP_RANK": "0",
+        "BLUEFOG_WIN_HOST_PLANE": "1",
+        "BLUEFOG_WIN_PLANE": "auto",
+    }.items():
+        monkeypatch.setenv(k, v)
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(8))
+    assert cp.active()
+    try:
+        def loss_fn(params, batch):
+            return jnp.sum((params["w"] - batch) ** 2)
+
+        opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1), loss_fn=loss_fn)
+        state = opt.init({"w": jnp.zeros((2,), jnp.float32)})
+        batch = bf.shard_rank_stacked(
+            bf.mesh(), np.arange(8, dtype=np.float32).reshape(8, 1))
+        try:
+            win = W._get_window(opt._win_names[0])
+            state, _ = opt.step(state, batch)  # healthy: all compiled
+            part0 = win.plane_partition(set())
+            assert part0 is not None and not part0.hosted
+
+            dead = {6, 7}
+            monkeypatch.setattr(hb, "dead_ranks", lambda: set(dead))
+            ep = hb.membership_epoch()
+            monkeypatch.setattr(hb, "membership_epoch", lambda: ep + 1)
+
+            topo = bf.load_topology()
+            live_in = {r: [s for s in
+                           bf.topology_util.in_neighbor_ranks(topo, r)
+                           if s not in dead] for r in range(8)}
+            w = np.asarray(state.params["w"], np.float64)
+            for _ in range(2):
+                state, _ = opt.step(state, batch)  # must complete, no hang
+                wl = w - 0.1 * 2.0 * (w - np.arange(8.0).reshape(8, 1))
+                mixed = np.zeros_like(wl)
+                for r in range(8):
+                    u = 1.0 / (len(live_in[r]) + 1)
+                    mixed[r] = u * (wl[r] + sum(wl[s] for s in live_in[r]))
+                w = mixed
+            # the healed partition: no compiled edge names a dead rank
+            part = win._planner.partition(frozenset(dead), ep + 1)
+            assert part.compiled, "live-live edges must stay compiled"
+            assert all(s not in dead and d not in dead
+                       for s, d in part.compiled)
+            assert all((s, d) in part.hosted
+                       for s, d in win._planner.edges
+                       if s in dead or d in dead)
+            got = np.asarray(state.params["w"])
+            live = sorted(set(range(8)) - dead)
+            np.testing.assert_allclose(got[live], w[live],
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            opt.free()
+    finally:
+        bf.shutdown()
+        cp.reset_for_test()
+
+
 def test_gossip_step_retries_after_dead_mutex_holder(bf_hosted_cp):
     """End-to-end PeerLostError recovery: an external actor dies while
     holding a window mutex the optimizer's hoisted acquisition needs. The

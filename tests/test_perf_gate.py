@@ -73,6 +73,9 @@ def test_gating_filter_keeps_stable_series_only():
         "win.f32.raw_put_bytes.mbps": 1.0,   # noisy: out
         "win.f32.drain_fold.mbps": 1.0,      # noisy: out
         "opt.win_put.img_per_sec": 1.0,
+        # r13 hybrid-plane series: info-only until two stable rounds
+        "hybrid.win_put.auto.ov0.img_per_sec": 1.0,
+        "hybrid.win_put.hosted.ov0.img_per_sec": 1.0,
     }
     kept = pg.gating(metrics)
     assert set(kept) == {"win.f32.win_put.mbps", "win.f32.win_update.mbps",

@@ -132,6 +132,66 @@ def test_distributed_torch_optimizer_mixes_params(bf8):
     assert float(spread) < 1e-3, float(spread)
 
 
+def test_device_resident_matches_host_path(bf8):
+    """ISSUE r13 satellite: the device-resident fast path (jax-owned
+    buffers + dlpack views) must be numerically identical to the legacy
+    stack/scatter host path, and the module parameters must really alias
+    the jax rows (an optimizer update through the view is visible to the
+    next communicate without any stack)."""
+    bf.set_topology(topology_util.ExponentialTwoGraph(N))
+    runs = {}
+    for resident in (False, True):
+        mods = _make_modules(seed=11)
+        params = [p for m in mods for p in m.parameters()]
+        opt = bft.DistributedTorchOptimizer(
+            torch.optim.SGD(params, lr=0.05), mods,
+            device_resident=resident)
+        x = torch.randn(16, 4, generator=torch.Generator().manual_seed(5))
+        for _ in range(4):
+            opt.zero_grad()
+            loss = sum(m(x).square().mean() for m in mods)
+            loss.backward()
+            opt.step()
+        runs[resident] = torch.stack([m.weight.data.float()
+                                      for m in mods]).numpy()
+        if resident:
+            plan = bft._comm_plan(mods)
+            assert plan.device is not None, "residency failed to install"
+            # the parameter IS the dlpack view of the jax row buffer
+            p0 = mods[0].weight
+            v0 = plan.device.views["weight"][0]
+            assert p0.data.data_ptr() == v0.data_ptr()
+            # write through the view; the jax-owned row must see it
+            with torch.no_grad():
+                p0.data.fill_(7.0)
+            row = np.asarray(plan.device.rows["weight"][0])
+            np.testing.assert_allclose(row[0], 7.0)
+    np.testing.assert_allclose(runs[True], runs[False], rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_device_resident_survives_data_rebinding(bf8):
+    """User code that rebinds ``p.data`` (the plan-cache hazard the host
+    path handles by re-reading ``.data``) must not silently diverge: the
+    next communicate re-anchors the value into the jax row."""
+    mods = _make_modules(seed=13)
+    params = [p for m in mods for p in m.parameters()]
+    opt = bft.DistributedTorchOptimizer(
+        torch.optim.SGD(params, lr=0.0), mods)
+    opt.step()  # installs residency + one mixing
+    plan = bft._comm_plan(mods)
+    assert plan.device is not None
+    with torch.no_grad():
+        mods[3].weight.data = torch.full_like(mods[3].weight.data, 2.5)
+    opt.step()  # re-anchors, then mixes the rebound value
+    # rank 3's 2.5s entered the average: its in-neighbors see a blend,
+    # and rank 3's own row is no longer all-2.5
+    assert not torch.allclose(mods[3].weight.data,
+                              torch.full_like(mods[3].weight.data, 2.5))
+    assert mods[3].weight.data.data_ptr() == \
+        plan.device.views["weight"][3].data_ptr()
+
+
 def test_optimizer_num_steps_per_communication(bf8):
     mods = _make_modules(seed=7)
     params = [p for m in mods for p in m.parameters()]

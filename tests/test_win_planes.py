@@ -1,0 +1,432 @@
+"""Hybrid per-edge gossip plane (ISSUE r13).
+
+The planner (ops/plan.py:PlanePlanner) splits a hosted window's frozen edge
+set into a compiled partition (one fused shard_map/ppermute program per
+step) and a hosted mailbox residual. These tests pin the contracts:
+
+  * all edges compiled-eligible → the hybrid step is BIT-EXACT against the
+    pure collective plane (same program ops, materialized through the same
+    mail dtype);
+  * BLUEFOG_WIN_PLANE=hosted → bit-identical to the legacy
+    BLUEFOG_WIN_HOST_PLANE=1 wire (the r6/r7 oracle — the planner is off);
+  * a mixed partition changes the execution split, never the semantics
+    (numpy combine oracle);
+  * partitions re-plan exactly on membership-epoch bumps / dead-set
+    changes (cache keyed on (edge set, dead set, epoch));
+  * the planner consumes a REAL scripts/step_attribution.py --json dump
+    (stable schema_version — it is a machine interface now);
+  * push-sum mass is conserved across the partition boundary (compiled
+    edges move mass in-program, hosted edges via mailbox).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import plan as plan_mod
+from bluefog_tpu.ops import windows as win_ops
+from bluefog_tpu.runtime import control_plane as cp
+from bluefog_tpu.runtime import heartbeat as hb
+from bluefog_tpu.runtime import native
+
+from conftest import cpu_devices
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native runtime unavailable")
+
+N = 8
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _set_cp_env(monkeypatch, plane=None, legacy=None, overlap=None):
+    env = {
+        "BLUEFOG_CP_HOST": "127.0.0.1",
+        "BLUEFOG_CP_PORT": str(_free_port()),
+        "BLUEFOG_CP_WORLD": "1",
+        "BLUEFOG_CP_RANK": "0",
+    }
+    if plane is not None:
+        env["BLUEFOG_WIN_PLANE"] = plane
+    if legacy is not None:
+        env["BLUEFOG_WIN_HOST_PLANE"] = legacy
+    if overlap is not None:
+        env["BLUEFOG_WIN_OVERLAP"] = overlap
+    for k in ("BLUEFOG_WIN_PLANE", "BLUEFOG_WIN_HOST_PLANE",
+              "BLUEFOG_WIN_OVERLAP"):
+        if k not in env:
+            monkeypatch.delenv(k, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+
+
+@pytest.fixture()
+def bf_hybrid(monkeypatch):
+    """8 CPU ranks, world-1 control plane, hosted window WITH the per-edge
+    planner: BLUEFOG_WIN_PLANE=auto + the legacy hosted force — the
+    single-controller hybrid harness shape (docs/window_planes.md)."""
+    _set_cp_env(monkeypatch, plane="auto", legacy="1")
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(N))
+    assert cp.active()
+    yield bf
+    bf.shutdown()
+    cp.reset_for_test()
+
+
+def _quadratic_opt(bf_, cls=None, lr=0.05):
+    target = jnp.asarray([1.0, -2.0, 3.0, 0.5])
+
+    def loss(params, batch):
+        return jnp.sum((params["w"] - target) ** 2)
+
+    cls = cls or bf_.DistributedWinPutOptimizer
+    opt = cls(optax.sgd(lr), loss_fn=loss)
+    state = opt.init({"w": jnp.zeros(4)})
+    return opt, state, jnp.zeros((N, 1))
+
+
+def _run_steps(opt, state, batch, steps):
+    for _ in range(steps):
+        state, _ = opt.step(state, batch)
+    return np.asarray(state.params["w"]).copy()
+
+
+# ---------------------------------------------------------------------------
+# planner unit tests (no runtime needed)
+# ---------------------------------------------------------------------------
+
+def _planner(owner_of=None, edges=None, **kw):
+    edges = edges or [(0, 1), (1, 2), (2, 3), (3, 0)]
+    owner_of = owner_of or {r: 0 for r in range(4)}
+    return plan_mod.PlanePlanner(4, edges, owner_of, row_bytes=1 << 20, **kw)
+
+
+def test_planner_mesh_local_and_dead_eligibility():
+    pl = _planner(owner_of={0: 0, 1: 0, 2: 1, 3: 1})
+    part = pl.partition()
+    # (0,1) and (2,3) are mesh-local; (1,2)/(3,0) cross the controller
+    # boundary and stay hosted
+    assert part.compiled == frozenset({(0, 1), (2, 3)})
+    assert part.hosted == frozenset({(1, 2), (3, 0)})
+    # a dead-adjacent edge is demoted: no compiled program names rank 3
+    part = pl.partition(dead={3})
+    assert part.compiled == frozenset({(0, 1)})
+    assert all(3 not in e or e in part.hosted for e in pl.edges)
+
+
+def test_planner_size_floor_and_override():
+    pl = _planner(min_bytes=2 << 20)  # floor above the 1 MB row
+    assert not pl.partition().compiled
+    pl = _planner(hosted_override={(0, 1)})
+    part = pl.partition()
+    assert (0, 1) in part.hosted and (1, 2) in part.compiled
+
+
+def test_planner_policy_hosted_compiles_nothing():
+    pl = _planner(policy="hosted")
+    assert not pl.partition().compiled
+
+
+def test_planner_cache_keyed_on_dead_set_and_epoch():
+    pl = _planner()
+    pl.partition(epoch=0)
+    pl.partition(epoch=0)
+    assert pl.rebuilds == 1  # cache hit on the unchanged key
+    pl.partition(epoch=1)  # membership-epoch bump → re-plan
+    assert pl.rebuilds == 2
+    pl.partition(dead={2}, epoch=1)  # dead-set change → re-plan
+    assert pl.rebuilds == 3
+    pl.partition(dead={2}, epoch=1)
+    assert pl.rebuilds == 3
+
+
+def test_attribution_schema_is_validated():
+    with pytest.raises(ValueError):
+        plan_mod.load_attribution({"ranks": {}})
+    with pytest.raises(ValueError):
+        plan_mod.load_attribution({"schema_version": 999, "ranks": {}})
+    hints = plan_mod.load_attribution({
+        "schema_version": plan_mod.ATTRIBUTION_SCHEMA_VERSION,
+        "ranks": {"0": {"edges": {"0->2": {"bytes": 64.0,
+                                           "wire_sec_est": 0.25}}}}})
+    assert hints[(0, 2)]["bytes"] == 64.0
+    pl = _planner(edges=[(0, 2)])
+    assert pl.edge_cost((0, 2)) == 1 << 20
+    assert pl.ingest_attribution({
+        "schema_version": 1,
+        "ranks": {"0": {"edges": {"0->2": {"bytes": 64.0}}}}}) == 1
+    assert pl.edge_cost((0, 2)) == 64.0
+
+
+# ---------------------------------------------------------------------------
+# equivalence: all-compiled hybrid ⇔ pure collective plane (bit-exact)
+# ---------------------------------------------------------------------------
+
+def test_all_compiled_hybrid_bitexact_vs_collective(monkeypatch):
+    steps = 4
+    # run 1: hybrid — hosted window, planner on, every edge mesh-local
+    _set_cp_env(monkeypatch, plane="auto", legacy="1")
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(N))
+    opt, state, batch = _quadratic_opt(bf)
+    win = win_ops._get_window(opt._win_names[0])
+    assert win.hosted and win._planner is not None
+    part = win.plane_partition(set())
+    assert part is not None and not part.hosted, \
+        "static exp2 edges in a world-1 job must all be compiled-eligible"
+    hybrid = _run_steps(opt, state, batch, steps)
+    opt.free()
+    bf.shutdown()
+    cp.reset_for_test()
+
+    # run 2: the pure collective plane (no control plane at all)
+    for k in ("BLUEFOG_CP_HOST", "BLUEFOG_CP_PORT", "BLUEFOG_CP_WORLD",
+              "BLUEFOG_CP_RANK", "BLUEFOG_WIN_HOST_PLANE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("BLUEFOG_WIN_PLANE", "compiled")
+    bf.init(devices=cpu_devices(N))
+    opt2, state2, batch2 = _quadratic_opt(bf)
+    assert not win_ops._get_window(opt2._win_names[0]).hosted
+    collective = _run_steps(opt2, state2, batch2, steps)
+    opt2.free()
+    bf.shutdown()
+    cp.reset_for_test()
+
+    np.testing.assert_array_equal(hybrid, collective)
+
+
+def test_forced_hosted_reproduces_legacy_wire(monkeypatch):
+    """BLUEFOG_WIN_PLANE=hosted must be the legacy BLUEFOG_WIN_HOST_PLANE=1
+    path bit for bit — the planner stays off and every byte rides the
+    r6/r7 mailbox wire."""
+    steps = 3
+    results = []
+    for plane, legacy in (("hosted", None), (None, "1")):
+        _set_cp_env(monkeypatch, plane=plane, legacy=legacy)
+        cp.reset_for_test()
+        bf.init(devices=cpu_devices(N))
+        opt, state, batch = _quadratic_opt(bf)
+        win = win_ops._get_window(opt._win_names[0])
+        assert win.hosted and win._planner is None  # planner pinned off
+        results.append(_run_steps(opt, state, batch, steps))
+        opt.free()
+        bf.shutdown()
+        cp.reset_for_test()
+    np.testing.assert_array_equal(results[0], results[1])
+
+
+# ---------------------------------------------------------------------------
+# mixed partition ⇔ numpy combine oracle (the split changes execution, not
+# semantics)
+# ---------------------------------------------------------------------------
+
+def _winput_oracle(topo, w0, batch_targets, steps, lr=0.05,
+                   target=np.asarray([1.0, -2.0, 3.0, 0.5])):
+    in_nbrs = {r: bf.topology_util.in_neighbor_ranks(topo, r)
+               for r in range(N)}
+    w = np.asarray(w0, np.float64)
+    for _ in range(steps):
+        wl = w - lr * 2.0 * (w - target[None])
+        mixed = np.zeros_like(wl)
+        for r in range(N):
+            u = 1.0 / (len(in_nbrs[r]) + 1)
+            mixed[r] = u * (wl[r] + sum(wl[s] for s in in_nbrs[r]))
+        w = mixed
+    return w
+
+
+def test_mixed_partition_matches_numpy_oracle(bf_hybrid):
+    opt, state, batch = _quadratic_opt(bf_hybrid)
+    win = win_ops._get_window(opt._win_names[0])
+    # force a mixed partition: roughly half the edges demoted to hosted
+    forced = frozenset(e for e in win._planner.edges
+                       if (e[0] + e[1]) % 2 == 0)
+    assert forced and forced != win._planner.edges
+    win._planner.hosted_override = forced
+    win._planner._cache.clear()
+    try:
+        part = win.plane_partition(set())
+        assert part.compiled and part.hosted  # genuinely mixed
+        got = _run_steps(opt, state, batch, 3)
+        want = _winput_oracle(bf_hybrid.load_topology(),
+                              np.zeros((N, 4)), batch, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    finally:
+        opt.free()
+
+
+def test_overlap_is_one_step_stale(monkeypatch):
+    """BLUEFOG_WIN_OVERLAP=1: the hosted residual of step t folds into
+    step t+1. With a mixed partition, step 1's result must MISS the
+    hosted contributions entirely (nothing in flight yet) and step 2 must
+    fold step 1's — the numpy oracle models exactly that lag."""
+    _set_cp_env(monkeypatch, plane="auto", legacy="1", overlap="1")
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(N))
+    try:
+        opt, state, batch = _quadratic_opt(bf)
+        win = win_ops._get_window(opt._win_names[0])
+        forced = frozenset(e for e in win._planner.edges
+                           if (e[0] + e[1]) % 2 == 0)
+        win._planner.hosted_override = forced
+        win._planner._cache.clear()
+        try:
+            topo = bf.load_topology()
+            in_nbrs = {r: bf.topology_util.in_neighbor_ranks(topo, r)
+                       for r in range(N)}
+            target = np.asarray([1.0, -2.0, 3.0, 0.5])
+            lr = 0.05
+            w = np.zeros((N, 4))
+            stale = np.zeros((N, 4))  # hosted contributions in flight
+            for step in range(3):
+                state, _ = opt.step(state, batch)
+                wl = w - lr * 2.0 * (w - target[None])
+                mixed = np.zeros_like(wl)
+                fresh = np.zeros_like(wl)
+                for r in range(N):
+                    u = 1.0 / (len(in_nbrs[r]) + 1)
+                    comp = sum(wl[s] for s in in_nbrs[r]
+                               if (s, r) not in forced)
+                    fresh[r] = u * sum(wl[s] for s in in_nbrs[r]
+                                       if (s, r) in forced)
+                    mixed[r] = u * (wl[r] + comp) + stale[r]
+                stale = fresh
+                w = mixed
+                np.testing.assert_allclose(
+                    np.asarray(state.params["w"]), w, rtol=1e-5, atol=1e-6,
+                    err_msg=f"step {step}")
+        finally:
+            opt.free()
+    finally:
+        bf.shutdown()
+        cp.reset_for_test()
+
+
+# ---------------------------------------------------------------------------
+# re-plan triggers + push-sum conservation + attribution consumption
+# ---------------------------------------------------------------------------
+
+def test_epoch_bump_invalidates_partition_cache(bf_hybrid, monkeypatch):
+    opt, state, batch = _quadratic_opt(bf_hybrid)
+    win = win_ops._get_window(opt._win_names[0])
+    try:
+        state, _ = opt.step(state, batch)
+        r0 = win._planner.rebuilds
+        state, _ = opt.step(state, batch)
+        assert win._planner.rebuilds == r0  # same epoch, same dead set
+        ep = hb.membership_epoch()
+        monkeypatch.setattr(hb, "membership_epoch", lambda: ep + 1)
+        state, _ = opt.step(state, batch)
+        assert win._planner.rebuilds == r0 + 1  # epoch fence → re-plan
+    finally:
+        opt.free()
+
+
+def test_pushsum_mass_conserved_across_partition_boundary(bf_hybrid):
+    """Compiled edges move mass in-program, hosted edges via the mailbox;
+    the sum over live ranks must stay exactly the minted total either
+    way — asserted through the same r10 mass/minted gauges the health
+    plane reads."""
+    from bluefog_tpu.runtime import metrics as metrics_mod
+
+    def loss(params, batch):
+        return jnp.sum((params["w"] - batch) ** 2)
+
+    opt = bf_hybrid.DistributedPushSumOptimizer(optax.sgd(0.1),
+                                                loss_fn=loss)
+    state = opt.init({"w": jnp.zeros((2,), jnp.float32)})
+    batch = bf_hybrid.shard_rank_stacked(
+        bf_hybrid.mesh(), np.arange(N, dtype=np.float32).reshape(N, 1))
+    win = win_ops._get_window(opt._win_names[0])
+    forced = frozenset(e for e in win._planner.edges
+                       if (e[0] + e[1]) % 2 == 0)
+    win._planner.hosted_override = forced
+    win._planner._cache.clear()
+    try:
+        part = win.plane_partition(set())
+        assert part.compiled and part.hosted
+        for _ in range(4):
+            state, _ = opt.step(state, batch)
+            p = win.host.read_p()
+            assert abs(float(np.sum(p)) - float(N)) < 1e-9
+            assert metrics_mod.gauge("pushsum.mass").value == \
+                pytest.approx(float(N), abs=1e-9)
+        assert metrics_mod.gauge("pushsum.minted").value == float(N)
+        # convergence sanity: de-biased params head toward the batch mean
+        got = np.asarray(state.params["w"])
+        assert np.isfinite(got).all()
+    finally:
+        opt.free()
+
+
+def test_pullget_hybrid_matches_oracle(bf_hybrid):
+    opt, state, batch = _quadratic_opt(
+        bf_hybrid, cls=bf_hybrid.DistributedPullGetOptimizer)
+    win = win_ops._get_window(opt._win_names[0])
+    forced = frozenset(e for e in win._planner.edges
+                       if e[0] % 3 == 0)
+    win._planner.hosted_override = forced
+    win._planner._cache.clear()
+    try:
+        got = _run_steps(opt, state, batch, 3)
+        want = _winput_oracle(bf_hybrid.load_topology(),
+                              np.zeros((N, 4)), batch, 3)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    finally:
+        opt.free()
+
+
+def test_planner_consumes_real_attribution_dump(bf_hybrid, monkeypatch,
+                                                tmp_path):
+    """End-to-end machine interface: real hosted-wire traffic → flight
+    dump → scripts/step_attribution.py --json → PlanePlanner.
+    Remote deposits (the flow-event source) are forced by shrinking this
+    controller's owned set, exactly like the r12 split-ownership test."""
+    from bluefog_tpu.runtime import flight as flight_mod
+
+    monkeypatch.setenv("BLUEFOG_FLIGHT_DIR", str(tmp_path))
+    flight_mod.reset_for_job()
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [0, 1, 2, 3])
+    x = bf_hybrid.shard_rank_stacked(
+        bf_hybrid.mesh(), np.ones((N, 16), np.float32))
+    assert bf_hybrid.win_create(x, "planes.attr", zero_init=True)
+    win = win_ops._get_window("planes.attr")
+    assert set(win.owned) == {0, 1, 2, 3}
+    # a fake "step" so the dump holds one complete opt.step span
+    fl = flight_mod.recorder()
+    with fl.span("opt.step", b=1):
+        bf_hybrid.win_put(x, "planes.attr")  # deposits to ranks 4..7
+    path = bf_hybrid.flight_dump(path=str(tmp_path / "dump.json"))
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "scripts",
+                      "step_attribution.py"), path, "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert doc["schema_version"] == plan_mod.ATTRIBUTION_SCHEMA_VERSION
+    pl = plan_mod.PlanePlanner(
+        N, win._planner.edges if win._planner else
+        [(s, d) for d, ss in win.in_neighbors.items() for s in ss],
+        {r: 0 for r in range(N)}, row_bytes=64)
+    n_hints = pl.ingest_attribution(doc)
+    assert n_hints > 0, "no per-edge hints recovered from a real dump"
+    hinted = next(iter(pl.hints))
+    assert pl.edge_cost(hinted) == pl.hints[hinted]["bytes"]
+    bf_hybrid.win_free("planes.attr")
