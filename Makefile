@@ -7,8 +7,8 @@ MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=
 
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
         test_launcher test_models bench chaos dryrun native scaling \
-        lm_bench metrics-smoke flight-smoke perf-gate lint bfcheck check \
-        tsan asan
+        lm_bench metrics-smoke flight-smoke soak-smoke perf-gate lint \
+        bfcheck check tsan asan
 
 # Test files replayed under the sanitizers: the chaos suite (reconnect /
 # dedup / fencing churn) plus the striped-transport + hosted-window stress
@@ -57,6 +57,14 @@ flight-smoke:    ## flight-recorder acceptance: < 1500 ns ring-record
                  ## merged clock-synced trace from a separate process
 	JAX_PLATFORMS=cpu python scripts/flight_smoke.py
 
+soak-smoke:      ## sharded-control-plane churn soak, quick mode (<= 60 s):
+                 ## 2 shard server processes, ~64 raw clients with
+                 ## incarnation churn, one injected SIGKILL — asserts health
+                 ## convergence, exactly-once counters, conserved deposit
+                 ## mass, bounded server RSS (no JAX anywhere; full mode:
+                 ## scripts/cp_soak.py --clients 500 --churn)
+	python scripts/cp_soak.py --quick
+
 perf-gate:       ## perf regression gate: quick win_microbench +
                  ## opt_matrix_bench medians vs the committed
                  ## PERF_BASELINE.json (red beyond the band; seeded
@@ -100,7 +108,7 @@ asan:            ## AddressSanitizer build of csrc + the same replay.
 	    ASAN_OPTIONS="detect_leaks=0 exitcode=66" \
 	    JAX_PLATFORMS=cpu $(PYTEST) $(SANITIZE_TESTS) -q -m "not slow"
 
-chaos: check metrics-smoke flight-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
+chaos: check metrics-smoke flight-smoke soak-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
                  ## seed offsets (BLUEFOG_CHAOS_SEED shifts every armed drop
                  ## point, so reconnect/dedup/fencing — and the telemetry
                  ## counters asserted against them — face different drop sites)

@@ -127,6 +127,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--min-world", type=int, default=1, metavar="M",
                    help="with --elastic: kill the whole job once fewer "
                         "than M ranks could keep running (default 1)")
+    p.add_argument("--cp-shards", type=int, default=None, metavar="N",
+                   help="shard the control plane across N server processes "
+                        "(failover-capable: clients route keys with a "
+                        "stable hash and fail over when a shard dies; "
+                        "membership state is replicated on every shard — "
+                        "docs/fault_tolerance.md). In driver (-H/--hostfile)"
+                        " mode the driver launches N shard servers and "
+                        "exports BLUEFOG_CP_HOSTS to every process; "
+                        "otherwise exports BLUEFOG_CP_SHARDS and rank 0 "
+                        "serves all N in-process")
     p.add_argument("--chaos", type=str, default=None, metavar="SPEC",
                    help="arm deterministic control-plane fault injection in "
                         "every launched process (exports BLUEFOG_CP_FAULT; "
@@ -165,11 +175,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how long --dump waits for rank acks (ranks poll "
                         "the trigger on their heartbeat cadence, default "
                         "5 s, so the default 60 covers slow ticks)")
-    p.add_argument("--cp", type=str, default=None, metavar="HOST:PORT",
-                   help="control-plane address for --status/--dump "
-                        "(default: BLUEFOG_CP_HOST/BLUEFOG_CP_PORT, "
-                        "falling back to JAX_COORDINATOR_ADDRESS port "
-                        "+ 17)")
+    p.add_argument("--cp", type=str, default=None,
+                   metavar="HOST:PORT[,HOST:PORT...]",
+                   help="control-plane address(es) for --status/--dump — "
+                        "a sharded job names every shard, and the views "
+                        "are merged with dead shards reported by name "
+                        "(default: BLUEFOG_CP_HOSTS, then "
+                        "BLUEFOG_CP_HOST/BLUEFOG_CP_PORT, falling back to "
+                        "JAX_COORDINATOR_ADDRESS port + 17)")
     p.add_argument("--timeline-filename", type=str, default=None,
                    help="enable the timeline profiler, writing to this prefix")
     p.add_argument("--verbose", action="store_true",
@@ -309,6 +322,43 @@ def _supervise_elastic(procs, spawn, base_inc: int, budget: int,
         time.sleep(0.1)
 
 
+def _spawn_shard_servers(n: int, total: int, advertise_host: str):
+    """Launch N control-plane shard server processes on the driver host
+    (``bfrun --cp-shards N``); returns (procs, BLUEFOG_CP_HOSTS value).
+    Blocks until every shard prints its READY line so children can never
+    race a bind; server processes inherit the freshly minted job secret
+    through the environment."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "runtime", "shard_server.py")
+    procs, eps = [], []
+    for i in range(n):
+        p = subprocess.Popen(
+            [sys.executable, script, "--port", "0", "--world", str(total),
+             "--shard", str(i)],
+            stdout=subprocess.PIPE, text=True)
+        line = p.stdout.readline()
+        if not line.startswith("BF_SHARD_READY"):
+            for q in procs + [p]:
+                q.terminate()
+            raise RuntimeError(
+                f"control-plane shard {i} failed to start")
+        procs.append(p)
+        eps.append(f"{advertise_host}:{int(line.split()[1])}")
+    return procs, ",".join(eps)
+
+
+def _stop_shard_servers(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
 def _fanout(args) -> int:
     """Drive the whole job from this one shell: launch every process, stream
     its output, aggregate exit codes, kill-all on Ctrl-C or first failure."""
@@ -360,6 +410,23 @@ def _fanout(args) -> int:
         # remotely that is only a likely-free ephemeral pick — pass an
         # explicit --coordinator if the bind fails there
         coordinator = f"{chost}:{_free_port()}"
+
+    # Sharded control plane: the driver owns N real shard server processes
+    # and every child (local and remote — BLUEFOG_* env is forwarded)
+    # routes over them instead of rank 0 serving in-process.
+    shard_procs: List[subprocess.Popen] = []
+    if args.cp_shards and args.cp_shards > 1:
+        shost = socket.getfqdn() if remote_hosts else "127.0.0.1"
+        try:
+            shard_procs, cp_hosts = _spawn_shard_servers(
+                args.cp_shards, total, shost)
+        except (RuntimeError, OSError, ValueError) as exc:
+            print(f"bfrun: {exc}", file=sys.stderr)
+            return 1
+        os.environ["BLUEFOG_CP_HOSTS"] = cp_hosts
+        os.environ["BLUEFOG_CP_SERVE"] = "0"
+        print(f"bfrun: control plane sharded over {args.cp_shards} "
+              f"server(s): {cp_hosts}", file=sys.stderr)
 
     def child_args(pid: int) -> List[str]:
         out = ["-m", "bluefog_tpu.launcher", "-np", str(total),
@@ -432,65 +499,76 @@ def _fanout(args) -> int:
 
     procs: List[subprocess.Popen] = []
     try:
-        for pid in range(total):
-            procs.append(spawn(pid, base_inc))
+        try:
+            for pid in range(total):
+                procs.append(spawn(pid, base_inc))
 
-        if args.elastic is not None:
-            own_exit = _supervise_elastic(
-                procs, spawn, base_inc, max(0, args.elastic),
-                max(1, args.min_world))
-        else:
-            # first failure kills the job (mpirun semantics); else wait all
-            while True:
-                codes = [p.poll() for p in procs]
-                failed = [c for c in codes if c not in (None, 0)]
-                if failed or all(c is not None for c in codes):
-                    break
-                time.sleep(0.1)
-            # codes at loop exit are authoritative: processes still running
-            # get terminated below, and their -SIGTERM must not mask the
-            # real failure
-            own_exit = [c for c in codes if c is not None]
-    except KeyboardInterrupt:
+            if args.elastic is not None:
+                own_exit = _supervise_elastic(
+                    procs, spawn, base_inc, max(0, args.elastic),
+                    max(1, args.min_world))
+            else:
+                # first failure kills the job (mpirun semantics); else
+                # wait all
+                while True:
+                    codes = [p.poll() for p in procs]
+                    failed = [c for c in codes if c not in (None, 0)]
+                    if failed or all(c is not None for c in codes):
+                        break
+                    time.sleep(0.1)
+                # codes at loop exit are authoritative: processes still
+                # running get terminated below, and their -SIGTERM must
+                # not mask the real failure
+                own_exit = [c for c in codes if c is not None]
+        except KeyboardInterrupt:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGINT)
+            deadline = time.time() + 5
+            for p in procs:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            return 130
         for p in procs:
             if p.poll() is None:
-                p.send_signal(signal.SIGINT)
-        deadline = time.time() + 5
-        for p in procs:
+                p.terminate()
             try:
-                p.wait(timeout=max(0.1, deadline - time.time()))
+                p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
-        return 130
-    for p in procs:
-        if p.poll() is None:
-            p.terminate()
-        try:
-            p.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            p.wait()
-    rc = 0
-    for c in own_exit:
-        if c != 0:
-            rc = c if c > 0 else 128 + abs(c)  # signal deaths, shell-style
-            break
-    return rc
+                p.wait()
+        rc = 0
+        for c in own_exit:
+            if c != 0:
+                rc = c if c > 0 else 128 + abs(c)  # signal deaths,
+                break                              # shell-style
+        return rc
+    finally:
+        _stop_shard_servers(shard_procs)
 
 
 def _cp_address(args, what: str):
-    """Resolve the control-plane address for --status/--dump: --cp wins,
-    then BLUEFOG_CP_HOST/PORT, then the jax coordinator + 17 convention.
-    Returns (host, port) or None after printing the error."""
+    """Resolve the control-plane endpoint list for --status/--dump: --cp
+    wins (``HOST:PORT[,HOST:PORT...]`` — a sharded job names every shard),
+    then BLUEFOG_CP_HOSTS, then BLUEFOG_CP_HOST/PORT, then the jax
+    coordinator + 17 convention. Returns [(host, port)] or None after
+    printing the error."""
+    from .runtime.router import parse_endpoints
+
+    spec = args.cp or os.environ.get("BLUEFOG_CP_HOSTS")
+    if spec:
+        try:
+            eps = parse_endpoints(spec)
+        except ValueError as exc:
+            print(f"bfrun {what}: {exc}", file=sys.stderr)
+            return None
+        if eps:
+            return eps
     host = os.environ.get("BLUEFOG_CP_HOST")
     port = int(os.environ["BLUEFOG_CP_PORT"]) \
         if os.environ.get("BLUEFOG_CP_PORT") else None
-    if args.cp:
-        h, _, p = args.cp.partition(":")
-        if not p:
-            print(f"bfrun {what}: --cp wants HOST:PORT", file=sys.stderr)
-            return None
-        host, port = h, int(p)
     if host is None or port is None:
         coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
         if coord and ":" in coord:
@@ -499,22 +577,46 @@ def _cp_address(args, what: str):
             port = port or int(cport) + 17
     if not host or not port:
         print(f"bfrun {what}: control-plane address unknown; pass "
-              "--cp HOST:PORT or set BLUEFOG_CP_HOST/BLUEFOG_CP_PORT",
+              "--cp HOST:PORT[,HOST:PORT...] or set "
+              "BLUEFOG_CP_HOST/BLUEFOG_CP_PORT (or BLUEFOG_CP_HOSTS)",
               file=sys.stderr)
         return None
-    return host, port
+    return [(host, port)]
 
 
-def _raw_client(host: str, port: int, what: str):
+def _raw_client(endpoints, what: str):
+    """A raw read-only attachment for --status/--dump: a plain client for
+    one endpoint, a LENIENT ShardRouter for several (a dead shard is
+    reported by name in the output instead of failing the probe)."""
     from .runtime.native import ControlPlaneClient
+    from .runtime.router import ShardRouter
 
     secret = os.environ.get("BLUEFOG_CP_SECRET", "")
     try:
-        return ControlPlaneClient(host, port, 0, secret=secret, streams=1)
+        if len(endpoints) == 1:
+            host, port = endpoints[0]
+            return ControlPlaneClient(host, port, 0, secret=secret,
+                                      streams=1)
+        return ShardRouter(endpoints, 0, secret=secret, streams=1,
+                           lenient=True)
     except (OSError, RuntimeError) as exc:
+        names = ",".join(f"{h}:{p}" for h, p in endpoints)
         print(f"bfrun {what}: cannot reach the control plane at "
-              f"{host}:{port} ({exc})", file=sys.stderr)
+              f"{names} ({exc})", file=sys.stderr)
         return None
+
+
+def _report_dead_shards(cl, what: str) -> list:
+    """Print (never raise) the router's dead-shard view; [] for a plain
+    single-endpoint client."""
+    if not hasattr(cl, "dead_shard_endpoints"):
+        return []
+    dead = cl.dead_shard_endpoints()
+    for name in dead:
+        print(f"bfrun {what}: control-plane shard {name} is DEAD "
+              "(its keyspace failed over; routed state there is lost)",
+              file=sys.stderr)
+    return dead
 
 
 def _strict_findings(health: dict) -> List[str]:
@@ -548,7 +650,7 @@ def _status(args) -> int:
         return 1
     from .runtime import metrics as _metrics
 
-    cl = _raw_client(*addr, what="--status")
+    cl = _raw_client(addr, what="--status")
     if cl is None:
         return 1
     try:
@@ -557,8 +659,27 @@ def _status(args) -> int:
         if not health["ranks"]:
             print("  (no rank has published metrics — is "
                   "BLUEFOG_METRICS_INTERVAL set on the job?)")
+        dead_shards = []
+        if hasattr(cl, "server_stats_all"):
+            # sharded plane: merge the per-shard server views; a dead
+            # shard is a named row, never a raised probe failure
+            print(f"  control-plane shards ({cl.shard_count}):")
+            for name, st in cl.server_stats_all():
+                if st is None:
+                    print(f"    {name}: DEAD")
+                    dead_shards.append(name)
+                else:
+                    print(f"    {name}: conns={st['live_connections']} "
+                          f"kv={st['kv_entries']} "
+                          f"mailbox={st['mailbox_records']} recs/"
+                          f"{st['mailbox_bytes']} B "
+                          f"locks={st['locks_held']} "
+                          f"stale_rejects={st['stale_rejects']}")
         if getattr(args, "strict", False):
             findings = _strict_findings(health)
+            if dead_shards:
+                findings.append(
+                    f"dead control-plane shard(s): {dead_shards}")
             if findings:
                 for f in findings:
                     print(f"  STRICT: {f}", file=sys.stderr)
@@ -586,7 +707,7 @@ def _dump(args) -> int:
         return 1
     from .runtime import flight as _flight
 
-    cl = _raw_client(*addr, what="--dump")
+    cl = _raw_client(addr, what="--dump")
     if cl is None:
         return 1
     try:
@@ -645,6 +766,7 @@ def _dump(args) -> int:
         flows = sum(1 for e in merged if e.get("ph") in ("s", "f"))
         print(f"  merged: {len(merged)} events ({flows} flow events) -> "
               f"{mpath}")
+        _report_dead_shards(cl, "--dump")
     finally:
         cl.close()
     return 0
@@ -668,6 +790,10 @@ def main(argv=None) -> int:
         return _fanout(args)
 
     env = dict(os.environ)
+    if args.cp_shards and args.cp_shards > 1:
+        # exec mode: rank 0's bf.init serves all N shards in-process
+        # (driver mode above launches real server processes instead)
+        env["BLUEFOG_CP_SHARDS"] = str(args.cp_shards)
     if args.timeline_filename:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
     if args.verbose:

@@ -187,6 +187,11 @@ class _ControlPlaneWinHost:
         self.n = n
         self.d_max = d_max
         self.owned = set(owned)
+        # May be a plain ControlPlaneClient or (sharded deployments) a
+        # ShardRouter — the whole window plane is deliberately routing-
+        # agnostic: scalars, mutexes, deposits, and drains address keys,
+        # and the router owns key -> shard placement + failover
+        # (docs/fault_tolerance.md, "Control-plane sharding & failover").
         self._cl = _cp.client()
         self._pre = f"w.{name}"
         # A quarantined rejoiner starts with ZERO push-sum mass: its old

@@ -34,13 +34,16 @@ from .config import knob_env
 from .logging import logger
 from .native import (ControlPlaneClient, ControlPlaneServer,
                      StaleIncarnationError)
+from .router import ShardRouter, parse_endpoints
 
 _mu = threading.Lock()
-_client: Optional[ControlPlaneClient] = None
+_client = None  # ControlPlaneClient (1 endpoint) or ShardRouter (N shards)
 _server: Optional[ControlPlaneServer] = None
+_servers: list = []  # in-process shard servers (BLUEFOG_CP_SHARDS > 1)
 _world: int = 1
 _tried = False
 _conn_params = None  # (host, port, rank, secret) of the live attachment
+_endpoints = None    # [(host, port)] of a sharded attachment
 _incarnation: int = 0  # incarnation this attachment registered
 
 
@@ -102,6 +105,22 @@ def attach() -> Optional[ControlPlaneClient]:
         # it the server accepts any TCP connect (single-host dev only).
         secret = os.environ.get("BLUEFOG_CP_SECRET", "")
 
+        # Sharded control plane, explicit endpoints (ISSUE r14):
+        # BLUEFOG_CP_HOSTS names N external shard server processes (what
+        # ``bfrun --cp-shards`` exports) — no host derivation needed, but
+        # (rank, world) still come from the launcher/jax.distributed env
+        # when BLUEFOG_CP_RANK/WORLD are not set explicitly.
+        hosts_spec = os.environ.get("BLUEFOG_CP_HOSTS")
+        if hosts_spec and world <= 0:
+            nproc = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+            pid = int(os.environ.get("JAX_PROCESS_ID", "0"))
+            if nproc <= 1:
+                _, nproc, pid = _distributed_client_info()
+            world, rank = max(1, nproc), pid
+        if hosts_spec:
+            return _attach_sharded(hosts_spec, 1, host, port, rank, world,
+                                   secret)
+
         if host is None:
             # Automatic multi-controller wiring: prefer the launcher's env,
             # fall back to the live jax.distributed client (pods initialized
@@ -119,6 +138,15 @@ def attach() -> Optional[ControlPlaneClient]:
             port = port or int(cport) + 17
             rank = pid
             world = nproc
+        # Sharded control plane over a derived host/port:
+        # BLUEFOG_CP_SHARDS=N uses ports port..port+N-1 and rank 0 serves
+        # all N in-process (tests, single-host jobs). One endpoint keeps
+        # the legacy single-client path below, byte for byte.
+        shards = int(knob_env("BLUEFOG_CP_SHARDS") or 1)
+        if shards > 1:
+            return _attach_sharded(None, shards, host, port, rank, world,
+                                   secret)
+
         if port is None or world <= 0:
             logger.warning("control plane env incomplete; staying local")
             return None
@@ -194,6 +222,118 @@ def attach() -> Optional[ControlPlaneClient]:
         return _client
 
 
+def _stop_servers() -> None:
+    global _servers
+    for srv in _servers:
+        srv.stop()
+    _servers = []
+
+
+def _attach_sharded(hosts_spec, shards, host, port, rank, world, secret):
+    """Sharded attachment (caller holds ``_mu``): connect a
+    :class:`ShardRouter` over N endpoints, optionally serving the N shards
+    in-process on rank 0, and assert per-shard mailbox-cap agreement.
+    Returns the router (stored as the process-global client) or None."""
+    global _client, _servers, _world, _conn_params, _endpoints, _incarnation
+    if hosts_spec:
+        try:
+            endpoints = parse_endpoints(hosts_spec)
+        except ValueError as exc:
+            raise RuntimeError(f"BLUEFOG_CP_HOSTS: {exc}") from None
+        serve_here = False  # endpoints name external shard server processes
+    else:
+        if host is None or port is None:
+            logger.warning("BLUEFOG_CP_SHARDS set without a control-plane "
+                           "host/port; staying local")
+            return None
+        endpoints = [(host, port + i) for i in range(max(1, shards))]
+        serve_here = rank == 0 and \
+            os.environ.get("BLUEFOG_CP_SERVE", "1") != "0"
+    if world <= 0:
+        logger.warning("control plane env incomplete; staying local")
+        return None
+
+    if serve_here:
+        max_mb = float(knob_env("BLUEFOG_CP_MAILBOX_MAX_MB"))
+        served_cap = int(max_mb * (1 << 20))
+        try:
+            for _, p in endpoints:
+                _servers.append(ControlPlaneServer(
+                    world, p, secret=secret, max_mailbox_bytes=served_cap))
+        except (OSError, RuntimeError) as exc:
+            # Another actor (launcher, tests) may already serve these ports.
+            logger.debug("shard servers not started here (%s)", exc)
+            _stop_servers()
+        else:
+            # Every shard publishes ITS OWN effective cap (value + 1, so a
+            # missing key's 0 stays distinguishable). Deliberately written
+            # per shard, never through the router: a router write would
+            # max-merge the copies and MASK a mixed-cap cluster instead of
+            # letting the agreement check below reject it.
+            for _, p in endpoints:
+                c = ControlPlaneClient("127.0.0.1", p, rank, secret=secret,
+                                       streams=1)
+                c.put(_MAILBOX_CAP_KEY, served_cap + 1)
+                c.close()
+
+    deadline = time.monotonic() + float(
+        os.environ.get("BLUEFOG_CP_CONNECT_TIMEOUT", "30"))
+    last: Optional[Exception] = None
+    inc = _env_incarnation()
+    router = None
+    while time.monotonic() < deadline:
+        try:
+            router = ShardRouter(endpoints, rank, secret=secret,
+                                 incarnation=inc)
+            break
+        except StaleIncarnationError:
+            _stop_servers()
+            raise
+        except (OSError, RuntimeError) as exc:
+            last = exc
+            time.sleep(0.2)
+    names = ",".join(f"{h}:{p}" for h, p in endpoints)
+    if router is None:
+        _stop_servers()
+        if world > 1:
+            # same loud-failure contract as the single-server path: a
+            # multi-process job must never degrade to local coordination
+            raise RuntimeError(
+                f"control plane connect to shards [{names}] failed after "
+                "BLUEFOG_CP_CONNECT_TIMEOUT with a declared world of "
+                f"{world} processes (rank {rank}): refusing to degrade "
+                "a multi-controller job to local-only coordination. "
+                f"Last error: {last}")
+        logger.warning("sharded control plane connect failed (%s); "
+                       "staying local", last)
+        return None
+
+    # Mixed-cap clusters fail loudly AT ATTACH: every shard advertises its
+    # own cap, and a disagreement would otherwise truncate deposits on the
+    # smaller shard only — silently, and only for the keys routed there.
+    caps = {ep: v - 1
+            for ep, v in router.replicated_get_all(_MAILBOX_CAP_KEY)
+            if v > 0}
+    if len(set(caps.values())) > 1:
+        router.close()
+        _stop_servers()
+        raise RuntimeError(
+            "control-plane shards advertise DIFFERENT mailbox caps: " +
+            ", ".join(f"{ep}={cap}" for ep, cap in sorted(caps.items())) +
+            " — set BLUEFOG_CP_MAILBOX_MAX_MB identically on every shard "
+            "server (a mixed-cap cluster truncates deposits on the "
+            "smaller shards only)")
+
+    _client = router
+    _world = world
+    _conn_params = (None, None, rank, secret)
+    _endpoints = list(endpoints)
+    _incarnation = inc
+    logger.info("control plane attached (sharded): %d shard(s) [%s] "
+                "rank=%d world=%d", len(endpoints), names, rank, world)
+    return router
+
+
 def active() -> bool:
     return _client is not None
 
@@ -218,6 +358,13 @@ def extra_client(streams: Optional[int] = None) -> ControlPlaneClient:
     if _conn_params is None:
         raise RuntimeError("control plane is not attached")
     host, port, rank, secret = _conn_params
+    if _endpoints is not None:
+        # Sharded attachment: the dedicated connection set is a router of
+        # its own, SHARING the main router's dead-shard state so every
+        # subsystem of this process agrees on routing.
+        return ShardRouter(_endpoints, rank, secret=secret, streams=streams,
+                           incarnation=_incarnation,
+                           shared_state=_client.shared_state())
     return ControlPlaneClient(host, port, rank, secret=secret,
                               streams=streams, incarnation=_incarnation)
 
@@ -262,7 +409,7 @@ def bump_membership_epoch() -> None:
 def detach() -> None:
     """Close the client (and server, when owned). Safe to call repeatedly."""
     global _client, _server, _tried, _world, _conn_params, _cap_cache, \
-        _incarnation
+        _incarnation, _endpoints
     with _mu:
         if _client is not None:
             _client.close()
@@ -270,9 +417,11 @@ def detach() -> None:
         if _server is not None:
             _server.stop()
             _server = None
+        _stop_servers()
         _tried = False
         _world = 1
         _conn_params = None
+        _endpoints = None
         _cap_cache = None
         _incarnation = 0
 

@@ -161,8 +161,24 @@ class PeerMonitor:
         except OSError:
             self._epoch += 1  # local monotonicity is what consumers need
 
+    def _poll_shards(self, cl) -> None:
+        """Per-shard control-plane liveness (sharded deployments only):
+        adopt peer-published failover flags, verify each live shard still
+        answers, and surface transitions in the telemetry/timeline planes.
+        The router logs the failure itself; this is the cadence that makes
+        every process converge on the same shrunken shard ring within one
+        heartbeat interval of a shard death."""
+        before = cl.dead_shards()
+        dead = cl.poll_shard_health()
+        _metrics.gauge("cp.shards").set(cl.shard_count)
+        _metrics.gauge("cp.dead_shards").set(len(dead))
+        for idx in sorted(dead - before):
+            timeline_instant(f"cp.shard.{idx}", "SHARD_DEAD")
+
     def _tick(self) -> None:
         cl = self._cl if self._cl is not None else _cp.client()
+        if hasattr(cl, "poll_shard_health"):
+            self._poll_shards(cl)
         cl.put(f"bf.hb.{self.me}", int(time.monotonic_ns() & 0x7FFFFFFFFFFF))
         now = time.monotonic()
         for peer in range(self.world):

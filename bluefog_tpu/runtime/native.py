@@ -161,10 +161,14 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn = getattr(lib, fname)
         fn.restype = ctypes.c_int64
         fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-    for fname in ("bf_cp_fetch_add", "bf_cp_put"):
+    for fname in ("bf_cp_fetch_add", "bf_cp_put", "bf_cp_put_max"):
         fn = getattr(lib, fname)
         fn.restype = ctypes.c_int64
         fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    # remote per-shard counter read (sharded control plane, kStats)
+    lib.bf_cp_remote_stats.restype = ctypes.c_int
+    lib.bf_cp_remote_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
     for fname in ("bf_cp_append_bytes", "bf_cp_put_bytes"):
         fn = getattr(lib, fname)
         fn.restype = ctypes.c_int64
@@ -568,6 +572,30 @@ class _MultiReply:
         return False
 
 
+_SRV_STAT_SLOTS = 43  # 32 per-op counts + 11 aggregates (csrc layout)
+
+
+def _server_stats_dict(buf) -> dict:
+    """Decode the 43-slot server counter block (one layout, two transports:
+    the in-process bf_cp_server_counters read and the kStats wire op)."""
+    ops = {name: int(buf[code]) for code, name in _OP_NAMES.items()
+           if buf[code]}
+    return {
+        "ops": ops,
+        "live_connections": int(buf[32]),
+        "mailbox_records": int(buf[33]),
+        "mailbox_bytes": int(buf[34]),
+        "locks_held": int(buf[35]),
+        "lock_force_releases": int(buf[36]),
+        "barrier_withdrawals": int(buf[37]),
+        "dedup_replays": int(buf[38]),
+        "stale_rejects": int(buf[39]),
+        "kv_entries": int(buf[40]),
+        "bytes_slots": int(buf[41]),
+        "bytes_slot_bytes": int(buf[42]),
+    }
+
+
 class ControlPlaneServer:
     """Coordinator side of the scalar control plane (one per job).
 
@@ -636,22 +664,7 @@ class ControlPlaneServer:
         if self._lib.bf_cp_server_counters(self._h, buf,
                                            self._SRV_SLOTS) < 0:
             return {}
-        ops = {name: int(buf[code]) for code, name in _OP_NAMES.items()
-               if buf[code]}
-        return {
-            "ops": ops,
-            "live_connections": int(buf[32]),
-            "mailbox_records": int(buf[33]),
-            "mailbox_bytes": int(buf[34]),
-            "locks_held": int(buf[35]),
-            "lock_force_releases": int(buf[36]),
-            "barrier_withdrawals": int(buf[37]),
-            "dedup_replays": int(buf[38]),
-            "stale_rejects": int(buf[39]),
-            "kv_entries": int(buf[40]),
-            "bytes_slots": int(buf[41]),
-            "bytes_slot_bytes": int(buf[42]),
-        }
+        return _server_stats_dict(buf)
 
     def __enter__(self):
         return self
@@ -843,6 +856,27 @@ class ControlPlaneClient:
         r = self._lib.bf_cp_get(self._h, name.encode())
         self._check_stale(r)
         return r
+
+    def put_max(self, name: str, value: int) -> int:
+        """Monotone merge: kv[name] = max(kv[name], value); returns the
+        post-merge value. The shard router's replication write — replaying
+        it (lost reply, failover re-send) can never regress the value."""
+        r = self._lib.bf_cp_put_max(self._h, name.encode(), value)
+        self._check_stale(r)
+        return r
+
+    def server_stats(self) -> dict:
+        """The server's telemetry counter block, read over the wire (the
+        kStats op) — per-shard server views for external actors that do
+        not own the :class:`ControlPlaneServer` handle. Empty dict when
+        the server predates the op."""
+        buf = (ctypes.c_longlong * _SRV_STAT_SLOTS)()
+        r = self._lib.bf_cp_remote_stats(self._h, buf, _SRV_STAT_SLOTS)
+        if r == _STALE:
+            self._check_stale(r)
+        if r < _SRV_STAT_SLOTS:
+            return {}
+        return _server_stats_dict(buf)
 
     # -- pipelined batches --------------------------------------------------
 

@@ -86,6 +86,16 @@ OPS: Tuple[OpSpec, ...] = (
     OpSpec("attach", 18, "kAttach", True,
            "incarnation registration; re-registering the same incarnation "
            "is a no-op (every reconnect re-sends it)"),
+    OpSpec("put_max", 19, "kPutMax", True,
+           "monotone merge (kv[key] = max(kv[key], arg)) — the shard "
+           "router's replication write for membership-critical keys; "
+           "commutative and idempotent by construction, so replaying it "
+           "after a lost reply (or onto a failover replica) cannot regress "
+           "the value"),
+    OpSpec("stats", 20, "kStats", True,
+           "pure read of the server's telemetry counter block — how an "
+           "external actor merges per-shard views without owning the "
+           "server handle"),
 )
 
 # name -> wire code (the table every Python-side consumer keys off)

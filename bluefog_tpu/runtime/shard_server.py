@@ -1,0 +1,117 @@
+"""One control-plane shard server, as a standalone OS process.
+
+The sharded control plane (docs/fault_tolerance.md, "Control-plane
+sharding & failover") runs N of these; clients route keys across them with
+:class:`bluefog_tpu.runtime.router.ShardRouter`. Launched by
+``bfrun --cp-shards N``, by ``scripts/cp_soak.py``, and by the chaos tests
+(which SIGKILL it mid-job on purpose):
+
+    python bluefog_tpu/runtime/shard_server.py --port P --world W [--shard I]
+
+Run BY FILE PATH it bootstraps lean — the relative imports below resolve
+without executing ``bluefog_tpu/__init__`` (which imports jax): a shard
+server must start in milliseconds, hold no accelerator state, and cost a
+few MB of RSS, because the churn soak starts and kills them in bulk.
+Importable normally (``bluefog_tpu.runtime.shard_server``) for in-process
+use.
+
+Prints ``BF_SHARD_READY <port>`` on stdout once serving (the spawn-side
+readiness handshake), then blocks until SIGTERM/SIGINT. The job secret
+rides ``BLUEFOG_CP_SECRET`` exactly as for the single-server plane, and
+the server self-publishes its effective mailbox cap under
+``bf.cp.mailbox_cap_bytes`` so attach-time agreement checks can reject a
+mixed-cap cluster loudly (every shard must publish its OWN value — a
+router must never write this key, or a mismatch would be masked).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+if __name__ == "__main__" and __package__ in (None, ""):
+    # Lean bootstrap: register dummy parent packages so the relative
+    # imports below resolve WITHOUT executing bluefog_tpu/__init__ (jax)
+    # or bluefog_tpu/runtime/__init__ (state -> jax).
+    import types
+
+    _here = os.path.dirname(os.path.abspath(__file__))
+    _pkg = os.path.dirname(_here)
+    # replace sys.path[0] (this script's directory — it would shadow the
+    # stdlib `logging` with runtime/logging.py) with the repo root
+    sys.path[0] = os.path.dirname(_pkg)
+    for _name, _path in (("bluefog_tpu", _pkg),
+                         ("bluefog_tpu.runtime", _here)):
+        if _name not in sys.modules:
+            _mod = types.ModuleType(_name)
+            _mod.__path__ = [_path]
+            sys.modules[_name] = _mod
+    __package__ = "bluefog_tpu.runtime"
+
+import argparse
+import signal
+import threading
+
+from .config import knob_env
+from .logging import logger
+from .native import ControlPlaneClient, ControlPlaneServer
+
+READY_MARKER = "BF_SHARD_READY"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bf-shard-server",
+        description="Serve one shard of the bluefog control plane.")
+    p.add_argument("--port", type=int, default=0,
+                   help="TCP port to bind (0 = ephemeral, reported on the "
+                        "READY line)")
+    p.add_argument("--world", type=int, default=1,
+                   help="number of controller processes in the job "
+                        "(barrier arity; must match every shard)")
+    p.add_argument("--shard", type=int, default=0,
+                   help="this shard's index (logging only; routing is "
+                        "decided client-side by key hash)")
+    p.add_argument("--mailbox-max-mb", type=float, default=None,
+                   help="per-mailbox byte cap (default: the "
+                        "BLUEFOG_CP_MAILBOX_MAX_MB registry knob)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    max_mb = args.mailbox_max_mb
+    if max_mb is None:
+        max_mb = float(knob_env("BLUEFOG_CP_MAILBOX_MAX_MB"))
+    cap = int(max_mb * (1 << 20))
+    secret = os.environ.get("BLUEFOG_CP_SECRET", "")
+    srv = ControlPlaneServer(args.world, args.port, secret=secret,
+                             max_mailbox_bytes=cap)
+    # Self-publish the effective cap (value + 1 so 0 still means "not
+    # published") through a loopback client; origins size deposit
+    # pre-checks against the SERVING side's cap, and the attach-time
+    # agreement check compares every shard's copy.
+    try:
+        cl = ControlPlaneClient("127.0.0.1", srv.port, 0, secret=secret,
+                                streams=1)
+        cl.put("bf.cp.mailbox_cap_bytes", cap + 1)
+        cl.close()
+    except OSError as exc:  # serve anyway; attach falls back to its knob
+        logger.warning("shard %d: mailbox-cap self-publish failed (%s)",
+                       args.shard, exc)
+
+    print(f"{READY_MARKER} {srv.port}", flush=True)
+    logger.info("control-plane shard %d serving on port %d (world %d, "
+                "mailbox cap %d bytes)", args.shard, srv.port, args.world,
+                cap)
+
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -254,6 +254,20 @@ enum Op : uint8_t {
   // connection's, the op is answered with the 4-byte kStaleFrame sentinel
   // instead of being applied.
   kAttach = 18,
+  // Replication ops (sharded control plane): the client-side shard router
+  // (runtime/router.py) replicates the membership-critical key families —
+  // the membership epoch, per-rank incarnation mirrors, quarantine phases,
+  // shutdown flags — onto EVERY shard so a shard SIGKILL cannot lose them.
+  //   kPutMax: kv[key] = max(kv[key], arg); reply = the post-merge value.
+  //     Monotone, commutative, idempotent — a delayed duplicate replica
+  //     write can never regress a quarantine phase or incarnation mirror,
+  //     which is exactly the property plain kPut lacks under failover
+  //     reordering.
+  //   kStats: bulk reply carrying this server's telemetry counter block
+  //     (same layout as bf_cp_server_counters) so an external actor —
+  //     `bfrun --status --cp a,b,...`, the soak harness — can merge
+  //     per-shard views without owning the server handle.
+  kPutMax = 19, kStats = 20,
 };
 
 // Reply status codes shared with the Python layer (runtime/native.py):
@@ -644,6 +658,45 @@ struct ControlServer {
   std::atomic<long long> srv_barrier_withdrawals{0};
   std::atomic<long long> srv_dedup_replays{0};
   std::atomic<long long> srv_stale_rejects{0};
+
+  // One counter-block layout, two readers: bf_cp_server_counters (the
+  // in-process owner) and the kStats wire op (external per-shard view
+  // mergers). Takes `mu` itself — callers must NOT hold it.
+  static constexpr int kStatSlots = 32 + 11;
+
+  int FillCounters(long long* out, int n) {
+    if (!out || n < kStatSlots) return -1;
+    for (int i = 0; i < 32; ++i)
+      out[i] = srv_ops[i].load(std::memory_order_relaxed);
+    long long recs = 0, rec_bytes = 0, held = 0, slots = 0, slot_bytes = 0;
+    long long conns, kvn;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      conns = static_cast<long long>(handler_fds.size());
+      for (const auto& it : mailbox)
+        recs += static_cast<long long>(it.second.size());
+      for (const auto& it : box_bytes) rec_bytes += it.second;
+      for (const auto& it : locks)
+        if (it.second.rank != -1) ++held;
+      kvn = static_cast<long long>(kv.size());
+      for (const auto& it : bytes_kv) {
+        ++slots;
+        if (it.second) slot_bytes += static_cast<long long>(it.second->size());
+      }
+    }
+    out[32] = conns;
+    out[33] = recs;
+    out[34] = rec_bytes;
+    out[35] = held;
+    out[36] = srv_lock_force_releases.load(std::memory_order_relaxed);
+    out[37] = srv_barrier_withdrawals.load(std::memory_order_relaxed);
+    out[38] = srv_dedup_replays.load(std::memory_order_relaxed);
+    out[39] = srv_stale_rejects.load(std::memory_order_relaxed);
+    out[40] = kvn;
+    out[41] = slots;
+    out[42] = slot_bytes;
+    return kStatSlots;
+  }
 
   // Has the peer closed its end? Used by blocked lock/barrier waiters: the
   // protocol is strictly request-reply with one outstanding request per
@@ -1087,6 +1140,28 @@ struct ControlServer {
           std::lock_guard<std::mutex> lk(mu);
           kv[key] = arg;
           reply = 1;
+          break;
+        }
+        case kPutMax: {
+          // replication merge: monotone max, so replica writes commute and
+          // a late duplicate can never regress the value
+          std::lock_guard<std::mutex> lk(mu);
+          int64_t& slot = kv[key];
+          if (arg > slot) slot = arg;
+          reply = slot;
+          break;
+        }
+        case kStats: {
+          // remote telemetry read: the same 43-slot counter block
+          // bf_cp_server_counters fills, serialized little-endian for an
+          // external merger (per-shard --status views, the soak harness)
+          long long block[kStatSlots];
+          FillCounters(block, kStatSlots);
+          uint32_t rlen = static_cast<uint32_t>(8 * kStatSlots);
+          if (!WriteAll(fd, &rlen, 4) ||
+              !WriteAll(fd, block, sizeof(block)))
+            return;
+          replied = true;
           break;
         }
         case kGet: {
@@ -2224,39 +2299,27 @@ int bf_flight_ring(long long* out, int max_events) {
 // withdrawals, [38] dedup replays served, [39] fenced (stale) ops,
 // [40] scalar kv entries, [41] bytes slots, [42] bytes-slot payload bytes.
 int bf_cp_server_counters(void* h, long long* out, int n) {
-  const int want = 32 + 11;
-  if (!h || !out || n < want) return -1;
-  auto* srv = static_cast<ControlServer*>(h);
-  for (int i = 0; i < 32; ++i)
-    out[i] = srv->srv_ops[i].load(std::memory_order_relaxed);
-  long long recs = 0, rec_bytes = 0, held = 0, slots = 0, slot_bytes = 0;
-  long long conns, kvn;
-  {
-    std::lock_guard<std::mutex> lk(srv->mu);
-    conns = static_cast<long long>(srv->handler_fds.size());
-    for (const auto& it : srv->mailbox)
-      recs += static_cast<long long>(it.second.size());
-    for (const auto& it : srv->box_bytes) rec_bytes += it.second;
-    for (const auto& it : srv->locks)
-      if (it.second.rank != -1) ++held;
-    kvn = static_cast<long long>(srv->kv.size());
-    for (const auto& it : srv->bytes_kv) {
-      ++slots;
-      if (it.second) slot_bytes += static_cast<long long>(it.second->size());
-    }
-  }
-  out[32] = conns;
-  out[33] = recs;
-  out[34] = rec_bytes;
-  out[35] = held;
-  out[36] = srv->srv_lock_force_releases.load(std::memory_order_relaxed);
-  out[37] = srv->srv_barrier_withdrawals.load(std::memory_order_relaxed);
-  out[38] = srv->srv_dedup_replays.load(std::memory_order_relaxed);
-  out[39] = srv->srv_stale_rejects.load(std::memory_order_relaxed);
-  out[40] = kvn;
-  out[41] = slots;
-  out[42] = slot_bytes;
-  return want;
+  if (!h) return -1;
+  return static_cast<ControlServer*>(h)->FillCounters(out, n);
+}
+
+// Remote counter read over the wire (kStats): same block as
+// bf_cp_server_counters, but fetched through a CLIENT handle — how an
+// external actor (bfrun --status over --cp a,b,..., the soak harness)
+// reads a shard server it does not own. Returns slots filled, or a
+// negative status on wire failure / fenced client.
+int bf_cp_remote_stats(void* h, long long* out, int n) {
+  if (!out || n <= 0) return -1;
+  void* payload = nullptr;
+  int64_t plen = 0;
+  int64_t r = static_cast<ControlClient*>(h)->CallBytes(
+      kStats, "", &payload, &plen);
+  if (r < 0) return static_cast<int>(r);
+  int got = static_cast<int>(plen / 8);
+  if (got > n) got = n;
+  std::memcpy(out, payload, static_cast<size_t>(got) * 8);
+  std::free(payload);
+  return got;
 }
 
 int64_t bf_cp_barrier(void* h, const char* key) {
@@ -2273,6 +2336,9 @@ int64_t bf_cp_fetch_add(void* h, const char* key, int64_t delta) {
 }
 int64_t bf_cp_put(void* h, const char* key, int64_t value) {
   return static_cast<ControlClient*>(h)->Call(kPut, key, value);
+}
+int64_t bf_cp_put_max(void* h, const char* key, int64_t value) {
+  return static_cast<ControlClient*>(h)->Call(kPutMax, key, value);
 }
 int64_t bf_cp_get(void* h, const char* key) {
   return static_cast<ControlClient*>(h)->Call(kGet, key, 0);

@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Control-plane churn soak: N shard servers vs hundreds of raw clients.
+
+Scenario coverage no unit test reaches (ROADMAP "Control-plane scale-out +
+1000-rank soak"): 500-1000 lightweight raw clients — no JAX anywhere in
+this harness — hammering heartbeats, locks, fetch_add counters, and
+deposit/drain cycles against a SHARDED control plane while the harness
+SIGKILLs a server mid-run and (with ``--churn``) rolls clients through
+incarnation-bumped reattach cycles. Asserted invariants:
+
+* **health convergence** — after the kill, every client's router converges
+  on the same dead-shard set (peer-published failover flags + its own
+  detection), and a fresh probe sees every client's final heartbeat;
+* **exactly-once counters** — each client's private counter hands out
+  contiguous pre-add values within an ownership era (a dedup failure
+  would duplicate or skip); across the failover boundary the era resets
+  at most once, exactly when ownership moved;
+* **conserved deposit mass** — per client, bytes acked == bytes drained
+  + bytes lost, and bytes can only be lost by the kill landing between
+  an append-ack and the drain (at most one cycle per client per kill);
+* **bounded server memory** — surviving servers' VmRSS stays under
+  ``--rss-limit-mb`` despite the churn (dedup GC + incarnation GC work).
+
+Invocations:
+    python scripts/cp_soak.py --clients 500 --churn      # the ROADMAP soak
+    python scripts/cp_soak.py --quick                    # make soak-smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+
+# Lean bootstrap (no jax): register dummy parent packages so the runtime
+# modules import without executing bluefog_tpu/__init__.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_ROOT, "bluefog_tpu")
+sys.path.insert(0, _ROOT)
+for _name, _path in (("bluefog_tpu", _PKG),
+                     ("bluefog_tpu.runtime", os.path.join(_PKG, "runtime"))):
+    if _name not in sys.modules:
+        _mod = types.ModuleType(_name)
+        _mod.__path__ = [_path]
+        sys.modules[_name] = _mod
+
+from bluefog_tpu.runtime.native import (  # noqa: E402
+    ControlPlaneClient, PeerLostError, load)
+from bluefog_tpu.runtime.router import ShardRouter  # noqa: E402
+
+SHARD_SERVER = os.path.join(_PKG, "runtime", "shard_server.py")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--shards", type=int, default=2)
+    p.add_argument("--clients", type=int, default=128)
+    p.add_argument("--duration", type=float, default=30.0,
+                   help="seconds of load (the kill lands mid-way)")
+    p.add_argument("--churn", action="store_true",
+                   help="clients periodically close and reattach with a "
+                        "bumped incarnation (elastic-membership churn)")
+    p.add_argument("--kill-shard", type=int, default=None,
+                   help="shard index to SIGKILL mid-run (default: the "
+                        "last shard; negative disables the kill)")
+    p.add_argument("--rss-limit-mb", type=float, default=512.0)
+    p.add_argument("--record-bytes", type=int, default=2048,
+                   help="max deposit record size")
+    p.add_argument("--quick", action="store_true",
+                   help="smoke preset (<= 60 s): 64 clients, 2 shards, "
+                        "~18 s of load, churn on, one injected kill")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.shards = 2
+        args.clients = min(args.clients, 64)
+        args.duration = min(args.duration, 18.0)
+        args.churn = True
+    if args.kill_shard is None:
+        args.kill_shard = args.shards - 1
+    return args
+
+
+def spawn_shard(index: int, world: int):
+    proc = subprocess.Popen(
+        [sys.executable, SHARD_SERVER, "--port", "0", "--world", str(world),
+         "--shard", str(index)],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("BF_SHARD_READY"):
+        raise RuntimeError(f"shard {index} failed to start: {line!r}")
+    return proc, int(line.split()[1])
+
+
+def vm_rss_mb(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+class Worker(threading.Thread):
+    """One raw client: heartbeat + counter + lock + deposit/drain loop."""
+
+    def __init__(self, wid: int, endpoints, deadline: float, churn: bool,
+                 record_bytes: int) -> None:
+        super().__init__(daemon=True, name=f"soak-{wid}")
+        self.wid = wid
+        self.endpoints = endpoints
+        self.deadline = deadline
+        self.churn = churn
+        self.rng = random.Random(1000 + wid)
+        self.record_bytes = max(64, record_bytes)
+        self.inc = 0
+        self.errors: list = []
+        # ledgers
+        self.ops = 0
+        self.acked_bytes = 0
+        self.drained_bytes = 0
+        self.lost_bytes = 0
+        self.lost_cycles = 0
+        self.reattaches = 0
+        self.peer_lost = 0
+        self.last_hb = 0
+        self.dead_seen: set = set()
+        self.counter_eras = 1
+        self.counter_acks = 0
+
+    def _attach(self) -> ShardRouter:
+        # Same contract as control_plane.attach: retry the connect for a
+        # bounded window — a reattach can land in the instant AFTER a
+        # shard died but BEFORE any survivor published its dead flag, and
+        # the strict router correctly refuses until the flag appears.
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                return ShardRouter(self.endpoints, self.wid, streams=1,
+                                   incarnation=self.inc)
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+
+    def run(self) -> None:  # noqa: C901 — the soak loop is one scenario
+        ckey = f"soak.ctr.{self.wid}"
+        box = f"soak.box.{self.wid}"
+        hb = f"soak.hb.{self.wid}"
+        try:
+            r = self._attach()
+        except Exception as exc:  # noqa: BLE001 — recorded, fails the soak
+            self.errors.append(f"attach: {exc!r}")
+            return
+        expected = None
+        cur_owner = r.owner_of(ckey)
+        next_churn = time.monotonic() + self.rng.uniform(4.0, 8.0)
+        next_poll = time.monotonic() + self.rng.uniform(0.5, 1.5)
+        try:
+            while time.monotonic() < self.deadline:
+                self.ops += 1
+                # heartbeat
+                self.last_hb += 1
+                r.put(hb, self.last_hb)
+                # exactly-once counter, era-checked: within one ownership
+                # era the pre-add values must be contiguous (a dedup slip
+                # duplicates or skips); a failover resets the era because
+                # the dead shard's counter state died with it
+                owner = r.owner_of(ckey)
+                if owner != cur_owner:
+                    cur_owner, expected = owner, None
+                    self.counter_eras += 1
+                pre = r.fetch_add(ckey, 1)
+                self.counter_acks += 1
+                owner2 = r.owner_of(ckey)
+                if owner2 != cur_owner:
+                    cur_owner, expected = owner2, pre + 1
+                    self.counter_eras += 1
+                elif expected is None:
+                    expected = pre + 1
+                else:
+                    if pre != expected:
+                        self.errors.append(
+                            f"counter era violation: pre={pre} "
+                            f"expected={expected}")
+                    expected = pre + 1
+                # occasional contended lock (typed degradation tolerated)
+                if self.ops % 7 == 0:
+                    lk = f"soak.lock.{self.wid % 8}"
+                    try:
+                        r.lock(lk)
+                        r.unlock(lk)
+                    except PeerLostError:
+                        self.peer_lost += 1
+                # deposit/drain cycle with a mass ledger: bytes can only
+                # be lost when the kill lands between ack and drain
+                nrec = self.rng.randint(1, 4)
+                blobs = [bytes([self.rng.randint(0, 255)]) *
+                         self.rng.randint(64, self.record_bytes)
+                         for _ in range(nrec)]
+                replies = r.append_bytes_many([box] * nrec, blobs)
+                cycle_acked = sum(
+                    len(b) for b, rep in zip(blobs, replies) if rep >= 1)
+                self.acked_bytes += cycle_acked
+                drained = sum(len(x) for lst in r.take_bytes_many([box])
+                              for x in lst)
+                self.drained_bytes += drained
+                if drained < cycle_acked:
+                    self.lost_bytes += cycle_acked - drained
+                    self.lost_cycles += 1
+                elif drained > cycle_acked:
+                    self.errors.append(
+                        f"drained {drained} > acked {cycle_acked} "
+                        "(duplicated deposit records)")
+                now = time.monotonic()
+                if now >= next_poll:
+                    self.dead_seen |= r.poll_shard_health()
+                    next_poll = now + self.rng.uniform(0.5, 1.5)
+                if self.churn and now >= next_churn:
+                    # elastic churn: the respawn path — close, bump the
+                    # incarnation, reattach (servers fence the zombie and
+                    # GC its dedup/mailbox state on every shard)
+                    r.close()
+                    self.inc += 1
+                    r = self._attach()
+                    cur_owner, expected = r.owner_of(ckey), None
+                    self.reattaches += 1
+                    next_churn = now + self.rng.uniform(4.0, 8.0)
+            self.dead_seen |= r.poll_shard_health()
+        except Exception as exc:  # noqa: BLE001 — recorded, fails the soak
+            self.errors.append(f"loop died at op {self.ops}: {exc!r}")
+        finally:
+            try:
+                r.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if load() is None:
+        print("cp_soak: native runtime unavailable", file=sys.stderr)
+        return 1
+    t0 = time.time()
+    os.environ.setdefault("BLUEFOG_CP_BACKOFF_MS", "20")
+    servers = [spawn_shard(i, 1) for i in range(args.shards)]
+    endpoints = [("127.0.0.1", port) for _, port in servers]
+    print(f"cp_soak: {args.shards} shard(s) up "
+          f"({','.join(str(p) for _, p in servers)}); "
+          f"{args.clients} client(s), {args.duration:.0f}s"
+          + (", churn" if args.churn else "")
+          + (f", SIGKILL shard {args.kill_shard} mid-run"
+             if args.kill_shard >= 0 else ""))
+
+    deadline = time.monotonic() + args.duration
+    workers = [Worker(i, endpoints, deadline, args.churn, args.record_bytes)
+               for i in range(args.clients)]
+    for w in workers:
+        w.start()
+
+    killed = None
+    if 0 <= args.kill_shard < args.shards:
+        time.sleep(args.duration * 0.45)
+        victim, _ = servers[args.kill_shard]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        killed = args.kill_shard
+        print(f"cp_soak: SIGKILLed shard {killed} at t+{args.duration * 0.45:.0f}s")
+
+    for w in workers:
+        w.join(timeout=args.duration + 120)
+    stuck = [w.wid for w in workers if w.is_alive()]
+
+    failures: list = []
+    if stuck:
+        failures.append(f"{len(stuck)} client(s) never finished: "
+                        f"{stuck[:10]}")
+    for w in workers:
+        for e in w.errors:
+            failures.append(f"client {w.wid}: {e}")
+        if w.lost_cycles > (1 if killed is not None else 0):
+            failures.append(
+                f"client {w.wid}: {w.lost_cycles} lossy deposit cycles "
+                "(only the kill window may lose one)")
+        if w.acked_bytes != w.drained_bytes + w.lost_bytes:
+            failures.append(
+                f"client {w.wid}: mass leak — acked {w.acked_bytes} != "
+                f"drained {w.drained_bytes} + lost {w.lost_bytes}")
+        if killed is not None and not stuck and \
+                w.dead_seen != {killed} and killed not in w.dead_seen:
+            failures.append(
+                f"client {w.wid}: never converged on dead shard "
+                f"{killed} (saw {sorted(w.dead_seen)})")
+
+    # fresh probe: health view converges from the outside too, and every
+    # client's final heartbeat reads back through failover routing
+    probe = ShardRouter(endpoints, 10 ** 6, streams=1, lenient=True)
+    probe.poll_shard_health()
+    if killed is not None and killed not in probe.dead_shards():
+        failures.append(
+            f"probe router did not converge on dead shard {killed}")
+    finished = [w for w in workers if not w.is_alive() and not w.errors]
+    hb_vals = probe.get_many([f"soak.hb.{w.wid}" for w in finished])
+    hb_bad = sum(1 for w, v in zip(finished, hb_vals) if v != w.last_hb)
+    # a heartbeat written to the victim's keyspace JUST before the kill is
+    # allowed to be stale only if the client never wrote again after
+    # failover — it always does (the loop outlives the kill), so mismatch
+    # means failover routing diverged between writer and prober
+    if hb_bad:
+        failures.append(f"{hb_bad} final heartbeat(s) unreadable through "
+                        "failover routing")
+
+    rss = {i: vm_rss_mb(proc.pid) for i, (proc, _) in enumerate(servers)
+           if i != killed}
+    for i, mb in rss.items():
+        if mb > args.rss_limit_mb:
+            failures.append(f"shard {i} RSS {mb:.0f} MB exceeds the "
+                            f"{args.rss_limit_mb:.0f} MB bound")
+
+    total_ops = sum(w.ops for w in workers)
+    total_acked = sum(w.acked_bytes for w in workers)
+    total_lost = sum(w.lost_bytes for w in workers)
+    lossy = sum(w.lost_cycles for w in workers)
+    print(f"cp_soak: {total_ops} cycles, "
+          f"{sum(w.counter_acks for w in workers)} counter acks "
+          f"({sum(w.counter_eras for w in workers)} eras), "
+          f"{total_acked / 1e6:.1f} MB deposited, "
+          f"{total_lost} B lost in {lossy} kill-window cycle(s), "
+          f"{sum(w.reattaches for w in workers)} churn reattaches, "
+          f"{sum(w.peer_lost for w in workers)} typed PeerLost, "
+          f"survivor RSS {max(rss.values()):.0f} MB, "
+          f"wall {time.time() - t0:.1f}s")
+
+    for i, (proc, _) in enumerate(servers):
+        if proc.poll() is None:
+            proc.terminate()
+    for proc, _ in servers:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    if failures:
+        print("cp_soak: FAIL", file=sys.stderr)
+        for f in failures[:40]:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("cp_soak: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

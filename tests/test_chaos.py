@@ -1179,6 +1179,247 @@ def test_healed_tables_cached_per_dead_set(bf_hosted_cp, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# sharded control plane: routing, replication, SIGKILL failover (ISSUE r14)
+# ---------------------------------------------------------------------------
+
+import signal  # noqa: E402 — grouped with the shard helpers that use it
+
+SHARD_SERVER = TESTS.parent / "bluefog_tpu" / "runtime" / "shard_server.py"
+
+
+def _spawn_shard(i: int, world: int = 1):
+    proc = subprocess.Popen(
+        [sys.executable, str(SHARD_SERVER), "--port", "0",
+         "--world", str(world), "--shard", str(i)],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("BF_SHARD_READY"), f"shard {i}: {line!r}"
+    return proc, int(line.split()[1])
+
+
+def _stop_shards(servers):
+    for proc, _ in servers:
+        if proc.poll() is None:
+            proc.terminate()
+    for proc, _ in servers:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+@pytest.fixture()
+def shard_pair(monkeypatch):
+    """Two real shard server PROCESSES (SIGKILL-able) + fast reconnects."""
+    monkeypatch.setenv("BLUEFOG_CP_BACKOFF_MS", "20")
+    servers = [_spawn_shard(i) for i in range(2)]
+    yield servers
+    native.fault_disarm()
+    _stop_shards(servers)
+
+
+def _endpoints(servers):
+    return [("127.0.0.1", port) for _, port in servers]
+
+
+def test_shard_failover_fetch_add_exactly_once(shard_pair):
+    """Acceptance: fetch_add stays exactly-once ACROSS the failover
+    boundary, composed with wire-drop injection. Pre-kill the victim
+    shard's counter hands out contiguous pre-add values under drops (the
+    r8 dedup); the SIGKILL reroutes the key to the replica where the era
+    restarts at 0 and stays contiguous — a double-apply would skip a
+    value, a lost apply would repeat one, on either side of the kill."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    r = ShardRouter(_endpoints(shard_pair), 0, streams=1)
+    key = next(f"fo.ctr.{j}" for j in range(64)
+               if r.shard_of(f"fo.ctr.{j}") == 1)
+    native.fault_arm(f"drop_after=6,seed={_seed(23)}")
+    pre = [r.fetch_add(key, 1) for _ in range(25)]
+    assert pre == list(range(25)), "pre-kill era lost exactly-once"
+    proc, _ = shard_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    post = [r.fetch_add(key, 1) for _ in range(25)]
+    drops = native.fault_stats()["drops"]
+    native.fault_disarm()
+    assert drops >= 3, f"only {drops} drops injected"
+    # typed degradation: the shard is named dead, nothing raised
+    assert r.dead_shards() == {1}
+    assert post == list(range(25)), "failover era lost exactly-once"
+    assert r.get(key) == 25
+    r.close()
+
+
+def test_shard_mailbox_failover_mass_conserved(shard_pair):
+    """Deposit/drain cycles across a shard SIGKILL conserve mass exactly
+    when the kill lands between drains (the documented failover window):
+    every acked byte is drained, including the cycles whose mailboxes
+    rerouted to the replica."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    r = ShardRouter(_endpoints(shard_pair), 0, streams=1)
+    rng = np.random.default_rng(_seed(29))
+    boxes = [f"mb.{k}" for k in range(6)]
+    assert {r.shard_of(b) for b in boxes} == {0, 1}, \
+        "want mailboxes on both shards"
+    acked = drained = 0
+
+    def cycle():
+        nonlocal acked, drained
+        names, blobs = [], []
+        for b in boxes:
+            for _ in range(2):
+                names.append(b)
+                blobs.append(bytes(rng.integers(
+                    0, 256, size=int(rng.integers(64, 2048)),
+                    dtype=np.uint8)))
+        replies = r.append_bytes_many(names, blobs)
+        acked += sum(len(b) for b, rep in zip(blobs, replies) if rep >= 1)
+        drained += sum(len(x) for lst in r.take_bytes_many(boxes)
+                       for x in lst)
+
+    for _ in range(3):
+        cycle()
+    proc, _ = shard_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    for _ in range(3):
+        cycle()
+    assert r.dead_shards() == {1}
+    assert acked == drained, \
+        f"deposit mass not conserved across failover: {acked} != {drained}"
+    r.close()
+
+
+def test_shard_replicated_membership_state_survives_kill(shard_pair):
+    """The membership-critical keys — epoch, quarantine phases, the
+    incarnation table — are replicated on every shard: a SIGKILL loses
+    none of them, and a zombie incarnation is still fenced by the
+    survivor alone."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    eps = _endpoints(shard_pair)
+    fresh = ShardRouter(eps, 7, streams=1, incarnation=1)
+    r = ShardRouter(eps, 0, streams=1)
+    r.put("bf.q.7.1", 1)
+    r.put("bf.q.7.1", 2)        # quarantine phases are monotone
+    e0 = r.get("bf.membership.epoch")
+    e1 = r.fetch_add("bf.membership.epoch", 1)
+    assert e1 >= e0
+    proc, _ = shard_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    assert r.get("bf.q.7.1") == 2, "quarantine phase lost with the shard"
+    assert r.get("bf.membership.epoch") >= e1 + 1, \
+        "membership epoch regressed after failover"
+    # the survivor's incarnation table still fences the zombie on its own
+    with pytest.raises(native.StaleIncarnationError):
+        ShardRouter(eps, 7, streams=1, incarnation=0)
+    fresh.close()
+    r.close()
+
+
+def test_shard_attach_strictness_vs_flagged_death(shard_pair):
+    """A FRESH job must not attach with a down, unflagged shard (it would
+    run with less replication than configured); once a survivor has
+    flagged the death, a (re)attach into the degraded cluster succeeds —
+    the elastic-respawn path."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    eps = _endpoints(shard_pair)
+    proc, _ = shard_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    with pytest.raises(OSError, match="not flagged dead"):
+        ShardRouter(eps, 0, streams=1)
+    cl = native.ControlPlaneClient("127.0.0.1", shard_pair[0][1], 9,
+                                   streams=1)
+    cl.put_max("bf.cp.shard_dead.1", 1)
+    cl.close()
+    r = ShardRouter(eps, 0, streams=1)
+    assert r.dead_shards() == {1}
+    r.put("deg.x", 5)
+    assert r.get("deg.x") == 5
+    r.close()
+
+
+def test_shard_kill_mid_gossip_run_completes(monkeypatch):
+    """Survivability demo (acceptance): a window-optimizer gossip run over
+    a 2-shard control plane completes its steps after one shard is
+    SIGKILLed mid-run, with ZERO lost deposits — every rank's mixed
+    parameters match the fault-free numpy oracle exactly (the oracle IS
+    the mass-conservation check: a lost deposit would break the uniform
+    average), and the dead shard is reported typed instead of raising."""
+    import bluefog_tpu as bf
+    import jax.numpy as jnp
+    import optax
+
+    from conftest import cpu_devices
+
+    servers = [_spawn_shard(i) for i in range(2)]
+    try:
+        eps = ",".join(f"127.0.0.1:{p}" for _, p in servers)
+        for k, v in {
+            "BLUEFOG_CP_HOSTS": eps,
+            "BLUEFOG_CP_WORLD": "1",
+            "BLUEFOG_CP_RANK": "0",
+            "BLUEFOG_CP_BACKOFF_MS": "20",
+            # pure hosted plane: every gossip edge rides the (sharded)
+            # control-plane wire, so the failover is actually load-bearing
+            "BLUEFOG_WIN_PLANE": "hosted",
+            "BLUEFOG_WIN_HOST_PLANE": "1",
+        }.items():
+            monkeypatch.setenv(k, v)
+        cp.reset_for_test()
+        bf.init(devices=cpu_devices(8))
+        assert cp.active()
+        assert getattr(cp.client(), "shard_count", 1) == 2
+
+        def loss_fn(params, batch):
+            return jnp.sum((params["w"] - batch) ** 2)
+
+        opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1), loss_fn=loss_fn)
+        state = opt.init({"w": jnp.zeros((2,), jnp.float32)})
+        batch = bf.shard_rank_stacked(
+            bf.mesh(), np.arange(8, dtype=np.float32).reshape(8, 1))
+        try:
+            topo = bf.load_topology()
+            in_nbrs = {r: bf.topology_util.in_neighbor_ranks(topo, r)
+                       for r in range(8)}
+            w = np.zeros((8, 2), np.float64)  # fault-free oracle state
+
+            def oracle_step():
+                nonlocal w
+                wl = w - 0.1 * 2.0 * (w - np.arange(8.0).reshape(8, 1))
+                mixed = np.zeros_like(wl)
+                for r in range(8):
+                    u = 1.0 / (len(in_nbrs[r]) + 1)
+                    mixed[r] = u * (wl[r] + sum(wl[s] for s in in_nbrs[r]))
+                w = mixed
+
+            for _ in range(2):  # healthy warm-up over both shards
+                state, _ = opt.step(state, batch)
+                oracle_step()
+            proc, _ = servers[1]
+            proc.send_signal(signal.SIGKILL)  # mid-run: between steps,
+            proc.wait()                       # mailboxes drained
+            for _ in range(2):  # must complete after failover — no hang
+                state, _ = opt.step(state, batch)
+                oracle_step()
+            got = np.asarray(state.params["w"])
+            np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+            assert cp.client().dead_shards() == {1}
+        finally:
+            opt.free()
+    finally:
+        bf.shutdown()
+        cp.reset_for_test()
+        _stop_shards(servers)
+
+
+# ---------------------------------------------------------------------------
 # end-to-end quarantined rejoin through bf.init (subprocess)
 # ---------------------------------------------------------------------------
 

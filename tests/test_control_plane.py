@@ -367,3 +367,111 @@ def test_topo_check_rearm_catches_desynced_schedule(bf_cp_world2):
     # counter — no per-round key accumulation (ADVICE r4)
     assert peer.get("tc.rearm.tickets") == 4
     assert peer.get("tc.rearm.0") and peer.get("tc.rearm.1")
+
+
+# ---------------------------------------------------------------------------
+# shard router unit behaviors (sharded control plane, ISSUE r14)
+# ---------------------------------------------------------------------------
+
+from bluefog_tpu.runtime.router import (ShardRouter, is_replicated_key,  # noqa: E402
+                                        parse_endpoints)
+
+
+def test_parse_endpoints_grammar():
+    assert parse_endpoints("a:1,b:2") == [("a", 1), ("b", 2)]
+    assert parse_endpoints(" a:1 , b:2 ") == [("a", 1), ("b", 2)]
+    assert parse_endpoints("") == []
+    with pytest.raises(ValueError):
+        parse_endpoints("nocolon")
+    with pytest.raises(ValueError):
+        parse_endpoints("a:not_a_port")
+
+
+def test_replicated_key_classes():
+    """The replication boundary is load-bearing: membership-critical keys
+    must survive a shard death, everything else is routed. A key family
+    moving between classes is a protocol change, not a refactor."""
+    for k in ("bf.membership.epoch", "bf.inc.3", "bf.q.2.5",
+              "bf.shutdown.flag.1", "bf.shutdown.ack.0",
+              "bf.cp.mailbox_cap_bytes", "bf.cp.shard_dead.0"):
+        assert is_replicated_key(k), k
+    for k in ("bf.hb.0", "bf.metrics.1", "bf.flight.0",
+              "w.opt.ver.3", "w.opt.dep.1.0", "w.opt.self.2"):
+        assert not is_replicated_key(k), k
+
+
+@pytest.fixture()
+def shard_trio():
+    servers = [native.ControlPlaneServer(1, _free_port()) for _ in range(3)]
+    yield servers
+    for s in servers:
+        s.stop()
+
+
+def test_router_routing_is_stable_and_spread(shard_trio):
+    r = ShardRouter([("127.0.0.1", s.port) for s in shard_trio], 0,
+                    streams=1)
+    names = [f"ob.{i}" for i in range(64)]
+    owners = [r.shard_of(n) for n in names]
+    assert owners == [r.shard_of(n) for n in names]  # pure + stable
+    assert set(owners) == {0, 1, 2}                  # spread over all shards
+    r.close()
+
+
+def test_router_batches_preserve_caller_order(shard_trio):
+    """Batch ops partition per shard and scatter results back by POSITION:
+    callers must see results aligned with their name order regardless of
+    how the names spread across shards."""
+    r = ShardRouter([("127.0.0.1", s.port) for s in shard_trio], 0,
+                    streams=1)
+    names = [f"ob.{i}" for i in range(40)]
+    r.put_many(names, list(range(40)))
+    assert r.get_many(names) == list(range(40))
+    assert r.fetch_add_many(names, deltas=[2] * 40) == list(range(40))
+    assert r.get_many(names) == [i + 2 for i in range(40)]
+    r.append_bytes_many(names, [str(i).encode() for i in range(40)])
+    assert r.box_bytes_many(names) == [len(str(i)) for i in range(40)]
+    recs = r.take_bytes_many(names)
+    assert [lst[0] for lst in recs] == [str(i).encode() for i in range(40)]
+    recs, owner = r.take_bytes_many_views(names)
+    assert all(lst == [] for lst in recs)  # already drained
+    owner.close()
+    r.close()
+
+
+def test_router_replicated_write_lands_on_every_shard(shard_trio):
+    r = ShardRouter([("127.0.0.1", s.port) for s in shard_trio], 0,
+                    streams=1)
+    r.put("bf.q.4.2", 2)
+    e = r.fetch_add("bf.membership.epoch", 1)
+    for s in shard_trio:
+        probe = native.ControlPlaneClient("127.0.0.1", s.port, 9, streams=1)
+        assert probe.get("bf.q.4.2") == 2
+        assert probe.get("bf.membership.epoch") >= e + 1
+        probe.close()
+    # monotone merge: a delayed lower write cannot regress the phase
+    r.put("bf.q.4.2", 1)
+    assert r.get("bf.q.4.2") == 2
+    r.close()
+
+
+def test_single_endpoint_attach_stays_plain_client(monkeypatch):
+    """Satellite guarantee: the world-1 single-endpoint path keeps the
+    plain ControlPlaneClient, byte for byte — no router in the loop."""
+    srv = native.ControlPlaneServer(1, _free_port())
+    try:
+        for k, v in {
+            "BLUEFOG_CP_HOST": "127.0.0.1",
+            "BLUEFOG_CP_PORT": str(srv.port),
+            "BLUEFOG_CP_WORLD": "1",
+            "BLUEFOG_CP_RANK": "0",
+            "BLUEFOG_CP_SERVE": "0",
+        }.items():
+            monkeypatch.setenv(k, v)
+        cp.reset_for_test()
+        cl = cp.attach()
+        assert isinstance(cl, native.ControlPlaneClient)
+        assert not isinstance(cl, ShardRouter)
+    finally:
+        cp.reset_for_test()
+        srv.stop()
