@@ -1,0 +1,368 @@
+"""Wire codecs for the hosted gossip plane: shrink the deposit payload.
+
+Compressed decentralized gossip (CHOCO-SGD, Koloskova et al.; EF-SGD,
+Stich et al.) multiplies every MB/s the transport layers bought by
+shrinking the wire itself: the r6 deposit format already ships payloads
+in a *wire dtype* and folds them in a *wide dtype*, so inserting a codec
+between ``pack_row`` and the ``_finish_deposit`` fold is a pure payload
+transform — scalars (push-sum p, versions, mutexes) never compress.
+
+Three codec families (``BLUEFOG_WIN_CODEC``, default ``none`` — the
+legacy wire stays byte-identical and is test-pinned):
+
+* ``int8``  — per-block symmetric quantization: each block of
+  ``BLUEFOG_WIN_CODEC_BLOCK`` elements carries one f32 scale
+  (``amax / 127``) and int8 codes. ~4x for f32 windows, ~2x for bf16.
+* ``fp8``   — per-block scale to the float8_e4m3 grid (``amax / 448``)
+  plus 1-byte codes; keeps ~3 mantissa bits where int8 keeps ~7 around
+  the block max — better for heavy-tailed rows.
+* ``topk:<frac>`` — top-k sparsification by magnitude (index+value
+  records) with **error feedback**: the sender adds its residual before
+  selecting, and keeps ``(input + residual) - decode(encode(...))`` for
+  the next step, so dropped mass is delayed, never lost (the EF-SGD
+  convergence argument). ``topk`` alone means ``topk:0.01``.
+
+Every encoded payload is self-describing (block size / k ride the
+payload, not the environment), so a cross-controller knob mismatch can
+at worst produce a codec-id mismatch error, never a silent misparse.
+
+Push-sum rule: codecs compress the NUMERATOR payload only; the
+associated-p contribution ships exact in the deposit header (f64), so
+``sum(mass) == sum(minted)`` gauges stay green under any codec. Top-k's
+residual holds numerator mass *transiently* (it arrives on later
+steps); quantization is per-deposit and unbiased up to rounding.
+
+The compiled (ppermute) plane has no wire to shrink, but the
+quantization codecs still apply *numerically* through
+:func:`quantize_blend` in the mail-dtype blend, so a hybrid partition's
+compiled and hosted edges see the same value grid. Top-k does not apply
+there (a dense exchange has no index records).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+from ..runtime.config import knob_env
+from ..runtime.logging import logger
+
+# codec ids: ride the deposit header's mode byte (high nibble), so id 0
+# MUST mean "no codec" — the legacy mode byte is 0 (put) or 1 (acc) and
+# stays byte-identical when no codec is configured.
+CODEC_NONE = 0
+CODEC_INT8 = 1
+CODEC_FP8 = 2
+CODEC_TOPK = 3
+
+_F8_MAX = 448.0  # float8_e4m3 largest finite magnitude
+_DEFAULT_TOPK_FRAC = 0.01
+
+
+def _block_size() -> int:
+    b = int(knob_env("BLUEFOG_WIN_CODEC_BLOCK") or 4096)
+    return max(64, b)
+
+
+def _f8_dtype():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def _as_f32_flat(arr) -> np.ndarray:
+    return np.ascontiguousarray(arr).reshape(-1).astype(np.float32,
+                                                        copy=False)
+
+
+def _blocked(flat: np.ndarray, block: int):
+    """(padded [nb, block] view, nb). Padding is zeros (quantizes to 0)."""
+    n = flat.size
+    nb = max(1, -(-n // block))
+    pad = nb * block - n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    return flat.reshape(nb, block), nb
+
+
+def _scales(blocks: np.ndarray, full: float) -> np.ndarray:
+    """Per-block f32 scale mapping each block's amax onto ``full``.
+
+    ``max(max, -min)`` instead of ``max(abs)``: two reduction passes
+    that WRITE nothing, where ``np.abs`` materializes (and page-faults)
+    a full row-sized temporary on the 100 MB encode hot path."""
+    amax = np.maximum(blocks.max(axis=1), -blocks.min(axis=1))
+    return (amax / full).astype(np.float32)
+
+
+def _scale_inplace(x: np.ndarray, scale: np.ndarray, block: int,
+                   count: int) -> None:
+    """``x[i] *= scale[i // block]`` without materializing a repeated
+    scale vector (the decode hot path runs at 100 MB row scale)."""
+    nf = count // block
+    if nf:
+        x[:nf * block].reshape(nf, block)[...] *= scale[:nf, None]
+    if count > nf * block:
+        x[nf * block:] *= scale[nf]
+
+
+class WireCodec:
+    """One codec: flat wire-dtype row -> self-describing uint8 payload."""
+
+    cid = CODEC_NONE
+    name = "none"
+    error_feedback = False
+    # whether ABSOLUTE state (the published "exposed window" rows) may
+    # ride this codec: true for the quantizers (a bounded-error dense
+    # approximation), false for top-k (dropping coordinates from a state
+    # snapshot would zero them for every reader)
+    state_codec = False
+    # static wire-bytes / raw-bytes estimate (f32 rows): what the plane
+    # planner's size floor uses before measured attribution is ingested
+    nominal_ratio = 1.0
+
+    def encode(self, arr: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def decode(self, raw, dtype, count: int, scale_mul=None, out=None):
+        """Decode ``raw`` back to ``count`` elements of ``dtype``.
+
+        ``scale_mul``: fold a scalar (the deposit's edge weight) into the
+        payload's own scale records — per-BLOCK work instead of a full
+        per-element multiply pass. ``out``: decode straight into a
+        caller-provided flat f32 buffer (the put-mode mailbox slot),
+        skipping the intermediate row allocation entirely; returns
+        ``out``. Both are pure hot-path levers — semantics match the
+        plain form bit for bit for ``scale_mul=None``."""
+        raise NotImplementedError
+
+
+class Int8Codec(WireCodec):
+    """Per-block symmetric int8: ``q = round(x * 127 / amax_block)``."""
+
+    cid = CODEC_INT8
+    name = "int8"
+    state_codec = True
+    nominal_ratio = 0.26  # 1/4 + per-block scale overhead
+
+    def encode(self, arr) -> np.ndarray:
+        flat = _as_f32_flat(arr)
+        n = flat.size
+        block = _block_size()
+        b, nb = _blocked(flat, block)
+        scale = _scales(b, 127.0)
+        safe = np.where(scale > 0, scale, np.float32(1.0))
+        # one temporary + two in-place passes; no clip needed — |b| <=
+        # amax by construction, so |t| <= 127 + ulp and rint lands on
+        # [-127, 127] exactly
+        t = b * (np.float32(1.0) / safe)[:, None]
+        np.rint(t, out=t)
+        q = t.astype(np.int8)
+        # exactly n code bytes on the wire — the tail block's padding
+        # never ships (it would be 2x overhead for a just-over-one-block
+        # row)
+        out = np.empty(4 + 4 * nb + n, np.uint8)
+        out[:4] = np.frombuffer(struct.pack("<I", block), np.uint8)
+        out[4:4 + 4 * nb] = scale.view(np.uint8)
+        out[4 + 4 * nb:] = q.reshape(-1)[:n].view(np.uint8)
+        return out
+
+    def decode(self, raw, dtype, count: int, scale_mul=None, out=None):
+        raw = np.frombuffer(raw, np.uint8) if isinstance(
+            raw, (bytes, bytearray, memoryview)) else raw.reshape(-1)
+        block, = struct.unpack("<I", raw[:4].tobytes())
+        nb = max(1, -(-count // block))
+        scale = raw[4:4 + 4 * nb].view(np.float32)
+        if scale_mul is not None and scale_mul != 1.0:
+            scale = scale * np.float32(scale_mul)  # nb floats, not count
+        q = raw[4 + 4 * nb:4 + 4 * nb + count].view(np.int8)
+        if out is not None:
+            if count == nb * block:
+                # ONE fused pass: int8 * per-block scale straight into
+                # the caller's buffer (the mailbox slot) — no cast copy
+                np.multiply(q.reshape(nb, block), scale[:, None],
+                            out=out.reshape(nb, block), casting="unsafe")
+            else:
+                np.copyto(out, q, casting="unsafe")  # int8 -> f32 cast
+                _scale_inplace(out, scale, block, count)
+            return out
+        x = q.astype(np.float32)
+        _scale_inplace(x, scale, block, count)
+        return x.astype(dtype, copy=False)
+
+
+class Fp8Codec(WireCodec):
+    """Per-block scaled float8_e4m3: relative precision across the block."""
+
+    cid = CODEC_FP8
+    name = "fp8"
+    state_codec = True
+    nominal_ratio = 0.26
+
+    def encode(self, arr) -> np.ndarray:
+        flat = _as_f32_flat(arr)
+        n = flat.size
+        block = _block_size()
+        b, nb = _blocked(flat, block)
+        scale = _scales(b, _F8_MAX)
+        safe = np.where(scale > 0, scale, np.float32(1.0))
+        q = (b / safe[:, None]).astype(_f8_dtype())
+        out = np.empty(4 + 4 * nb + n, np.uint8)
+        out[:4] = np.frombuffer(struct.pack("<I", block), np.uint8)
+        out[4:4 + 4 * nb] = scale.view(np.uint8)
+        out[4 + 4 * nb:] = q.reshape(-1)[:n].view(np.uint8)
+        return out
+
+    def decode(self, raw, dtype, count: int, scale_mul=None, out=None):
+        raw = np.frombuffer(raw, np.uint8) if isinstance(
+            raw, (bytes, bytearray, memoryview)) else raw.reshape(-1)
+        block, = struct.unpack("<I", raw[:4].tobytes())
+        nb = max(1, -(-count // block))
+        scale = raw[4:4 + 4 * nb].view(np.float32)
+        if scale_mul is not None and scale_mul != 1.0:
+            scale = scale * np.float32(scale_mul)
+        q = raw[4 + 4 * nb:4 + 4 * nb + count].view(_f8_dtype())
+        if out is not None:
+            np.copyto(out, q.astype(np.float32), casting="unsafe")
+            _scale_inplace(out, scale, block, count)
+            return out
+        x = q.astype(np.float32)
+        _scale_inplace(x, scale, block, count)
+        return x.astype(dtype, copy=False)
+
+
+class TopKCodec(WireCodec):
+    """Top-k by magnitude: ``u32 k | u32 idx[k] | f32 val[k]`` records.
+
+    ``error_feedback=True``: the window plane keeps a residual per owned
+    source row (``(input + residual) - decode(encode(input + residual))``)
+    so the dropped coordinates are sent on later steps instead of lost —
+    the property the convergence-parity oracle pins.
+    """
+
+    cid = CODEC_TOPK
+    error_feedback = True
+
+    def __init__(self, frac: float) -> None:
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(f"topk fraction must be in (0, 1], got {frac}")
+        self.frac = float(frac)
+        self.name = f"topk:{frac:g}"
+        # u32 index + f32 value per kept element vs 4 raw bytes/element
+        self.nominal_ratio = min(1.0, 2.0 * self.frac)
+
+    def encode(self, arr) -> np.ndarray:
+        flat = _as_f32_flat(arr)
+        n = flat.size
+        k = max(1, min(n, int(round(self.frac * n))))
+        if k >= n:
+            idx = np.arange(n, dtype=np.uint32)
+        else:
+            part = np.argpartition(np.abs(flat), n - k)[n - k:]
+            idx = np.sort(part).astype(np.uint32)
+        vals = flat[idx].astype(np.float32)
+        out = np.empty(4 + 8 * k, np.uint8)
+        out[:4] = np.frombuffer(struct.pack("<I", k), np.uint8)
+        out[4:4 + 4 * k] = idx.view(np.uint8)
+        out[4 + 4 * k:] = vals.view(np.uint8)
+        return out
+
+    def decode(self, raw, dtype, count: int, scale_mul=None, out=None):
+        raw = np.frombuffer(raw, np.uint8) if isinstance(
+            raw, (bytes, bytearray, memoryview)) else raw.reshape(-1)
+        k, = struct.unpack("<I", raw[:4].tobytes())
+        idx = raw[4:4 + 4 * k].view(np.uint32)
+        vals = raw[4 + 4 * k:4 + 8 * k].view(np.float32)
+        if k and int(idx.max()) >= count:
+            raise ValueError(
+                f"top-k deposit names index {int(idx.max())} beyond the "
+                f"{count}-element row — mismatched window shape across "
+                "controllers")
+        if scale_mul is not None and scale_mul != 1.0:
+            vals = vals * np.float32(scale_mul)  # k floats, not count
+        if out is not None:
+            out[:] = 0.0
+            out[idx] = vals
+            return out
+        dense = np.zeros(count, np.float32)
+        dense[idx] = vals
+        return dense.astype(dtype, copy=False)
+
+
+_warned_bad_spec = set()
+
+
+def resolve(spec) -> Optional[WireCodec]:
+    """``BLUEFOG_WIN_CODEC`` value -> codec instance (None = legacy wire).
+
+    Grammar: ``none | int8 | fp8 | topk:<frac> | topk``. An unknown spec
+    warns once and falls back to ``none`` — a typo must degrade to the
+    exact legacy wire, never to a half-configured codec.
+    """
+    if not spec:
+        return None
+    s = str(spec).strip().lower()
+    if s in ("", "none", "0"):
+        return None
+    if s == "int8":
+        return Int8Codec()
+    if s == "fp8":
+        return Fp8Codec()
+    if s == "topk":
+        return TopKCodec(_DEFAULT_TOPK_FRAC)
+    if s.startswith("topk:"):
+        try:
+            return TopKCodec(float(s.split(":", 1)[1]))
+        except ValueError:
+            pass
+    if s not in _warned_bad_spec:
+        _warned_bad_spec.add(s)
+        logger.warning(
+            "BLUEFOG_WIN_CODEC=%r is not none|int8|fp8|topk:<frac>; "
+            "running the uncompressed wire", spec)
+    return None
+
+
+def by_id(cid: int) -> WireCodec:
+    """Decode-side lookup: the drain learns the codec from the deposit
+    header (codec id in the mode byte's high nibble), never from its own
+    environment — origin and owner env may disagree. Top-k decode needs
+    no fraction (k rides the payload), so a parameterless instance
+    suffices."""
+    if cid == CODEC_INT8:
+        return Int8Codec()
+    if cid == CODEC_FP8:
+        return Fp8Codec()
+    if cid == CODEC_TOPK:
+        return TopKCodec(_DEFAULT_TOPK_FRAC)
+    raise ValueError(f"unknown wire codec id {cid} in deposit header — "
+                     "origin runs a newer codec than this build")
+
+
+def quantize_blend(x, cid: int):
+    """In-program (jax) analog of the quantization codecs for the
+    compiled plane's mail-dtype blend: per-tensor symmetric scale, the
+    same int8 / fp8 grids the hosted wire ships. Identity for ``none``
+    and for top-k (no dense-exchange analog)."""
+    if cid not in (CODEC_INT8, CODEC_FP8):
+        return x
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    a = jnp.max(jnp.abs(xf))
+    if cid == CODEC_INT8:
+        s = jnp.where(a > 0, a / 127.0, 1.0)
+        q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+        return (q.astype(jnp.float32) * s).astype(x.dtype)
+    s = jnp.where(a > 0, a / jnp.float32(_F8_MAX), 1.0)
+    q = (xf / s).astype(jnp.float8_e4m3fn)
+    return (q.astype(jnp.float32) * s).astype(x.dtype)
+
+
+__all__: List[str] = [
+    "CODEC_NONE", "CODEC_INT8", "CODEC_FP8", "CODEC_TOPK",
+    "WireCodec", "Int8Codec", "Fp8Codec", "TopKCodec",
+    "resolve", "by_id", "quantize_blend",
+]

@@ -77,14 +77,22 @@ def unpack(buffer, spec: PackSpec):
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
-def unpack_row(row: np.ndarray, spec: PackSpec) -> List[np.ndarray]:
+def unpack_row(row: np.ndarray, spec: PackSpec,
+               codec=None) -> List[np.ndarray]:
     """Host-side unpack of ONE rank's flat [total] row into per-leaf arrays.
 
     The elastic-rejoin state transfer moves a single rank's packed window
     row between controllers as host bytes; a jitted :func:`unpack` would
     need every controller to dispatch the same program — exactly what a
     one-sided rejoin cannot ask for — so this unpacks with numpy only.
+
+    ``codec`` (an ``ops.codec.WireCodec``): ``row`` is an encoded wire
+    payload; decode it back to the flat buffer-dtype row first — the
+    inverse of :func:`pack_row`'s encode hook.
     """
+    if codec is not None:
+        row = codec.decode(np.asarray(row).reshape(-1).view(np.uint8),
+                           np.dtype(spec.buffer_dtype), spec.total)
     row = np.asarray(row).reshape(-1)
     out: List[np.ndarray] = []
     for shape, dtype, off, size in zip(spec.shapes, spec.dtypes, spec.offsets,
@@ -94,13 +102,23 @@ def unpack_row(row: np.ndarray, spec: PackSpec) -> List[np.ndarray]:
     return out
 
 
-def pack_row(leaf_rows: Sequence, spec: PackSpec) -> np.ndarray:
+def pack_row(leaf_rows: Sequence, spec: PackSpec, codec=None) -> np.ndarray:
     """Host-side inverse of :func:`unpack_row`: per-leaf arrays for ONE
-    rank -> that rank's flat [total] packed row (buffer dtype)."""
+    rank -> that rank's flat [total] packed row (buffer dtype).
+
+    ``codec`` (an ``ops.codec.WireCodec``): additionally encode the flat
+    row into the codec's wire payload (uint8) — the insertion point the
+    compressed gossip wire uses for whole-row host-side transforms
+    (docs/compression.md); the deposit hot path in ``ops/windows.py``
+    calls the codec on its already-flat rows directly.
+    """
     bt = np.dtype(spec.buffer_dtype)
-    return np.concatenate([
+    row = np.concatenate([
         np.asarray(x).reshape(-1).astype(bt) for x in leaf_rows
     ]) if leaf_rows else np.zeros((0,), bt)
+    if codec is not None:
+        return codec.encode(row)
+    return row
 
 
 @functools.lru_cache(maxsize=512)

@@ -240,12 +240,20 @@ class PlanePlanner:
 
     def __init__(self, n: int, edges, owner_of: Dict[int, int],
                  row_bytes: int, min_bytes: int = 0, policy: str = "auto",
-                 hosted_override=()) -> None:
+                 hosted_override=(), wire_scale: float = 1.0) -> None:
         self.n = n
         self.edges: FrozenSet[Edge] = frozenset(
             (int(s), int(d)) for s, d in edges)
         self.owner_of = dict(owner_of)
         self.row_bytes = int(row_bytes)
+        # Wire codec discount (docs/compression.md): with a codec on the
+        # hosted wire, a deposit ships ~codec.nominal_ratio of the row, so
+        # the static size estimate must shrink with it or the min-bytes
+        # floor would mis-plan every edge. Measured attribution hints
+        # (ingest_attribution) already carry POST-codec bytes — the
+        # edge.<src>.<dst> flow events record the encoded payload size —
+        # so they are never rescaled here.
+        self.wire_scale = float(wire_scale)
         self.min_bytes = int(min_bytes)
         self.policy = policy
         self.hosted_override = frozenset(hosted_override)
@@ -264,11 +272,12 @@ class PlanePlanner:
 
     def edge_cost(self, edge: Edge) -> float:
         """Wire bytes one gossip step moves over this edge if it stays
-        hosted: the measured per-step attribution bytes when ingested,
-        else the window row size (every deposit ships one full row)."""
+        hosted: the measured per-step attribution bytes when ingested
+        (already on-wire, i.e. post-codec), else the window row size
+        scaled by the configured codec's nominal compression ratio."""
         if self.hints is not None and edge in self.hints:
             return float(self.hints[edge]["bytes"])
-        return float(self.row_bytes)
+        return float(self.row_bytes) * self.wire_scale
 
     def _eligible(self, edge: Edge, dead: FrozenSet[int]) -> bool:
         src, dst = edge

@@ -58,6 +58,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import topology as topology_util
+from . import codec as _wire_codec
 from ..runtime import control_plane as _cp
 from ..runtime import flight as _flight
 from ..runtime import handles as _handles
@@ -592,6 +593,23 @@ class Window:
         policy = getattr(st, "win_plane", None) or _plane_policy()
         self.plane = policy[0]
         self.hosted = _hosted_mode_enabled(policy)
+        # Wire codec (ISSUE r15, docs/compression.md): resolved once per
+        # window from the registry knob. On the hosted plane it transforms
+        # every deposit payload (and the matching local folds, so a
+        # single-controller hosted harness sees the same numerics as a
+        # cross-controller wire); on the compiled plane the quantization
+        # codecs apply through the mail-dtype blend (codec.quantize_blend)
+        # while top-k — index records over a dense exchange — does not.
+        # None keeps the legacy wire byte-identical (test-pinned).
+        self.codec = _wire_codec.resolve(knob_env("BLUEFOG_WIN_CODEC"))
+        # Error-feedback state (top-k): one acc-dtype row per owned source
+        # rank, held next to the fused flat window the optimizers pack
+        # (optimizers._WindowOptimizer). `_ef_rows` is the residual/unsent
+        # gap; `_ef_ref` is the put-mode CHOCO estimate x̂ — seeded below
+        # from the creation-time rows so it starts aligned with the
+        # mailbox slots' initial copies (zero_init windows start at 0).
+        self._ef_rows: Dict[int, np.ndarray] = {}
+        self._ef_ref: Dict[int, np.ndarray] = {}
         # Scalar protocols (versions / push-sum p / mutexes): controller-local
         # host memory, or the job-wide control plane when one is attached
         # (multi-controller; reference mpi_controller.cc:1281-1393, 1532-1602).
@@ -623,7 +641,12 @@ class Window:
                  for r in range(st.size)},
                 row_bytes=int(np.prod(self.row_shape, dtype=np.int64))
                 * self.dtype.itemsize,
-                min_bytes=int(float(min_mb) * (1 << 20)))
+                min_bytes=int(float(min_mb) * (1 << 20)),
+                # the codec shrinks every hosted deposit, so the planner's
+                # static size floor must judge POST-codec bytes — measured
+                # attribution (already on-wire) overrides this estimate
+                wire_scale=(self.codec.nominal_ratio
+                            if self.codec is not None else 1.0))
 
         if self.hosted:
             # defensive: discard any deposit records a crashed predecessor
@@ -635,6 +658,12 @@ class Window:
                         pass
             rows = _owned_rows(tensor, self.owned)
             self._rows = {r: v.astype(self.dtype) for r, v in rows.items()}
+            if self.codec is not None and self.codec.error_feedback:
+                acc_t = np.dtype(_win_acc_dtype(mail_dtype))
+                self._ef_ref = {
+                    r: (np.zeros(self.row_shape, acc_t) if zero_init
+                        else self._rows[r].astype(acc_t))
+                    for r in self.owned}
             if zero_init:
                 self._mail_rows = {
                     r: np.zeros((d,) + self.row_shape, mail_dtype)
@@ -735,24 +764,67 @@ class Window:
         extension floats) through the native scatter-gather write — a
         100 MB publish costs zero Python-side copies, where ``tobytes()``
         duplicated every published byte (this is half the win_update wire
-        traffic at ResNet scale)."""
+        traffic at ResNet scale).
+
+        Quantization codecs (``state_codec``) compress the published copy
+        too — the publish is the OTHER half of win_update's wire bytes
+        and the whole of win_get's pull — behind a 4-byte magic + codec
+        id header; every reader goes through :meth:`_parse_published`,
+        which keeps raw rows (codec ``none``, and top-k windows, whose
+        sparse records cannot carry absolute state) byte-identical."""
         ranks = list(ranks)
-        if ranks:
+        if not ranks:
+            return
+        codec = self.codec
+        if codec is not None and codec.state_codec:
+            blobs = []
+            raw_b = wire_b = 0
+            for r in ranks:
+                enc = codec.encode(self._rows[r])
+                blob = np.empty(_PUB_HDR + enc.nbytes, np.uint8)
+                blob[:_PUB_HDR] = np.frombuffer(
+                    struct.pack("<IBBH", _PUB_MAGIC, codec.cid, 0, 0),
+                    np.uint8)
+                blob[_PUB_HDR:] = enc
+                blobs.append(blob)
+                raw_b += self._rows[r].nbytes
+                wire_b += blob.nbytes
+            _metrics.counter("win.codec.raw_bytes").inc(raw_b)
+            _metrics.counter("win.codec.wire_bytes").inc(wire_b)
             _cp.client().put_bytes_many(
-                [self._self_key(r) for r in ranks],
-                [np.ascontiguousarray(self._rows[r]).reshape(-1).view(
-                    np.uint8) for r in ranks])
+                [self._self_key(r) for r in ranks], blobs)
+            return
+        _cp.client().put_bytes_many(
+            [self._self_key(r) for r in ranks],
+            [np.ascontiguousarray(self._rows[r]).reshape(-1).view(
+                np.uint8) for r in ranks])
 
     def _read_remote_self(self, rank: int) -> np.ndarray:
         return self._read_remote_selves([rank])[0]
 
-    def _check_published_len(self, rank: int, nbytes: int) -> None:
+    def _parse_published(self, rank: int, buf) -> np.ndarray:
+        """Published payload -> row array: a raw wire-dtype row (codec
+        ``none`` / top-k — byte-identical to the legacy format) or a
+        magic-prefixed codec-encoded state row (``_publish_selves``).
+        The codec id comes from the PAYLOAD, never this window's env —
+        origin and reader may disagree safely."""
         expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
             self.dtype.itemsize
-        if nbytes != expect:
-            raise RuntimeError(
-                f"window '{self.name}': published tensor for rank "
-                f"{rank} has {nbytes} bytes, expected {expect}")
+        n = len(buf)
+        if n == expect:
+            return np.frombuffer(buf, self.dtype).reshape(self.row_shape)
+        if n > _PUB_HDR:
+            magic, cid = struct.unpack_from("<IB", buf, 0)
+            if magic == _PUB_MAGIC:
+                count = int(np.prod(self.row_shape, dtype=np.int64))
+                flat = _wire_codec.by_id(cid).decode(
+                    np.frombuffer(buf, np.uint8)[_PUB_HDR:],
+                    self.dtype, count)
+                return flat.reshape(self.row_shape)
+        raise RuntimeError(
+            f"window '{self.name}': published tensor for rank "
+            f"{rank} has {n} bytes, expected {expect} (raw) or an "
+            "encoded-state payload")
 
     def _read_remote_selves(self, ranks) -> List[np.ndarray]:
         """Batched read of published tensors: one pipelined round-trip."""
@@ -761,12 +833,8 @@ class Window:
             return []
         raws = _cp.client().get_bytes_many(
             [self._self_key(r) for r in ranks])
-        out = []
-        for rank, raw in zip(ranks, raws):
-            self._check_published_len(rank, len(raw))
-            out.append(np.frombuffer(raw, self.dtype).reshape(
-                self.row_shape))
-        return out
+        return [self._parse_published(rank, raw)
+                for rank, raw in zip(ranks, raws)]
 
     def _read_remote_self_view(self, rank: int):
         """One published row as a zero-copy array over the native reply.
@@ -775,10 +843,11 @@ class Window:
         ``owner.close()``. Large rows arrive as concurrent byte-range
         stripes over the connection pool (``get_bytes_view``); the win_get
         pipeline additionally keeps several sources in flight at once, so
-        the pool stays saturated while earlier sources fold."""
+        the pool stays saturated while earlier sources fold. (Encoded
+        state rows decode into a fresh array; the owner close stays the
+        caller's job either way.)"""
         view, owner = _cp.client().get_bytes_view(self._self_key(rank))
-        self._check_published_len(rank, len(view))
-        row = np.frombuffer(view, self.dtype).reshape(self.row_shape)
+        row = self._parse_published(rank, view)
         return row, owner
 
     def _fold_record(self, dst: int, k: int, mode: int,
@@ -800,34 +869,128 @@ class Window:
         else:
             np.copyto(slot, contrib, casting="unsafe")
 
-    def _start_deposit(self, pair, rec) -> Optional[_PendingDeposit]:
+    def ef_residual(self, src: int) -> np.ndarray:
+        """The error-feedback residual row for owned source ``src`` (zeros
+        until the first compressed send). Held in the acc dtype so
+        repeated compensate/subtract cycles never lose mass to rounding
+        below the wire's own precision."""
+        r = self._ef_rows.get(src)
+        if r is None:
+            acc_t = np.dtype(_win_acc_dtype(self.mail_dtype))
+            r = self._ef_rows[src] = np.zeros(self.row_shape, acc_t)
+        return r
+
+    def ef_residual_norm(self) -> float:
+        """L2 norm over every owned rank's residual (0.0 when EF is off
+        or nothing compressed yet) — the ``win.codec.residual_norm``
+        gauge's source."""
+        if not self._ef_rows:
+            return 0.0
+        return float(np.sqrt(sum(
+            float(np.sum(np.square(r, dtype=np.float64)))
+            for r in self._ef_rows.values())))
+
+    def _encode_row(self, src: int, x: np.ndarray, wire_t, mode: int):
+        """Encode one source row for the wire:
+        ``(payload, estimate, fold_mode)``.
+
+        The codec encodes each row ONCE per op — the same payload feeds
+        every out-edge (weights move receiver-side via the extension
+        header) and the same decoded ``estimate`` feeds the local folds,
+        so a single-controller hosted window and a cross-controller wire
+        produce identical numerics.
+
+        Error-feedback codecs split by op mode (docs/compression.md):
+
+        * **put** (overwrite semantics) uses the CHOCO-SGD construction —
+          ship ``C(x - x̂)`` against a sender-tracked estimate ``x̂``
+          that advances by exactly the decoded increment, and fold it
+          ADDITIVELY (``fold_mode`` flips to accumulate), so the mailbox
+          slot integrates to the same ``x̂`` both ends agree on. A raw
+          ``C(x)`` overwrite would zero the unsent coordinates every
+          step — the scheme that does NOT converge for parameter gossip.
+        * **accumulate** (push-sum mass) uses classic EF-SGD — ship
+          ``C(x + e)``, keep ``e = (x + e) - est``: dropped numerator
+          mass is delayed to later deposits, never lost, while the
+          associated-p channel ships exact in the header.
+        """
+        codec = self.codec
+        acc_t = np.dtype(_win_acc_dtype(self.mail_dtype))
+        fold_mode = mode
+        if codec.error_feedback and mode == _DEP_PUT:
+            ref = self._ef_ref.get(src)
+            if ref is None:
+                ref = self._ef_ref[src] = np.zeros(self.row_shape, acc_t)
+            base = x - ref
+            fold_mode = _DEP_ACC
+        elif codec.error_feedback:
+            base = x + self.ef_residual(src)
+        else:
+            base = x
+        raw = np.ascontiguousarray(base.astype(wire_t, copy=False)).reshape(-1)
+        payload = codec.encode(raw)
+        est = codec.decode(payload, wire_t, raw.size).astype(
+            acc_t, copy=False).reshape(self.row_shape)
+        if codec.error_feedback:
+            if mode == _DEP_PUT:
+                self._ef_ref[src] = ref + est
+                self._ef_rows[src] = x - self._ef_ref[src]  # unsent gap
+            else:
+                self._ef_rows[src] = base - est
+            _metrics.gauge("win.codec.residual_norm").set(
+                self.ef_residual_norm())
+        _metrics.counter("win.codec.raw_bytes").inc(raw.nbytes)
+        _metrics.counter("win.codec.wire_bytes").inc(payload.nbytes)
+        _metrics.gauge("win.codec.ratio").set(
+            raw.nbytes / payload.nbytes if payload.nbytes else 0.0)
+        return payload, est, fold_mode
+
+    def _start_deposit(self, pair, rec, expect: int) -> Optional[_PendingDeposit]:
         """Parse a deposit's header record into reassembly state.
 
         Put-mode deposits stream straight into the mailbox slot: the wire
         dtype always equals the mail dtype (floating windows ship their own
         dtype; integer windows' mailboxes ARE the f32 acc dtype), so a put
         is a pure byte copy with no accumulation pass. Accumulate-mode
-        stages into a scratch buffer and folds once complete."""
+        stages into a scratch buffer and folds once complete.
+
+        Codec deposits (mode byte's high nibble non-zero): the encoded
+        payload's size differs from the row size — the extension header
+        carries it — and both modes must stage (the payload is a codec
+        record, not slot bytes); the fold decodes at ``_finish_deposit``.
+        ``expect`` is the raw-wire payload byte count (row size in the
+        wire dtype), used by legacy deposits."""
         seq = int.from_bytes(rec[:_DEP_TAG], "little") >> 24
-        mode, has_p, pc, nchunks = struct.unpack_from("<BBdI", rec, _DEP_TAG)
-        if mode == _DEP_PUT:
+        raw_mode, has_p, pc, nchunks = struct.unpack_from(
+            "<BBdI", rec, _DEP_TAG)
+        codec_id = raw_mode >> _DEP_CODEC_SHIFT
+        mode = raw_mode & _DEP_MODE_MASK
+        wt = 1.0
+        hdr_end = _DEP_TAG + _DEP_HDR
+        if codec_id:
+            wt, expect = struct.unpack_from("<dQ", rec, hdr_end)
+            hdr_end += _DEP_EXT
+            staging = np.empty(expect, np.uint8)
+            target = staging
+        elif mode == _DEP_PUT:
             target = self._mail_rows[pair[0]][pair[1]].reshape(-1).view(
                 np.uint8)
             staging = None
         else:
-            expect = self._mail_rows[pair[0]][pair[1]].nbytes
             staging = np.empty(expect, np.uint8)
             target = staging
-        pend = _PendingDeposit(mode, has_p, pc, seq, nchunks, target, staging)
+        pend = _PendingDeposit(mode, has_p, pc, seq, nchunks, target,
+                               staging, codec_id=codec_id, wt=wt,
+                               expect=int(expect))
         # compact single-record form: a header carrying payload inline
-        body = rec[_DEP_TAG + _DEP_HDR:]
+        body = rec[hdr_end:]
         if len(body):
             pend.target[:len(body)] = np.frombuffer(body, np.uint8)
             pend.hdr_len = pend.got = len(body)
         return pend
 
     def _place_chunk(self, pair, pend: "_PendingDeposit", idx: int,
-                     body, expect: int) -> None:
+                     body) -> None:
         """Place one continuation chunk at its deterministic offset.
 
         Striped senders fan a deposit's chunk records across the
@@ -836,6 +999,7 @@ class Window:
         the sender's chunk size (learned from whichever non-last chunk
         arrives first), and the last chunk anchors to the tail. In-order
         single-stream arrival degenerates to the same math."""
+        expect = pend.expect
         blen = len(body)
         off = -1
         bad = idx < 1 or idx > pend.nchunks or idx in pend.seen
@@ -867,7 +1031,38 @@ class Window:
         fl.rec(_flight.FLOW_F,
                fl.intern(f"drain.{(pend.seq >> 32) & 0x7F}"),
                pend.got, pend.seq)
-        if pend.mode == _DEP_ACC:
+        if pend.codec_id:
+            # compressed deposit: decode the self-describing payload back
+            # to a full wire-dtype row, apply the edge weight the sender
+            # moved receiver-side (one encode per source row feeds every
+            # out-edge), and fold — put OR accumulate — through the usual
+            # acc-dtype discipline (docs/compression.md)
+            wire_t = _win_wire_dtype(self.mail_dtype)
+            acc_t = np.dtype(_win_acc_dtype(self.mail_dtype))
+            n = int(np.prod(self.row_shape, dtype=np.int64))
+            codec_obj = _wire_codec.by_id(pend.codec_id)
+            # error-feedback put deposits are CHOCO deltas: integrate them
+            # (the slot tracks the sender's x̂) instead of overwriting
+            fold_mode = _DEP_ACC if (codec_obj.error_feedback
+                                     and pend.mode == _DEP_PUT) \
+                else pend.mode
+            _metrics.counter("win.codec.wire_bytes_in").inc(pend.got)
+            slot = self._mail_rows[pair[0]][pair[1]]
+            with fl.span("win.fold", a=pend.got):
+                if fold_mode == _DEP_PUT and slot.dtype == np.float32:
+                    # decode STRAIGHT into the mailbox slot with the edge
+                    # weight folded into the per-block scales: two passes
+                    # over the row instead of decode + weight + copy
+                    codec_obj.decode(pend.staging, np.float32, n,
+                                     scale_mul=pend.wt,
+                                     out=slot.reshape(-1))
+                else:
+                    flat = codec_obj.decode(pend.staging, wire_t, n,
+                                            scale_mul=pend.wt)
+                    contrib = flat.astype(acc_t, copy=False).reshape(
+                        self.row_shape)
+                    self._fold_record(pair[0], pair[1], fold_mode, contrib)
+        elif pend.mode == _DEP_ACC:
             wire_t = _win_wire_dtype(self.mail_dtype)
             contrib = pend.staging.view(wire_t).reshape(self.row_shape)
             with fl.span("win.fold", a=pend.got):
@@ -1001,7 +1196,7 @@ class Window:
                                     # corrupted peer
                                     orphans += 1
                                 pend = pend_map[seq] = self._start_deposit(
-                                    pair, rec)
+                                    pair, rec, expect)
                             else:
                                 pend = pend_map.get(seq)
                                 if pend is None:
@@ -1015,8 +1210,8 @@ class Window:
                                     orphans += 1
                                     continue
                                 self._place_chunk(pair, pend,
-                                                  idx, rec[_DEP_TAG:], expect)
-                            if pend.got == expect:
+                                                  idx, rec[_DEP_TAG:])
+                            if pend.got == pend.expect:
                                 self._finish_deposit(pair, pend)
                                 del pend_map[seq]
                         # GC: per-origin deposit counters are monotonic and a
@@ -1115,13 +1310,14 @@ class Window:
         mis-sized (its controller never published, or is itself dead and
         its slot was cleared). The rejoin state transfer reads a donor's
         row through this — the same striped get_bytes transport win_get
-        rides, reused as-is."""
+        rides, reused as-is. Under a state codec the adopted row is the
+        donor's quantized copy (bounded per-block error —
+        docs/compression.md documents the rejoin tradeoff)."""
         raw = _cp.client().get_bytes(self._self_key(rank))
-        expect = int(np.prod(self.row_shape, dtype=np.int64)) * \
-            self.dtype.itemsize
-        if len(raw) != expect:
+        try:
+            return self._parse_published(rank, raw).copy()
+        except RuntimeError:
             return None
-        return np.frombuffer(raw, self.dtype).reshape(self.row_shape).copy()
 
     def install_row(self, rank: int, row) -> None:
         """Owner-write one OWNED rank's window row and publish it (the
@@ -1184,7 +1380,15 @@ class Window:
         put), letting XLA reuse it instead of allocating a fresh self
         tensor.
         """
-        key = ("xchg", accumulate, donate_source, identity_self)
+        # Quantization codecs apply to the compiled plane through the
+        # mail-dtype blend (the value each edge materializes): the moved
+        # payload rides the same int8/fp8 grid the hosted wire ships, so a
+        # hybrid partition's two planes agree numerically. Top-k has no
+        # dense-exchange analog (blend id 0 = exact legacy program).
+        blend = self.codec.cid if self.codec is not None and \
+            self.codec.cid in (_wire_codec.CODEC_INT8,
+                               _wire_codec.CODEC_FP8) else 0
+        key = ("xchg", accumulate, donate_source, identity_self, blend)
         fn = self._exchange_cache.get(key)
         if fn is not None:
             return fn
@@ -1202,6 +1406,8 @@ class Window:
             for si, s in enumerate(shifts):
                 perm = [(i, (i + s) % n) for i in range(n)]
                 moved = lax.ppermute(xb, "rank", perm)  # from (me - s) % n
+                if blend:
+                    moved = _wire_codec.quantize_blend(moved, blend)
                 ak = active[si, me]
                 # effective weight carries the active mask: an inactive
                 # shift's write is redirected to the scratch slot AND its
@@ -1367,7 +1573,10 @@ def _hybrid_fn(win: Window, meta: dict, accumulate: bool):
     the two-program collective pair materializes them, which is what makes
     the all-compiled case bit-exact against that plane.
     """
-    key = ("fn", accumulate, meta["perms"], meta["k"])
+    blend = win.codec.cid if win.codec is not None and \
+        win.codec.cid in (_wire_codec.CODEC_INT8,
+                          _wire_codec.CODEC_FP8) else 0
+    key = ("fn", accumulate, meta["perms"], meta["k"], blend)
     fn = win._hybrid_cache.get(key)
     if fn is not None:
         return fn
@@ -1383,6 +1592,10 @@ def _hybrid_fn(win: Window, meta: dict, accumulate: bool):
         mb = jnp.zeros((d_max + 1,) + xb.shape, mail_dtype)
         for si in range(len(perms)):
             moved = lax.ppermute(xb, "rank", list(perms[si]))
+            if blend:
+                # the compiled partition's mail-dtype blend rides the same
+                # quantized grid as the hosted wire (docs/compression.md)
+                moved = _wire_codec.quantize_blend(moved, blend)
             ak = active[si, me]
             wk = (w[si, me] * ak).astype(acc_t)
             # inactive (no compiled edge on this shift for me): redirect the
@@ -1541,6 +1754,25 @@ _DEP_ACC = 1
 _DEP_HDR = struct.calcsize("<BBdI")
 _DEP_TAG = 8  # server-prefixed i64 tag bytes per stored record
 _DEFAULT_MAX_SENT = 16 << 20
+# Compressed-wire extension (ISSUE r15, docs/compression.md): a codec id
+# rides the HIGH NIBBLE of the header's mode byte (the legacy wire's mode
+# byte is 0/1, so BLUEFOG_WIN_CODEC=none stays byte-identical — pinned).
+# When the nibble is non-zero, an extension header follows the base one:
+#   f64 edge weight | u64 encoded payload bytes
+# The weight moves receiver-side because the codec encodes each source ROW
+# once (one encode feeds every out-edge — and, for top-k, one
+# error-feedback residual per row); the payload itself is the codec's
+# self-describing record (ops/codec.py), so its length differs from the
+# row size and the drain completes it by the header's byte count.
+_DEP_MODE_MASK = 0x0F
+_DEP_CODEC_SHIFT = 4
+_DEP_EXT = struct.calcsize("<dQ")
+# Published-row ("exposed window") state-codec framing: raw rows have no
+# header (the legacy format, length == row bytes); encoded rows carry
+# u32 magic | u8 codec id | 3 reserved bytes, then the self-describing
+# codec payload. Readers dispatch on length + magic (_parse_published).
+_PUB_MAGIC = 0x43575642  # "BVWC"
+_PUB_HDR = struct.calcsize("<IBBH")
 
 
 def _deposit_tags(seq: int, nrec: int, origin: int = 0) -> List[int]:
@@ -1606,10 +1838,13 @@ class _PendingDeposit:
     out-of-order arrivals reassemble exactly; completion is by byte count."""
 
     __slots__ = ("mode", "has_p", "pc", "seq", "nchunks", "cap", "hdr_len",
-                 "got", "seen", "staging", "target", "t0")
+                 "got", "seen", "staging", "target", "t0", "codec_id", "wt",
+                 "expect")
 
     def __init__(self, mode: int, has_p: int, pc: float, seq: int,
-                 nchunks: int, target: np.ndarray, staging) -> None:
+                 nchunks: int, target: np.ndarray, staging,
+                 codec_id: int = 0, wt: float = 1.0,
+                 expect: int = 0) -> None:
         self.mode = mode
         self.has_p = has_p
         self.pc = pc
@@ -1620,7 +1855,10 @@ class _PendingDeposit:
         self.got = 0
         self.seen: set = set()  # chunk indices already placed
         self.target = target    # flat uint8 view, len == expected bytes
-        self.staging = staging  # acc-mode staging array (None for put)
+        self.staging = staging  # acc/codec staging array (None for put)
+        self.codec_id = codec_id  # wire codec (0 = legacy raw payload)
+        self.wt = wt            # receiver-side edge weight (codec wire)
+        self.expect = expect    # this deposit's payload byte count
         self.t0 = time.monotonic()
 
 
@@ -1659,7 +1897,8 @@ def _max_sent_bytes() -> int:
     return max(1 << 16, v)
 
 
-def _pack_deposit(mode: int, has_p: int, pc: float, payload) -> List:
+def _pack_deposit(mode: int, has_p: int, pc: float, payload,
+                  codec_id: int = 0, wt: float = 1.0) -> List:
     """Split one deposit into its wire records: a header record followed by
     bounded payload chunks.
 
@@ -1669,7 +1908,12 @@ def _pack_deposit(mode: int, has_p: int, pc: float, payload) -> List:
     100 MB deposit is chunked without a single Python-side copy. The drain
     completes a deposit by BYTE COUNT (the row size is known to both
     ends), so a header record carrying its payload inline (the compact
-    single-record form) reassembles identically."""
+    single-record form) reassembles identically.
+
+    ``codec_id``/``wt`` (compressed wire): the codec id joins the mode
+    byte's high nibble and the extension header carries the edge weight
+    plus the encoded byte count (the drain cannot derive it from the row
+    size). ``codec_id=0`` emits exactly the legacy record layout."""
     cap = _max_sent_bytes()
     if isinstance(payload, np.ndarray):
         # extension dtypes (ml_dtypes bf16/f8) lack the buffer protocol;
@@ -1677,7 +1921,11 @@ def _pack_deposit(mode: int, has_p: int, pc: float, payload) -> List:
         payload = payload.reshape(-1).view(np.uint8)
     mv = memoryview(payload).cast("B")
     chunks = [mv[i:i + cap] for i in range(0, mv.nbytes, cap)]
-    return [struct.pack("<BBdI", mode, has_p, pc, len(chunks)), *chunks]
+    hdr = struct.pack("<BBdI", mode | (codec_id << _DEP_CODEC_SHIFT),
+                      has_p, pc, len(chunks))
+    if codec_id:
+        hdr += struct.pack("<dQ", float(wt), mv.nbytes)
+    return [hdr, *chunks]
 
 
 def _blen(b) -> int:
@@ -1958,29 +2206,59 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 try:
                     for src in win.owned:
                         x = rows[src].astype(acc_t, copy=False)
-                        for dst in sorted(table.get(src, {})):
+                        dsts = sorted(table.get(src, {}))
+                        # Compressed wire: ONE encode per source row — the
+                        # payload feeds every out-edge (weights move
+                        # receiver-side) and its decoded estimate feeds the
+                        # local folds, so wire and local numerics agree.
+                        enc = est = None
+                        fold_mode = mode
+                        if win.codec is not None and dsts:
+                            enc, est, fold_mode = win._encode_row(
+                                src, x, wire_t, mode)
+                        for dst in dsts:
                             wt = float(table[src][dst])
                             k = win.layout.slot_of[dst][src]
-                            contrib = x * np.asarray(wt, acc_t)
                             pc = float(p_own[src] * wt) if use_p else 0.0
                             if dst in owned:
+                                base_row = x if est is None else est
+                                # unit weights (the optimizer default)
+                                # skip a full-row multiply; _fold_record
+                                # never mutates its contrib
+                                contrib = base_row if wt == 1.0 else \
+                                    base_row * np.asarray(wt, acc_t)
                                 with fl.span("win.fold", a=contrib.nbytes):
-                                    win._fold_record(dst, k, mode, contrib)
+                                    win._fold_record(dst, k, fold_mode,
+                                                     contrib)
                                 if use_p:
                                     if accumulate:
                                         win.host.add_p_mail(dst, k, pc)
                                     else:
                                         win.host.set_p_mail(dst, k, pc)
                                 deposited.add((src, dst, k))
+                            elif enc is not None:
+                                # codec deposit: the encoded payload (one
+                                # self-describing record) with the edge
+                                # weight + byte count in the extension
+                                # header; flow events below report the
+                                # POST-CODEC bytes, so step attribution
+                                # and the plane planner see real wire cost
+                                payload = enc
+                                recs = _pack_deposit(
+                                    mode, int(use_p), pc, payload,
+                                    codec_id=win.codec.cid, wt=wt)
+                                key = win._dep_key(dst, k)
                             else:
                                 # wire payload stays a live numpy buffer:
                                 # _pack_deposit slices it zero-copy and the
                                 # native scatter-gather write streams it
                                 payload = np.ascontiguousarray(
-                                    contrib.astype(wire_t, copy=False))
+                                    (x * np.asarray(wt, acc_t)).astype(
+                                        wire_t, copy=False))
                                 recs = _pack_deposit(
                                     mode, int(use_p), pc, payload)
                                 key = win._dep_key(dst, k)
+                            if dst not in owned:
                                 win._dep_seq += 1
                                 dep_names.extend([key] * len(recs))
                                 dep_blobs.extend(recs)

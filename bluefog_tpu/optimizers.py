@@ -599,6 +599,17 @@ class _WindowOptimizer(_FusedOptimizer):
     computed in that dtype and cast back per leaf on unpack); set the
     threshold to 0 to recover the r5 per-leaf windows and per-leaf
     dtype-true wire.
+
+    **Compressed gossip wire** (``BLUEFOG_WIN_CODEC``, docs/compression.md):
+    hosted deposits of the fused flat window optionally ride an int8/fp8
+    quantized or top-k sparsified payload. Top-k keeps an error-feedback
+    residual per owned rank NEXT TO the fused flat window (the window
+    object holds it in the fold/acc dtype; :meth:`ef_residual_norm`
+    surfaces its magnitude, mirrored by the ``win.codec.residual_norm``
+    gauge) so dropped coordinates are delayed to later gossip steps, never
+    lost — the EF-SGD/CHOCO-SGD convergence argument the parity oracle in
+    tests/test_codec.py pins. Push-sum's associated-p channel always ships
+    exact, so mass-conservation gauges stay green under any codec.
     """
 
     _comm_kind = "none"
@@ -673,6 +684,17 @@ class _WindowOptimizer(_FusedOptimizer):
                     "program); completing quarantine with fresh state")
             _hb.complete_quarantine()
         return state
+
+    def ef_residual_norm(self) -> float:
+        """L2 norm of the wire codec's error-feedback residuals held
+        alongside this optimizer's fused flat window(s) (0.0 when no
+        error-feedback codec is configured or nothing was compressed
+        yet). A norm that grows without bound means the chosen top-k
+        fraction cannot keep up with the gradient scale — raise it."""
+        total = 0.0
+        for nm in self._win_names:
+            total += _windows._get_window(nm).ef_residual_norm() ** 2
+        return float(np.sqrt(total))
 
     def free(self) -> None:
         if self._overlap_pending is not None:

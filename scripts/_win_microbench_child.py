@@ -74,21 +74,31 @@ def get_f(cl, key):
     return struct.unpack("<d", struct.pack("<q", cl.get(key)))[0]
 
 
-def report(cl, pid, config, op, times, wire_bytes):
-    """Post my median; pid 0 prints the slowest controller's number."""
+def report(cl, pid, config, op, times, wire_bytes, codec=None):
+    """Post my median; pid 0 prints the slowest controller's number.
+
+    ``codec`` rows come from the ``--codec`` sweep: ``mbps`` stays the
+    EFFECTIVE rate (app-level payload bytes / wall time — the acceptance
+    metric for the compressed wire), while the shrunken on-wire byte
+    count shows up as wall time, not in ``wire_mb``."""
     med = float(np.median(times))
-    put_f(cl, f"wb.{config}.{op}.{pid}", med)
+    key = f"wb.{config}.{codec or ''}.{op}.{pid}"
+    put_f(cl, key, med)
     barrier()
     if pid == 0:
-        meds = [get_f(cl, f"wb.{config}.{op}.{p}") for p in range(N)]
+        meds = [get_f(cl, f"wb.{config}.{codec or ''}.{op}.{p}")
+                for p in range(N)]
         worst = max(meds)
-        print(json.dumps({
+        row = {
             "config": config, "op": op,
             "median_ms": round(worst * 1e3, 3),
             "mbps": round(wire_bytes / worst / 1e6, 1) if wire_bytes else None,
             "wire_mb": round(wire_bytes / 1e6, 2),
             "per_controller_ms": [round(m * 1e3, 3) for m in meds],
-        }), flush=True)
+        }
+        if codec:
+            row["codec"] = codec
+        print(json.dumps(row), flush=True)
     barrier()
 
 
@@ -222,6 +232,111 @@ def main() -> None:
             t_fold.append(t2 - t1)
         report(cl, pid, tag, "drain_stream", t_stream, 2 * row_bytes)
         report(cl, pid, tag, "drain_fold", t_fold, 2 * row_bytes)
+
+    # -- compressed-wire sweep (--codec, ISSUE r15): replay the win_put /
+    # win_update series of the FIRST (headline) config under each codec.
+    # mbps stays payload-bytes / wall-time, so `codec != none` rows read
+    # directly as EFFECTIVE throughput against the same-run uncompressed
+    # numbers above (the >= 2x int8 win_update acceptance bar); the extra
+    # compression_ratio field reports raw/wire bytes from the metrics
+    # registry.
+    codecs = [c for c in os.environ.get("BLUEFOG_WB_CODECS", "").split(",")
+              if c and c != "none"]
+    if codecs:
+        from bluefog_tpu.runtime import metrics as _metrics
+
+        tag, dtype, elems, rounds = CONFIGS[0]
+        row_bytes = elems * np.dtype(dtype).itemsize
+        x = np.zeros((N, elems), dtype)
+        x[:] = np.arange(N, dtype=np.float32)[:, None].astype(dtype)
+        def _codec_counters():
+            c = _metrics.snapshot().get("counters", {})
+            return (c.get("win.codec.raw_bytes", 0.0),
+                    c.get("win.codec.wire_bytes", 0.0))
+
+        for codec in codecs:
+            os.environ["BLUEFOG_WIN_CODEC"] = codec
+            name = f"wb.cx.{codec}"
+            raw0, wire0 = _codec_counters()
+            try:
+                assert bf.win_create(x, name, zero_init=True)
+                barrier()
+                ts = []
+                for r in range(WARMUP + rounds):
+                    barrier()
+                    t0 = time.perf_counter()
+                    bf.win_put(x, name)
+                    if r >= WARMUP:
+                        ts.append(time.perf_counter() - t0)
+                    barrier()
+                    bf.win_update(name)
+                report(cl, pid, tag, "win_put", ts, 3 * row_bytes,
+                       codec=codec)
+                ts = []
+                for r in range(WARMUP + rounds):
+                    bf.win_put(x, name)
+                    barrier()
+                    t0 = time.perf_counter()
+                    bf.win_update(name)
+                    if r >= WARMUP:
+                        ts.append(time.perf_counter() - t0)
+                    barrier()
+                report(cl, pid, tag, "win_update", ts, 2 * row_bytes,
+                       codec=codec)
+                if pid == 0:
+                    # delta vs the sweep start: counters are cumulative
+                    # process-global, and earlier codecs' bytes would
+                    # otherwise blend into this codec's ratio
+                    raw1, wire1 = _codec_counters()
+                    raw, wire = raw1 - raw0, wire1 - wire0
+                    print(json.dumps({
+                        "config": tag, "op": "compression_ratio",
+                        "codec": codec,
+                        "ratio": round(raw / wire, 2) if wire else None,
+                    }), flush=True)
+                barrier()
+                bf.win_free(name)
+
+                # wire-leg isolation (the codec analog of the
+                # fold-vs-stream probe): socket-take the SAME 2-deposit
+                # backlog in its ENCODED form and decode it — the leg
+                # the codec compresses, reported at the app-level
+                # effective rate. On wire-bound paths this ratio is what
+                # a full win_update converges to; on a CPU-bound
+                # loopback box the full-op number also pays the
+                # combine/publish legs the codec cannot shrink
+                # (PERF.md r15 discusses both).
+                from bluefog_tpu.ops import codec as _cd
+
+                cobj = _cd.resolve(codec)
+                enc = cobj.encode(x[0]).tobytes()
+                chunk = 16 << 20
+                recs2 = [enc[o:o + chunk]
+                         for o in range(0, len(enc), chunk)] * 2
+                key = f"wb.cfvs.{pid}"
+                ts = []
+                dec_out = np.empty(elems, np.float32)
+                for _ in range(rounds):
+                    cl.append_bytes_many([key] * len(recs2), recs2)
+                    barrier()
+                    t0 = time.perf_counter()
+                    got = []
+                    while True:
+                        part = cl.take_bytes(key)
+                        if not part:
+                            break
+                        got.extend(part)
+                    buf = b"".join(bytes(r) for r in got)
+                    for dep in range(2):
+                        seg = np.frombuffer(
+                            buf, np.uint8)[dep * len(enc):
+                                           (dep + 1) * len(enc)]
+                        cobj.decode(seg, np.float32, elems, out=dec_out)
+                    ts.append(time.perf_counter() - t0)
+                report(cl, pid, tag, "drain_stream", ts, 2 * row_bytes,
+                       codec=codec)
+            finally:
+                os.environ.pop("BLUEFOG_WIN_CODEC", None)
 
     bf.shutdown()
     if pid == 0:

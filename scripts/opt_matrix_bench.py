@@ -22,6 +22,14 @@ Usage:  python scripts/opt_matrix_bench.py [--chip] [--quick] [--modes ...]
            the acceptance bar is >= 1.5x. Then replays the plane
            equivalence suite (tests/test_win_planes.py) so the speedup and
            the bit-exactness/mass-conservation proofs come from one run.
+  --codec: sweep BLUEFOG_WIN_CODEC (none, int8, fp8, topk:0.01) over the
+           win_put optimizer on the same world-1 hosted-window harness
+           (plane pinned to `hosted`). NOTE the world-1 harness has no
+           cross-controller wire — every deposit folds locally — so this
+           sweep isolates the HOST-SIDE codec cost (encode + decode per
+           gossip step, `speedup_vs_none` < 1 by construction); the wire
+           win itself is win_microbench --codec's 4-process measurement
+           (docs/compression.md).
 """
 
 import argparse
@@ -72,6 +80,9 @@ def run_mode(mode: str, simulate: int, extra=(), quick: bool = False) -> dict:
 
 # (plane, overlap) sweep of the hybrid harness; "hosted"/ov0 is the baseline
 HYBRID_SWEEP = [("hosted", "0"), ("auto", "0"), ("auto", "1")]
+
+# wire-codec sweep on the forced-hosted harness; "none" is the baseline
+CODEC_SWEEP = ["none", "int8", "fp8", "topk:0.01"]
 
 
 def _free_port() -> int:
@@ -140,6 +151,57 @@ def run_hybrid(modes, quick: bool) -> int:
     return rc or int(t.returncode != 0)
 
 
+def run_codec_mode(mode: str, codec: str, quick: bool = False) -> dict:
+    """One benchmark child on the world-1 hosted-window harness with the
+    wire codec pinned: the plane is forced `hosted` so every gossip byte
+    rides the mailbox wire the codec compresses (the plane policy stays
+    out of the comparison)."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        BLUEFOG_CP_HOST="127.0.0.1", BLUEFOG_CP_PORT=str(_free_port()),
+        BLUEFOG_CP_WORLD="1", BLUEFOG_CP_RANK="0",
+        BLUEFOG_WIN_PLANE="hosted")
+    if codec != "none":
+        env["BLUEFOG_WIN_CODEC"] = codec
+    else:
+        env.pop("BLUEFOG_WIN_CODEC", None)
+    env.pop("BLUEFOG_CP_FAULT", None)  # never bench under fault injection
+    cmd = [sys.executable, "-m", "bluefog_tpu.launcher",
+           "--simulate", "8", "--"]
+    reps = ("1", "2", "1") if quick else ("3", "5", "3")
+    cmd += [sys.executable, str(REPO / "examples" / "benchmark.py"),
+            "--model", "mlp", "--batch-size", "8",
+            "--num-warmup-batches", reps[0], "--num-batches-per-iter",
+            reps[1], "--num-iters", reps[2], "--dist-optimizer", mode,
+            "--disable-dynamic-topology"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
+                       cwd=REPO, env=env)
+    m = RATE_RE.search(r.stdout)
+    base = {"mode": mode, "codec": codec}
+    if r.returncode != 0 or not m:
+        return {**base, "error": (r.stdout + r.stderr)[-500:]}
+    return {**base, "img_per_sec": float(m.group(1)),
+            "ci": float(m.group(2))}
+
+
+def run_codecs(modes, quick: bool) -> int:
+    rc = 0
+    for mode in modes:
+        baseline = None
+        for codec in CODEC_SWEEP:
+            res = run_codec_mode(mode, codec, quick=quick)
+            res["where"] = "cpu-mesh-8dev-mlp-b8-cp1-hosted-win"
+            if "error" in res:
+                rc = 1
+            elif codec == "none":
+                baseline = res["img_per_sec"]
+            elif baseline:
+                res["speedup_vs_none"] = round(
+                    res["img_per_sec"] / baseline, 2)
+            print(json.dumps(res), flush=True)
+    return rc
+
+
 def run_chip_mode(mode: str) -> dict:
     cmd = [sys.executable, str(REPO / "examples" / "benchmark.py"),
            "--model", "resnet50", "--batch-size", "64",
@@ -159,9 +221,12 @@ def main() -> int:
     ap.add_argument("--chip", action="store_true")
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--hybrid", action="store_true")
+    ap.add_argument("--codec", action="store_true")
     ap.add_argument("--modes", nargs="*", default=None)
     args = ap.parse_args()
     rc = 0
+    if args.codec:
+        return run_codecs(args.modes or ["win_put"], quick=args.quick)
     if args.hybrid:
         return run_hybrid(args.modes or ["win_put"], quick=args.quick)
     if args.chip:

@@ -13,9 +13,12 @@ of percent run to run, a real regression (a serialization bug, an extra
 copy, a lost overlap) costs 2-10x.
 
 Only the *stable* quick-mode series gate: the hosted window ops
-(win_put / win_accumulate / win_update / win_get MB/s) and the optimizer
-step rates. Sub-millisecond raw-socket probes are reported in the JSON but
-never gate — their quick-mode medians swing 3x on scheduler whim.
+(win_put / win_accumulate / win_update / win_get MB/s), the optimizer
+step rates, and — since r15, after two stable rounds per the
+stable-series rule — the ``hybrid.*`` plane-sweep rates. Sub-millisecond
+raw-socket probes and the new ``codec.*`` compressed-wire series are
+reported in the JSON but never gate (codec.* graduates the same way
+hybrid.* did once it shows two stable rounds).
 
 Exit codes: 0 pass, 1 regression (or a bench failed), 2 usage/baseline
 problems.
@@ -66,13 +69,21 @@ def _run(cmd, timeout) -> str:
 def collect_once() -> dict:
     """One pass over both harnesses -> {metric: value} (higher = better)."""
     out: dict = {}
-    text = _run([sys.executable, "scripts/win_microbench.py", "--quick"],
-                timeout=900)
+    # the --codec sweep rides the SAME 4-process run (extra rows after the
+    # plain series, which stay untouched): `codec.*` series are info-only
+    # per the stable-series rule (see gating())
+    text = _run([sys.executable, "scripts/win_microbench.py", "--quick",
+                 "--codec", "int8,topk:0.01"], timeout=900)
     for line in text.splitlines():
         line = line.strip()
         if not line.startswith("{"):
             continue
         row = json.loads(line)
+        if row.get("codec"):
+            if row.get("mbps") is not None:
+                out[f"codec.{row['codec']}.{row['config']}.{row['op']}"
+                    ".mbps"] = row["mbps"]
+            continue
         if row.get("mbps") is not None:
             out[f"win.{row['config']}.{row['op']}.mbps"] = row["mbps"]
     text = _run([sys.executable, "scripts/opt_matrix_bench.py", "--quick",
@@ -87,10 +98,9 @@ def collect_once() -> dict:
                 f"opt_matrix_bench mode {row['mode']} failed: "
                 f"{row['error']}")
         out[f"opt.{row['mode']}.img_per_sec"] = row["img_per_sec"]
-    # hybrid plane sweep (ISSUE r13): reported as `hybrid.*` series, which
-    # are INFO-ONLY per the stable-series rule — they join the gating set
-    # only after two stable rounds (move them out of the exclusion in
-    # gating() and re-run --update-baseline then)
+    # hybrid plane sweep (ISSUE r13): `hybrid.*` series — GATING since r15
+    # (two stable rounds elapsed per the stable-series rule; baseline
+    # refreshed alongside)
     text = _run([sys.executable, "scripts/opt_matrix_bench.py", "--quick",
                  "--hybrid"], timeout=1800)
     for line in text.splitlines():
@@ -132,12 +142,13 @@ def collect(repeats: int) -> dict:
 def gating(metrics: dict) -> dict:
     keep = {}
     for name, v in metrics.items():
-        if name.startswith("hybrid."):
-            # r13 hybrid-plane series: info-only until two stable rounds
-            # (the gate's stable-series rule) — then delete this branch
-            # and refresh the baseline
+        if name.startswith("codec."):
+            # r15 compressed-wire series: info-only until two stable
+            # rounds (the gate's stable-series rule) — then delete this
+            # branch and refresh the baseline, exactly as the hybrid.*
+            # series graduated in r15
             continue
-        if name.startswith("opt.") or \
+        if name.startswith("opt.") or name.startswith("hybrid.") or \
                 any(name.endswith(f"{op}.mbps") or f".{op}." in name
                     for op in _GATING_OPS):
             keep[name] = v
@@ -178,10 +189,11 @@ def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
             "host": platform.node(),
             "repeats": repeats,
             "band": band,
-            "harnesses": ["win_microbench --quick",
+            "harnesses": ["win_microbench --quick --codec int8,topk:0.01 "
+                          "(codec.* info-only)",
                           "opt_matrix_bench --quick --modes "
                           + " ".join(_OPT_MODES),
-                          "opt_matrix_bench --quick --hybrid (info-only)"],
+                          "opt_matrix_bench --quick --hybrid"],
             "note": "quick-mode numbers: gate-relative only, meaningless "
                     "as absolute throughput (see PERF.md for real runs)",
         },

@@ -19,10 +19,16 @@ Also prints a fold-vs-stream isolation line per config: the same drained
 bytes timed as (a) the socket take alone and (b) the numpy fold alone, so
 the drain pipeline's overlap headroom is a measured number, not a guess.
 
-Usage:  python scripts/win_microbench.py [--quick]
+Usage:  python scripts/win_microbench.py [--quick] [--codec LIST]
   --quick: tiny windows, 2 rounds, 1 warmup — seconds instead of minutes;
            exercised by the CI smoke test (tests/test_benchmark_smoke.py),
            numbers are NOT meaningful for PERF.md.
+  --codec: comma-separated wire codecs (e.g. ``int8,fp8,topk:0.01``) to
+           additionally sweep on the headline config's win_put/win_update
+           series (docs/compression.md). ``mbps`` in codec rows is the
+           EFFECTIVE rate — app-level payload bytes over wall time — so
+           the compressed-vs-raw comparison reads off directly (the int8
+           ``>= 2x win_update`` acceptance bar, PERF.md r15).
 """
 
 import argparse
@@ -45,12 +51,17 @@ def free_port() -> int:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--codec", type=str, default=None,
+                    help="comma-separated wire codecs to sweep "
+                         "(int8,fp8,topk:<frac>) on the headline config")
     args = ap.parse_args()
     env = os.environ.copy()
     if args.quick:
         env["BLUEFOG_WB_QUICK"] = "1"
+    if args.codec:
+        env["BLUEFOG_WB_CODECS"] = args.codec
     for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE",
-              "BLUEFOG_CP_HOST", "BLUEFOG_CP_PORT"):
+              "BLUEFOG_CP_HOST", "BLUEFOG_CP_PORT", "BLUEFOG_WIN_CODEC"):
         env.pop(k, None)
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     # host-plane bench on a simulated mesh: skip the TPU-plugin probe (a

@@ -73,13 +73,21 @@ def test_gating_filter_keeps_stable_series_only():
         "win.f32.raw_put_bytes.mbps": 1.0,   # noisy: out
         "win.f32.drain_fold.mbps": 1.0,      # noisy: out
         "opt.win_put.img_per_sec": 1.0,
-        # r13 hybrid-plane series: info-only until two stable rounds
+        # r13 hybrid-plane series: GATING since r15 (two stable rounds
+        # elapsed per the stable-series rule)
         "hybrid.win_put.auto.ov0.img_per_sec": 1.0,
         "hybrid.win_put.hosted.ov0.img_per_sec": 1.0,
+        # r15 compressed-wire series: info-only until two stable rounds —
+        # note `codec.*` names embed `.win_put.` / `.win_update.`, so the
+        # prefix exclusion must fire BEFORE the op-name match
+        "codec.int8.f32.win_put.mbps": 1.0,
+        "codec.topk:0.01.f32.win_update.mbps": 1.0,
     }
     kept = pg.gating(metrics)
     assert set(kept) == {"win.f32.win_put.mbps", "win.f32.win_update.mbps",
-                         "opt.win_put.img_per_sec"}
+                         "opt.win_put.img_per_sec",
+                         "hybrid.win_put.auto.ov0.img_per_sec",
+                         "hybrid.win_put.hosted.ov0.img_per_sec"}
 
 
 # ---------------------------------------------------------------------------
