@@ -57,13 +57,17 @@ flight-smoke:    ## flight-recorder acceptance: < 1500 ns ring-record
                  ## merged clock-synced trace from a separate process
 	JAX_PLATFORMS=cpu python scripts/flight_smoke.py
 
-soak-smoke:      ## sharded-control-plane churn soak, quick mode (<= 60 s):
-                 ## 2 shard server processes, ~64 raw clients with
-                 ## incarnation churn, one injected SIGKILL — asserts health
-                 ## convergence, exactly-once counters, conserved deposit
-                 ## mass, bounded server RSS (no JAX anywhere; full mode:
-                 ## scripts/cp_soak.py --clients 500 --churn)
+soak-smoke:      ## durable sharded-control-plane churn soak, quick mode
+                 ## (<= 2 min): 2 WAL-replicated shard server processes,
+                 ## ~64 raw clients with incarnation churn, one injected
+                 ## SIGKILL — asserts ZERO lost deposit mass, exactly-once
+                 ## counters continuous across the failover, health
+                 ## convergence, bounded server RSS; then a second pass
+                 ## with --rejoin (kill + in-place restart with snapshot
+                 ## catch-up, ring converges back). No JAX anywhere; full
+                 ## mode: scripts/cp_soak.py --clients 5000 --churn --rejoin
 	python scripts/cp_soak.py --quick
+	python scripts/cp_soak.py --quick --rejoin
 
 perf-gate:       ## perf regression gate: quick win_microbench +
                  ## opt_matrix_bench medians vs the committed

@@ -327,23 +327,50 @@ def _spawn_shard_servers(n: int, total: int, advertise_host: str):
     (``bfrun --cp-shards N``); returns (procs, BLUEFOG_CP_HOSTS value).
     Blocks until every shard prints its READY line so children can never
     race a bind; server processes inherit the freshly minted job secret
-    through the environment."""
+    through the environment.
+
+    With ``BLUEFOG_CP_REPLICATION`` (default on) and N > 1 the spawn is
+    two-phase: every shard reports its bound port first, the full ring is
+    written back over stdin, and each shard wires WAL replication to its
+    ring successor before declaring READY — an acked control-plane write
+    then survives any single shard's SIGKILL."""
+    from .runtime.config import knob_env
+
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "runtime", "shard_server.py")
-    procs, eps = [], []
+    replicate = n > 1 and bool(int(knob_env("BLUEFOG_CP_REPLICATION")))
+    procs = []
+
+    def _fail(i, why):
+        for q in procs:
+            q.terminate()
+        raise RuntimeError(f"control-plane shard {i} failed to start: {why}")
+
     for i in range(n):
-        p = subprocess.Popen(
-            [sys.executable, script, "--port", "0", "--world", str(total),
-             "--shard", str(i)],
-            stdout=subprocess.PIPE, text=True)
+        cmd = [sys.executable, script, "--port", "0", "--world", str(total),
+               "--shard", str(i)]
+        if replicate:
+            cmd.append("--expect-peers")
+        procs.append(subprocess.Popen(
+            cmd, stdout=subprocess.PIPE,
+            stdin=subprocess.PIPE if replicate else None, text=True))
+    ports = []
+    marker = "BF_SHARD_PORT" if replicate else "BF_SHARD_READY"
+    for i, p in enumerate(procs):
         line = p.stdout.readline()
-        if not line.startswith("BF_SHARD_READY"):
-            for q in procs + [p]:
-                q.terminate()
-            raise RuntimeError(
-                f"control-plane shard {i} failed to start")
-        procs.append(p)
-        eps.append(f"{advertise_host}:{int(line.split()[1])}")
+        if not line.startswith(marker):
+            _fail(i, repr(line))
+        ports.append(int(line.split()[1]))
+    if replicate:
+        ring = ",".join(f"127.0.0.1:{port}" for port in ports)
+        for i, p in enumerate(procs):
+            p.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+            p.stdin.flush()
+        for i, p in enumerate(procs):
+            line = p.stdout.readline()
+            if not line.startswith("BF_SHARD_READY"):
+                _fail(i, repr(line))
+    eps = [f"{advertise_host}:{port}" for port in ports]
     return procs, ",".join(eps)
 
 
@@ -660,6 +687,7 @@ def _status(args) -> int:
             print("  (no rank has published metrics — is "
                   "BLUEFOG_METRICS_INTERVAL set on the job?)")
         dead_shards = []
+        under_replicated = []
         if hasattr(cl, "server_stats_all"):
             # sharded plane: merge the per-shard server views; a dead
             # shard is a named row, never a raised probe failure
@@ -669,17 +697,31 @@ def _status(args) -> int:
                     print(f"    {name}: DEAD")
                     dead_shards.append(name)
                 else:
+                    repl = {0: "off", 1: "live", 2: "DEGRADED"}.get(
+                        st.get("repl_status", 0), "?")
+                    lag = st.get("wal_enqueued", 0) - st.get("wal_acked", 0)
                     print(f"    {name}: conns={st['live_connections']} "
                           f"kv={st['kv_entries']} "
                           f"mailbox={st['mailbox_records']} recs/"
                           f"{st['mailbox_bytes']} B "
                           f"locks={st['locks_held']} "
-                          f"stale_rejects={st['stale_rejects']}")
+                          f"stale_rejects={st['stale_rejects']} "
+                          f"repl={repl} wal_lag={lag} "
+                          f"wal_dropped={st.get('wal_dropped', 0)}")
+                    if st.get("repl_status", 0) == 2:
+                        # successor lagging/absent: this shard is serving
+                        # acked writes that live NOWHERE else
+                        under_replicated.append(name)
         if getattr(args, "strict", False):
             findings = _strict_findings(health)
             if dead_shards:
                 findings.append(
                     f"dead control-plane shard(s): {dead_shards}")
+            if under_replicated:
+                findings.append(
+                    "under-replicated control-plane shard(s) (WAL "
+                    f"degraded, successor lagging or absent): "
+                    f"{under_replicated}")
             if findings:
                 for f in findings:
                     print(f"  STRICT: {f}", file=sys.stderr)

@@ -265,6 +265,13 @@ def _attach_sharded(hosts_spec, shards, host, port, rank, world, secret):
             logger.debug("shard servers not started here (%s)", exc)
             _stop_servers()
         else:
+            if len(_servers) > 1 and int(knob_env("BLUEFOG_CP_REPLICATION")):
+                # durable plane (r16): each in-process shard streams its
+                # WAL to its ring successor, so a key's failover target
+                # already holds its mailbox/KV/lock state
+                for i, srv in enumerate(_servers):
+                    _, sp = endpoints[(i + 1) % len(endpoints)]
+                    srv.set_successor("127.0.0.1", sp, len(endpoints), i)
             # Every shard publishes ITS OWN effective cap (value + 1, so a
             # missing key's 0 stays distinguishable). Deliberately written
             # per shard, never through the router: a router write would

@@ -491,7 +491,7 @@ def chrome_events(doc: dict) -> list:
 
 # native flight-ring kinds (mirror of csrc/bf_runtime.cc FlightRec callers)
 _NATIVE_KINDS = {1: "redial_attempt", 2: "redial", 3: "stale_frame",
-                 4: "stripe", 5: "striped_xfer"}
+                 4: "stripe", 5: "striped_xfer", 6: "shard_failover"}
 
 
 def merge_dumps(docs: List[dict]) -> list:

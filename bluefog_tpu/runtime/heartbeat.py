@@ -174,6 +174,26 @@ class PeerMonitor:
         _metrics.gauge("cp.dead_shards").set(len(dead))
         for idx in sorted(dead - before):
             timeline_instant(f"cp.shard.{idx}", "SHARD_DEAD")
+        for idx in sorted(before - dead):
+            timeline_instant(f"cp.shard.{idx}", "SHARD_REJOIN")
+        # Replication health (durable plane, r16): the max WAL lag across
+        # live shards and the count of shards serving UNREPLICATED
+        # (degraded / successor lost) — the gauges `bfrun --status
+        # --strict` mirrors as under-replication findings.
+        try:
+            lag = 0
+            under = 0
+            for _name, st in cl.server_stats_all():
+                if not st:
+                    continue
+                if st.get("repl_status") == 1:
+                    lag = max(lag, st["wal_enqueued"] - st["wal_acked"])
+                elif st.get("repl_status") == 2:
+                    under += 1
+            _metrics.gauge("cp.repl_lag").set(lag)
+            _metrics.gauge("cp.under_replicated").set(under)
+        except (OSError, RuntimeError):
+            pass  # stats probe must never break the heartbeat cadence
 
     def _tick(self) -> None:
         cl = self._cl if self._cl is not None else _cp.client()

@@ -122,6 +122,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_cp_serve_auth2.argtypes = [ctypes.c_int, ctypes.c_int,
                                       ctypes.c_char_p, ctypes.c_int64,
                                       ctypes.c_int]
+    lib.bf_cp_serve_auth3.restype = ctypes.c_void_p
+    lib.bf_cp_serve_auth3.argtypes = [ctypes.c_int, ctypes.c_int,
+                                      ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.c_int, ctypes.c_int]
     lib.bf_cp_server_port.restype = ctypes.c_int
     lib.bf_cp_server_port.argtypes = [ctypes.c_void_p]
     lib.bf_cp_server_stop.restype = None
@@ -240,6 +244,25 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.bf_flight_ring.restype = ctypes.c_int
     lib.bf_flight_ring.argtypes = [
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+    # WAL replication + shard rejoin (r16 durable control plane)
+    lib.bf_cp_server_set_successor.restype = ctypes.c_int
+    lib.bf_cp_server_set_successor.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int]
+    lib.bf_cp_snapshot.restype = ctypes.c_int64
+    lib.bf_cp_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.bf_cp_server_load_snapshot.restype = ctypes.c_longlong
+    lib.bf_cp_server_load_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+    lib.bf_cp_server_set_rejoin_pending.restype = None
+    lib.bf_cp_server_set_rejoin_pending.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_set_failover.restype = None
+    lib.bf_cp_set_failover.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int]
+    lib.bf_cp_failed_over.restype = ctypes.c_int
+    lib.bf_cp_failed_over.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -358,9 +381,10 @@ def flight_events() -> list:
     """The native transport's flight ring, oldest -> newest: a list of
     ``[wall_us, kind, a, b]`` rows (kinds: 1 redial attempt, 2 redial
     success, 3 stale frame, 4 per-stripe timing, 5 whole striped
-    transfer; a/b are bytes/micros for the timed kinds). Spliced into
-    flight-recorder dumps (runtime/flight.py); empty when the native
-    runtime is unavailable."""
+    transfer, 6 failover redirect to the ring successor; a/b are
+    bytes/micros for the timed kinds). Spliced into flight-recorder
+    dumps (runtime/flight.py); empty when the native runtime is
+    unavailable."""
     lib = load()
     if lib is None:
         return []
@@ -572,12 +596,16 @@ class _MultiReply:
         return False
 
 
-_SRV_STAT_SLOTS = 43  # 32 per-op counts + 11 aggregates (csrc layout)
+_SRV_STAT_SLOTS = 48  # 32 per-op counts + 16 aggregates (csrc layout)
 
 
 def _server_stats_dict(buf) -> dict:
-    """Decode the 43-slot server counter block (one layout, two transports:
-    the in-process bf_cp_server_counters read and the kStats wire op)."""
+    """Decode the 48-slot server counter block (one layout, two transports:
+    the in-process bf_cp_server_counters read and the kStats wire op).
+    Slots 43-47 are the WAL-replication view: ``repl_status`` is 0 when no
+    successor is configured, 1 while the chain commit is live, 2 when the
+    shard DEGRADED to unreplicated (`bfrun --status --strict` reports 2 as
+    an under-replicated finding)."""
     ops = {name: int(buf[code]) for code, name in _OP_NAMES.items()
            if buf[code]}
     return {
@@ -593,6 +621,11 @@ def _server_stats_dict(buf) -> dict:
         "kv_entries": int(buf[40]),
         "bytes_slots": int(buf[41]),
         "bytes_slot_bytes": int(buf[42]),
+        "wal_enqueued": int(buf[43]),
+        "wal_acked": int(buf[44]),
+        "wal_dropped": int(buf[45]),
+        "repl_status": int(buf[46]),
+        "repl_applied": int(buf[47]),
     }
 
 
@@ -609,16 +642,22 @@ class ControlPlaneServer:
 
     def __init__(self, world: int, port: int = 0, secret: str = "",
                  max_mailbox_bytes: int = 0,
-                 sockbuf_bytes: Optional[int] = None) -> None:
+                 sockbuf_bytes: Optional[int] = None,
+                 rejoin_pending: bool = False) -> None:
         lib = load()
         if lib is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = lib
         if sockbuf_bytes is None:
             sockbuf_bytes = _env_sockbuf_bytes()
-        self._h = lib.bf_cp_serve_auth2(port, world, secret.encode(),
+        # rejoin_pending arms the rejoin gate ATOMICALLY with the bind: a
+        # restarted shard accepts connections from construction, and not
+        # one op may execute against the empty store before the snapshot
+        # catch-up lands (set_successor opens the gate).
+        self._h = lib.bf_cp_serve_auth3(port, world, secret.encode(),
                                         int(max_mailbox_bytes),
-                                        int(sockbuf_bytes))
+                                        int(sockbuf_bytes),
+                                        1 if rejoin_pending else 0)
         if not self._h:
             raise OSError(f"control plane failed to bind port {port}")
         self.port = lib.bf_cp_server_port(self._h)
@@ -636,6 +675,38 @@ class ControlPlaneServer:
         if self._h:
             self._lib.bf_cp_server_drop_conns(self._h)
 
+    # -- WAL replication / rejoin (r16 durable control plane) --------------
+
+    def set_successor(self, host: str, port: int, nshards: int = 0,
+                      idx: int = -1) -> None:
+        """Start streaming this server's mailbox/KV/lock mutations to its
+        ring successor (chain commit: client replies wait for the
+        successor's ack). ``nshards``/``idx`` give the server its ring
+        position — the kSnapshot filter and the scoped incarnation GC key
+        off it. One-shot per server."""
+        if self._lib.bf_cp_server_set_successor(
+                self._h, host.encode(), int(port), int(nshards),
+                int(idx)) < 0:
+            raise RuntimeError("replication successor already configured")
+
+    def set_rejoin_pending(self) -> None:
+        """Arm the rejoin gate BEFORE pulling a snapshot: incoming WAL
+        records park until :meth:`load_snapshot` (with ``set_fence``)
+        clears it, so the resumed stream cannot interleave with the
+        not-yet-loaded snapshot contents."""
+        self._lib.bf_cp_server_set_rejoin_pending(self._h)
+
+    def load_snapshot(self, blob: bytes, set_fence: bool = True) -> int:
+        """Apply a snapshot blob pulled from a peer shard (rejoin
+        catch-up); returns the record count applied. ``set_fence`` adopts
+        the blob's WAL fence so the predecessor's resumed stream skips
+        records already folded into the snapshot."""
+        r = int(self._lib.bf_cp_server_load_snapshot(
+            self._h, blob, len(blob), 1 if set_fence else 0))
+        if r < 0:
+            raise RuntimeError("malformed control-plane snapshot blob")
+        return r
+
     # -- introspection (chaos tests assert incarnation GC left nothing) ----
 
     def dedup_entries(self) -> int:
@@ -650,7 +721,7 @@ class ControlPlaneServer:
         """Registered incarnation of ``rank`` (-1 = never attached)."""
         return int(self._lib.bf_cp_server_incarnation(self._h, rank))
 
-    _SRV_SLOTS = 43  # 32 per-op counts + 11 aggregates (csrc layout)
+    _SRV_SLOTS = _SRV_STAT_SLOTS
 
     def stats(self) -> dict:
         """Server-side telemetry: per-op dispatch counts (zero rows
@@ -864,6 +935,39 @@ class ControlPlaneClient:
         r = self._lib.bf_cp_put_max(self._h, name.encode(), value)
         self._check_stale(r)
         return r
+
+    def set_failover(self, host: str, port: int) -> None:
+        """Name the ring-successor endpoint this client may permanently
+        redirect to when its primary stops answering mid-call. The
+        redirect happens INSIDE the native retry loop, so the re-sent
+        request keeps its kSeqPre (cid, seq) identity — on a replicated
+        shard pair the successor replays the WAL-recorded reply instead
+        of double-applying (exactly-once across failover)."""
+        self._lib.bf_cp_set_failover(self._h, host.encode(), int(port))
+
+    def failed_over(self) -> bool:
+        """True once this client permanently redirected to its failover
+        target (lock-free read — safe next to a blocked op)."""
+        return bool(self._lib.bf_cp_failed_over(self._h))
+
+    def snapshot(self, filter_shards: int = 0, filter_idx: int = 0) -> bytes:
+        """Pull a point-in-time state snapshot from the connected server
+        (kSnapshot; the shard-rejoin catch-up transport). With
+        ``filter_shards`` > 0 only keys whose preferred shard
+        (fnv64 % filter_shards) equals ``filter_idx`` are included."""
+        arg = (int(filter_shards) << 32) | (int(filter_idx) & 0xFFFFFFFF) \
+            if filter_shards else 0
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        r = self._lib.bf_cp_snapshot(self._h, arg, ctypes.byref(out),
+                                     ctypes.byref(out_len))
+        if r < 0:
+            self._wire_error("control plane snapshot pull failed")
+        try:
+            return ctypes.string_at(out.value, out_len.value) \
+                if out_len.value else b""
+        finally:
+            self._lib.bf_cp_free(out)
 
     def server_stats(self) -> dict:
         """The server's telemetry counter block, read over the wire (the

@@ -96,6 +96,15 @@ OPS: Tuple[OpSpec, ...] = (
            "pure read of the server's telemetry counter block — how an "
            "external actor merges per-shard views without owning the "
            "server handle"),
+    OpSpec("repl_apply", 21, "kReplApply", False,
+           "one WAL record streamed shard-to-shard by the replicator "
+           "thread (durable control plane); double-applied it would "
+           "duplicate a replicated deposit or double-advance a replicated "
+           "counter, so the inter-shard stream rides kSeqPre dedup like "
+           "any other non-idempotent op"),
+    OpSpec("snapshot", 22, "kSnapshot", True,
+           "pure point-in-time state dump (shard rejoin catch-up); "
+           "re-reading it merely re-serializes the store"),
 )
 
 # name -> wire code (the table every Python-side consumer keys off)

@@ -26,21 +26,28 @@ have:
 
 * **Failover**: when a shard stops answering (its native client exhausted
   the r8 redial budget — the same path that survives transient drops), the
-  router marks it dead, publishes ``bf.cp.shard_dead.<i>`` to the
-  survivors so every other process converges on the same routing within a
-  heartbeat interval, and re-routes the dead shard's keyspace to the next
-  live shard on the ring. In-flight non-idempotent ops stay exactly-once:
-  an op the dead shard acked died with that shard's state, and the re-send
-  lands exactly once on the replica (the per-connection kSeqPre dedup
-  protects the re-send against ordinary wire drops exactly as before).
+  router marks it dead, publishes a generation under
+  ``bf.cp.shard_dead.<i>`` (odd = dead, even = rejoined) to the survivors
+  so every other process converges on the same routing within a heartbeat
+  interval, and re-routes the dead shard's keyspace to the next live shard
+  on the ring. Each per-shard native client also carries its ring
+  successor as a FAILOVER REDIRECT target: a call in flight when the
+  shard dies redials the successor on the same client — same kSeqPre
+  (cid, seq) identity — so on a WAL-replicated pair the successor replays
+  the pre-recorded reply instead of double-applying (exactly-once across
+  the failover boundary, including drained-haul replies: zero lost
+  deposits).
 
-Caveats vs the single-server plane are documented in
-docs/fault_tolerance.md ("Control-plane sharding & failover"): ROUTED
-(non-replicated) state on a killed shard — queued mailbox deposits not yet
-drained, scalar counters, published bytes slots — is lost with it; locks
-held on a dead shard surface PeerLostError on the holder's next unlock
-(typed degradation, the critical section may have been entered by a peer
-via the failover replica); dead shards never rejoin within a job.
+* **Rejoin** (r16): a restarted shard server that caught up from its
+  successor's snapshot + WAL publishes an EVEN generation under its
+  ``bf.cp.shard_dead.<i>`` key; routers observe it on the next health
+  poll, dial the endpoint fresh, and move the keyspace back. Redirected
+  clients are replaced, never flipped back mid-stream.
+
+With ``BLUEFOG_CP_REPLICATION=0`` (the r14 wire) the remaining caveats are
+documented in docs/fault_tolerance.md: routed state on a killed shard is
+lost with it and locks held there surface PeerLostError instead of
+handing off.
 """
 
 from __future__ import annotations
@@ -61,14 +68,26 @@ from .native import (ControlPlaneClient, PeerLostError,  # noqa: F401
 _REPL_EXACT = frozenset({"bf.membership.epoch"})
 _REPL_PREFIX = ("bf.inc.", "bf.q.", "bf.shutdown.", "bf.cp.")
 
+# Per-shard liveness GENERATION (monotone, merged with put_max): 0 = never
+# died, odd = dead, even (> 0) = rejoined. A router declaring a death bumps
+# an even value to the next odd one; a rejoined shard server publishes the
+# next even value after its snapshot catch-up. Monotone merge keeps the
+# transitions race-free under failover reordering (a late duplicate can
+# never flip a rejoined shard back to dead).
 _DEAD_FLAG = "bf.cp.shard_dead.{idx}"
+
+
+def _gen_dead(gen: int) -> bool:
+    return gen > 0 and gen % 2 == 1
 
 # Endpoints whose death was already ERROR-announced by THIS process: many
 # routers (one per subsystem, hundreds in the soak) detect the same death
 # within milliseconds, and one loud line per process is signal while N
 # identical ones are noise. Guarded by the GIL (set.add is atomic enough
-# for a log-dedup).
+# for a log-dedup). _announced_alive dedups the matching REJOIN line per
+# liveness generation.
 _announced_dead: set = set()
+_announced_alive: set = set()
 
 _FNV_OFFSET = 0xcbf29ce484222325
 _FNV_PRIME = 0x100000001b3
@@ -151,8 +170,14 @@ class ShardRouter:
             raise ValueError("ShardRouter needs at least one endpoint")
         self._st = shared_state or _ShardState(endpoints)
         self._rank = rank
+        self._secret = secret
+        self._streams = streams
         self.incarnation = None if incarnation is None else int(incarnation)
         self._clients: List[Optional[ControlPlaneClient]] = []
+        # Clients superseded by a shard rejoin are parked here (closed at
+        # router close): another thread may still be mid-call on one, and
+        # closing a native client under a live call is a use-after-free.
+        self._zombies: List[ControlPlaneClient] = []
         unreachable: List[int] = []
 
         def _bail(exc: Optional[Exception] = None):
@@ -167,9 +192,7 @@ class ShardRouter:
                 self._clients.append(None)
                 continue
             try:
-                self._clients.append(ControlPlaneClient(
-                    host, port, rank, secret=secret, streams=streams,
-                    incarnation=incarnation))
+                self._clients.append(self._dial(idx))
             except StaleIncarnationError:
                 _bail()
                 raise
@@ -191,9 +214,10 @@ class ShardRouter:
                     break
                 except OSError:
                     continue
-            if flags is None or not all(flags):
+            if flags is None or not all(_gen_dead(f) for f in flags):
                 bad = [i for i in unreachable] if flags is None else \
-                    [i for i, f in zip(unreachable, flags) if not f]
+                    [i for i, f in zip(unreachable, flags)
+                     if not _gen_dead(f)]
                 names = ", ".join(
                     "%s:%d" % self._st.endpoints[i] for i in bad)
                 _bail(OSError(
@@ -210,6 +234,21 @@ class ShardRouter:
                 + ", ".join(f"{h}:{p}" for h, p in self._st.endpoints))
         self.streams = max(cl.streams for cl in self._clients
                            if cl is not None)
+
+    def _dial(self, idx: int) -> ControlPlaneClient:
+        """A fresh connection to shard ``idx``, armed with its ring
+        successor as the native failover-redirect target (N > 1): an op
+        in flight when the shard dies redials the successor on the SAME
+        client — preserving the kSeqPre identity the successor's
+        WAL-primed dedup table replays against."""
+        host, port = self._st.endpoints[idx]
+        cl = ControlPlaneClient(host, port, self._rank, secret=self._secret,
+                                streams=self._streams,
+                                incarnation=self.incarnation)
+        n = len(self._st.endpoints)
+        if n > 1:
+            cl.set_failover(*self._st.endpoints[(idx + 1) % n])
+        return cl
 
     # -- topology ----------------------------------------------------------
 
@@ -268,37 +307,131 @@ class ShardRouter:
                 return
             self._st.dead.add(idx)
             dead_n = len(self._st.dead)
+        n = len(self._st.endpoints)
         host, port = self._st.endpoints[idx]
+        succ = (idx + 1) % n
         first = (host, port) not in _announced_dead
         _announced_dead.add((host, port))
         (logger.error if first else logger.debug)(
             "control-plane shard %d (%s:%d) declared DEAD (%s); its "
-            "keyspace fails over to the next live shard on the ring — "
-            "routed state queued there (undrained deposits, scalar "
-            "counters) is lost, replicated membership state is not "
-            "(docs/fault_tolerance.md)", idx, host, port, why)
+            "keyspace fails over to shard %d, the next live shard on the "
+            "ring — with WAL replication the successor already holds its "
+            "mailbox/KV/lock state (zero lost deposits); unreplicated "
+            "(BLUEFOG_CP_REPLICATION=0) routed state is lost with it "
+            "(docs/fault_tolerance.md)", idx, host, port, why, succ)
         try:  # lazy: metrics -> control_plane -> router would be circular
             from . import metrics as _metrics
+            from .timeline import timeline_instant
 
             _metrics.counter("cp.shard_failovers").inc()
+            _metrics.counter("cp.shard_promotions").inc()
             _metrics.gauge("cp.dead_shards").set(dead_n)
+            timeline_instant(f"cp.shard.{succ}", "SHARD_PROMOTED")
         except Exception:  # noqa: BLE001 — telemetry must not mask failover
+            pass
+        try:
+            from . import flight as _flight
+
+            _flight.recorder().instant("cp.shard_dead", a=float(idx))
+            _flight.recorder().instant("cp.shard_promoted", a=float(succ))
+        except Exception:  # noqa: BLE001
             pass
         # Tell every other process (best-effort): their routers adopt the
         # flag on the next heartbeat tick, so the job converges on one
-        # routing instead of split-braining on per-process detection.
+        # routing instead of split-braining on per-process detection. The
+        # flag is a GENERATION: bump the current (even/0) value to the
+        # next odd one; monotone put_max makes concurrent announcers
+        # converge on the same generation.
         flag = _DEAD_FLAG.format(idx=idx)
         for j in self._live():
             try:
-                self._clients[j].put_max(flag, 1)
+                cur = self._clients[j].put_max(flag, 0)
+                if cur >= 0 and not _gen_dead(cur):
+                    self._clients[j].put_max(flag, cur + 1)
             except (OSError, RuntimeError):
                 pass
 
+    def _mark_alive(self, idx: int, why) -> None:
+        """Shard rejoin (even liveness generation observed): dial the
+        endpoint fresh and move its keyspace back. The superseded client
+        (possibly failover-redirected) is parked, never closed mid-call."""
+        with self._st.mu:
+            if idx not in self._st.dead:
+                return
+        try:
+            cl = self._dial(idx)
+        except (OSError, RuntimeError):
+            return  # not actually serving yet; retried on the next poll
+        except StaleIncarnationError:
+            return  # a newer incarnation of this rank owns the identity
+        adopted = False
+        with self._st.mu:
+            if idx in self._st.dead:
+                self._st.dead.discard(idx)
+                adopted = True
+                dead_n = len(self._st.dead)
+        if not adopted:
+            cl.close()
+            return
+        old, self._clients[idx] = self._clients[idx], cl
+        if old is not None:
+            self._zombies.append(old)
+        host, port = self._st.endpoints[idx]
+        _announced_dead.discard((host, port))
+        first = (host, port, why) not in _announced_alive
+        _announced_alive.add((host, port, why))
+        (logger.warning if first else logger.debug)(
+            "control-plane shard %d (%s:%d) REJOINED (%s): snapshot "
+            "catch-up complete, keyspace routing restored", idx, host,
+            port, why)
+        try:
+            from . import metrics as _metrics
+            from .timeline import timeline_instant
+
+            _metrics.counter("cp.shard_rejoins").inc()
+            _metrics.gauge("cp.dead_shards").set(dead_n)
+            timeline_instant(f"cp.shard.{idx}", "SHARD_REJOIN")
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from . import flight as _flight
+
+            _flight.recorder().instant("cp.shard_rejoin", a=float(idx))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _check_failed_over(self, idx: int) -> None:
+        """After a successful call on shard ``idx``'s client: if the
+        native layer permanently redirected it to the ring successor, the
+        primary endpoint is PROBABLY dead — but the redirect may also be
+        stale (the shard has since rejoined) or spurious (a connect-storm
+        dial failure on a live shard). A fresh dial to the true endpoint
+        decides: success swaps the redirected client out (self-heal,
+        no death published — publishing one would wedge the ring dead
+        with a new odd generation nobody re-evens); failure declares the
+        death for the whole job."""
+        cl = self._clients[idx]
+        if cl is None or idx in self._st.dead or not cl.failed_over():
+            return
+        try:
+            fresh = self._dial(idx)
+        except (OSError, RuntimeError):
+            self._mark_dead(idx, "native failover redirect engaged")
+            return
+        except StaleIncarnationError:
+            self._mark_dead(idx, "native failover redirect engaged")
+            return
+        self._zombies.append(cl)
+        self._clients[idx] = fresh
+
     def poll_shard_health(self) -> set:
-        """Heartbeat-tick probe: adopt peer-published shard-dead flags and
-        verify each live shard still answers. Returns the dead set."""
+        """Heartbeat-tick probe: adopt peer-published liveness
+        generations (odd = dead, even = rejoined), verify each live shard
+        still answers, and notice clients whose calls silently redirected
+        to the ring successor. Returns the dead set."""
         n = len(self._st.endpoints)
         keys = [_DEAD_FLAG.format(idx=i) for i in range(n)]
+        gens: dict = {}
         for idx in self._live():
             cl = self._clients[idx]
             try:
@@ -306,9 +439,14 @@ class ShardRouter:
             except OSError as exc:
                 self._mark_dead(idx, exc)
                 continue
+            self._check_failed_over(idx)
             for i, f in enumerate(flags):
-                if f:
-                    self._mark_dead(i, "peer-published failover flag")
+                gens[i] = max(gens.get(i, 0), int(f))
+        for i, g in sorted(gens.items()):
+            if _gen_dead(g):
+                self._mark_dead(i, "peer-published failover flag")
+            elif g > 0 and i in self.dead_shards():
+                self._mark_alive(i, f"liveness generation {g}")
         return self.dead_shards()
 
     # -- failover plumbing -------------------------------------------------
@@ -322,10 +460,17 @@ class ShardRouter:
         for _ in range(len(self._st.endpoints)):
             idx = self._route(key)
             try:
-                return fn(self._clients[idx])
+                out = fn(self._clients[idx])
             except OSError as exc:
                 self._mark_dead(idx, exc)
                 last = exc
+                continue
+            # a call that succeeded by silently redirecting to the ring
+            # successor proves the primary dead — record it so routing
+            # (and every peer, via the published flag) converges now
+            # instead of at the next heartbeat tick
+            self._check_failed_over(idx)
+            return out
         raise OSError(f"all control-plane shards failed for {key!r}: {last}")
 
     def _routed_batch(self, names: Sequence[str], call: Callable) -> list:
@@ -348,6 +493,7 @@ class ShardRouter:
                     self._mark_dead(sidx, exc)
                     pending.extend(idxs)
                     continue
+                self._check_failed_over(sidx)
                 for i, r in zip(idxs, res):
                     out[i] = r
         return out
@@ -606,6 +752,7 @@ class ShardRouter:
                     self._mark_dead(sidx, exc)
                     pending.extend(idxs)
                     continue
+                self._check_failed_over(sidx)
                 owners.append(owner)
                 for i, r in zip(idxs, recs):
                     out[i] = r
@@ -634,6 +781,9 @@ class ShardRouter:
             if cl is not None:
                 cl.close()
         self._clients = [None] * len(self._clients)
+        for cl in self._zombies:
+            cl.close()
+        self._zombies = []
 
     def __enter__(self):
         return self

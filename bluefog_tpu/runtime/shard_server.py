@@ -22,6 +22,18 @@ the server self-publishes its effective mailbox cap under
 ``bf.cp.mailbox_cap_bytes`` so attach-time agreement checks can reject a
 mixed-cap cluster loudly (every shard must publish its OWN value — a
 router must never write this key, or a mismatch would be masked).
+
+Durable-plane peer wiring (r16): with ``--expect-peers`` the handshake is
+two-phase — the server prints ``BF_SHARD_PORT <port>`` first, the spawner
+collects every shard's port and writes one ``BF_SHARD_PEERS
+host:port,host:port,...`` line to each shard's stdin, and only then does
+the server configure its ring successor (WAL replication,
+``BLUEFOG_CP_REPLICATION``) and print the READY line. Ephemeral ports
+(``--port 0``) therefore need no pre-agreed port plan. ``--rejoin``
+(requires an explicit ``--port`` — the routers hold the old endpoint)
+additionally pulls a state snapshot from the ring successor, loads it,
+and publishes the next EVEN liveness generation under
+``bf.cp.shard_dead.<i>`` so every router moves the keyspace back.
 """
 
 from __future__ import annotations
@@ -51,6 +63,7 @@ if __name__ == "__main__" and __package__ in (None, ""):
 import argparse
 import signal
 import threading
+import time
 
 from .config import knob_env
 from .logging import logger
@@ -75,7 +88,80 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mailbox-max-mb", type=float, default=None,
                    help="per-mailbox byte cap (default: the "
                         "BLUEFOG_CP_MAILBOX_MAX_MB registry knob)")
+    p.add_argument("--expect-peers", action="store_true",
+                   help="two-phase start: print BF_SHARD_PORT, read one "
+                        "'BF_SHARD_PEERS host:port,...' line from stdin, "
+                        "wire the ring successor (WAL replication), then "
+                        "print the READY line")
+    p.add_argument("--peers", default=None, metavar="HOST:PORT,...",
+                   help="explicit ring endpoint list (all shards, in "
+                        "index order) when ports are known up front; "
+                        "alternative to --expect-peers")
+    p.add_argument("--rejoin", action="store_true",
+                   help="restarted-shard catch-up: pull a state snapshot "
+                        "from the ring successor, load it, and publish "
+                        "the next even liveness generation before READY "
+                        "(requires --port and a peer list)")
     return p
+
+
+def _parse_peers(spec: str):
+    out = []
+    for item in (spec or "").split(","):
+        item = item.strip()
+        if not item:
+            continue
+        host, _, port = item.rpartition(":")
+        out.append((host, int(port)))
+    return out
+
+
+def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
+    """Restarted-shard catch-up: pull a snapshot from the ring successor
+    (which held this shard's replicated state and served its keyspace
+    since the death), load it, and publish the next EVEN liveness
+    generation so routers move the keyspace back. Serving the snapshot
+    also re-arms the successor-side predecessor stream from the same cut,
+    so snapshot + resumed WAL records are gap-free. For rings larger than
+    two, the predecessor's keyspace (this shard's replica role) is pulled
+    from the predecessor itself — the pull doubles as ITS resync cut."""
+    n = len(peers)
+    succ = (idx + 1) % n
+    pred = (idx - 1) % n
+    deadline = time.monotonic() + float(knob_env("BLUEFOG_CP_REJOIN_TIMEOUT"))
+    last = None
+    while True:
+        try:
+            host, port = peers[succ]
+            cl = ControlPlaneClient(host, port, 0, secret=secret, streams=1)
+            try:
+                if n <= 2:
+                    # successor == predecessor: one pull carries both the
+                    # served keyspace and the replica keyspace, and the
+                    # fence re-arms the (single) incoming stream
+                    srv.load_snapshot(cl.snapshot(), set_fence=True)
+                else:
+                    srv.load_snapshot(cl.snapshot(n, idx), set_fence=False)
+                    ph, pp = peers[pred]
+                    pcl = ControlPlaneClient(ph, pp, 0, secret=secret,
+                                             streams=1)
+                    try:
+                        srv.load_snapshot(pcl.snapshot(n, pred),
+                                          set_fence=True)
+                    finally:
+                        pcl.close()
+            finally:
+                cl.close()
+            logger.warning("shard %d: rejoin catch-up complete (snapshot "
+                           "from shard %d)", idx, succ)
+            return
+        except (OSError, RuntimeError) as exc:
+            last = exc
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"shard {idx}: rejoin catch-up failed within "
+                    f"BLUEFOG_CP_REJOIN_TIMEOUT: {last}") from last
+            time.sleep(0.2)
 
 
 def main(argv=None) -> int:
@@ -85,20 +171,78 @@ def main(argv=None) -> int:
         max_mb = float(knob_env("BLUEFOG_CP_MAILBOX_MAX_MB"))
     cap = int(max_mb * (1 << 20))
     secret = os.environ.get("BLUEFOG_CP_SECRET", "")
+    if args.rejoin and not args.port:
+        print("shard_server: --rejoin requires an explicit --port (the "
+              "routers hold the old endpoint)", file=sys.stderr)
+        return 2
+    # --rejoin arms the rejoin gate ATOMICALLY with the bind: any op
+    # served against the not-yet-loaded store would lose records now and
+    # resurrect them out of order later. The cap self-publish is skipped
+    # in that case — a loopback put would park on the gate, and the
+    # snapshot restores the key anyway.
     srv = ControlPlaneServer(args.world, args.port, secret=secret,
-                             max_mailbox_bytes=cap)
-    # Self-publish the effective cap (value + 1 so 0 still means "not
-    # published") through a loopback client; origins size deposit
-    # pre-checks against the SERVING side's cap, and the attach-time
-    # agreement check compares every shard's copy.
-    try:
-        cl = ControlPlaneClient("127.0.0.1", srv.port, 0, secret=secret,
-                                streams=1)
-        cl.put("bf.cp.mailbox_cap_bytes", cap + 1)
-        cl.close()
-    except OSError as exc:  # serve anyway; attach falls back to its knob
-        logger.warning("shard %d: mailbox-cap self-publish failed (%s)",
-                       args.shard, exc)
+                             max_mailbox_bytes=cap,
+                             rejoin_pending=args.rejoin)
+    if not args.rejoin:
+        # Self-publish the effective cap (value + 1 so 0 still means "not
+        # published") through a loopback client; origins size deposit
+        # pre-checks against the SERVING side's cap, and the attach-time
+        # agreement check compares every shard's copy.
+        try:
+            cl = ControlPlaneClient("127.0.0.1", srv.port, 0, secret=secret,
+                                    streams=1)
+            cl.put("bf.cp.mailbox_cap_bytes", cap + 1)
+            cl.close()
+        except OSError as exc:  # serve anyway; attach falls back to knob
+            logger.warning("shard %d: mailbox-cap self-publish failed (%s)",
+                           args.shard, exc)
+
+    peers = _parse_peers(args.peers) if args.peers else None
+    if args.expect_peers:
+        # two-phase: report the bound port, then wait for the full ring
+        print(f"BF_SHARD_PORT {srv.port}", flush=True)
+        line = sys.stdin.readline()
+        if not line.startswith("BF_SHARD_PEERS"):
+            print(f"shard_server: expected a BF_SHARD_PEERS line, got "
+                  f"{line!r}", file=sys.stderr)
+            srv.stop()
+            return 2
+        peers = _parse_peers(line.split(None, 1)[1])
+    if args.rejoin and not (
+            peers and len(peers) > 1
+            and int(knob_env("BLUEFOG_CP_REPLICATION"))):
+        print("shard_server: --rejoin requires a peer ring with "
+              "BLUEFOG_CP_REPLICATION enabled (the gate would never "
+              "open)", file=sys.stderr)
+        srv.stop()
+        return 2
+    if peers and len(peers) > 1 and int(knob_env("BLUEFOG_CP_REPLICATION")):
+        if args.rejoin:
+            _rejoin_catch_up(srv, args.shard, peers, secret)
+        sh, sp = peers[(args.shard + 1) % len(peers)]
+        srv.set_successor(sh, sp, len(peers), args.shard)
+        logger.info("shard %d: WAL replication to ring successor %s:%d",
+                    args.shard, sh, sp)
+        if args.rejoin:
+            # Announce alive ONLY NOW — after our own WAL stream is armed.
+            # Routers flip traffic back the moment they see the even
+            # generation, and an op served before set_successor would be
+            # acked UNREPLICATED (a split-brain seed the soak caught as
+            # counter-era violations). Monotone put_max + the successor's
+            # WAL propagate the flag to every shard.
+            try:
+                sh0, sp0 = peers[(args.shard + 1) % len(peers)]
+                cl = ControlPlaneClient(sh0, sp0, 0, secret=secret,
+                                        streams=1)
+                flag = f"bf.cp.shard_dead.{args.shard}"
+                cur = cl.put_max(flag, 0)
+                if cur % 2 == 1:
+                    cl.put_max(flag, cur + 1)
+                cl.close()
+            except OSError as exc:
+                logger.warning("shard %d: alive-generation publish failed "
+                               "(%s); routers will not re-route until an "
+                               "operator republishes it", args.shard, exc)
 
     print(f"{READY_MARKER} {srv.port}", flush=True)
     logger.info("control-plane shard %d serving on port %d (world %d, "
@@ -106,6 +250,45 @@ def main(argv=None) -> int:
                 cap)
 
     done = threading.Event()
+    if peers and len(peers) > 1 and int(knob_env("BLUEFOG_CP_REPLICATION")):
+        # Alive keeper: a router whose redirect-verify dial loses a race
+        # under a connect storm can FALSELY publish an odd (dead)
+        # liveness generation for this perfectly live shard — and nothing
+        # else would ever re-even it (the rejoin publish is one-shot).
+        # While this process lives, it periodically re-asserts the next
+        # even generation through its ring successor (whose WAL chains
+        # the monotone put_max around the ring), so a false death claim
+        # self-corrects within a poll interval; a real death stops the
+        # keeper with the process.
+        sh, sp = peers[(args.shard + 1) % len(peers)]
+        flag = f"bf.cp.shard_dead.{args.shard}"
+
+        def _alive_keeper() -> None:
+            cl = None
+            while not done.wait(2.0):
+                try:
+                    if cl is None:
+                        cl = ControlPlaneClient(sh, sp, 0, secret=secret,
+                                                streams=1)
+                    cur = cl.put_max(flag, 0)
+                    if cur % 2 == 1:
+                        cl.put_max(flag, cur + 1)
+                        logger.warning(
+                            "shard %d: re-asserted ALIVE (liveness "
+                            "generation %d -> %d; a peer's death claim "
+                            "was spurious)", args.shard, cur, cur + 1)
+                except OSError:
+                    if cl is not None:
+                        cl.close()
+                    cl = None  # successor briefly away; redial next tick
+            if cl is not None:
+                cl.close()
+
+        # bfcheck: ok-daemon-no-join (keeper must die WITH the process —
+        # its whole job is that a real death stops the re-assertions; the
+        # `done` event stops it on graceful SIGTERM teardown)
+        threading.Thread(target=_alive_keeper, daemon=True,
+                         name="bf-shard-alive").start()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: done.set())
     done.wait()

@@ -268,6 +268,31 @@ enum Op : uint8_t {
   //     `bfrun --status --cp a,b,...`, the soak harness — can merge
   //     per-shard views without owning the server handle.
   kPutMax = 19, kStats = 20,
+  // Durable control plane (r16): per-shard WAL replication to the ring
+  // successor + snapshot-based shard rejoin (chain replication in the
+  // van Renesse & Schneider OSDI'04 shape, generalizing the kPutMax
+  // monotone-merge pattern to a sequence-numbered mutation log).
+  //   kReplApply: one WAL record from a shard server's replicator thread.
+  //     key = the original key, arg = the WAL sequence number; the payload
+  //     carries the original op, its argument, the reply the primary
+  //     computed, the ORIGIN client's dedup identity (cid, seq, idx), and
+  //     for appends the record bytes. The replica applies the mutation to
+  //     its own store (routing sends the dead shard's keyspace here on
+  //     failover, so promotion is a no-op) and, when the origin identity
+  //     is present, records the reply in its dedup table under that
+  //     identity — a client whose primary died mid-call redials the
+  //     successor with the SAME kSeqPre (cid, seq) and is answered from
+  //     the recording instead of double-applying. The op itself rides the
+  //     replicator client's own kSeqPre dedup (IsDedupOp) so inter-shard
+  //     wire drops cannot double-apply a record either.
+  //   kSnapshot: point-in-time state pull (shard rejoin catch-up). arg = 0
+  //     dumps everything; arg = (nshards << 32 | idx) filters to keys
+  //     whose preferred shard (fnv64 % nshards) is idx. The bulk reply is
+  //     a fence (the server's WAL seq at the cut) followed by typed
+  //     records (kv / mailbox / lock / incarnation); serving a snapshot
+  //     also re-arms this server's own replicator from the cut, so the
+  //     requester sees snapshot + every later record — no gap.
+  kReplApply = 21, kSnapshot = 22,
 };
 
 // Reply status codes shared with the Python layer (runtime/native.py):
@@ -372,6 +397,7 @@ constexpr long long kFlightRedial = 2;         // a = attempt index
 constexpr long long kFlightStaleFrame = 3;
 constexpr long long kFlightStripe = 4;         // a = bytes, b = micros
 constexpr long long kFlightStripedXfer = 5;    // a = bytes, b = micros
+constexpr long long kFlightFailover = 6;       // a = attempt index
 constexpr int kFlightCap = 1024;  // power of two
 struct FlightEv { long long t_us, kind, a, b; };
 FlightEv g_flight[kFlightCap];
@@ -583,7 +609,52 @@ struct DedupEntry {
   std::vector<std::string> bulks;
   std::vector<uint8_t> is_bulk;
   uint32_t inflight = 0xFFFFFFFFu;
+  // Highest seq this client has FULLY completed on this server (advanced
+  // when a newer seq re-arms the entry; seqs are monotone per client).
+  // The WAL-replication apply uses it as a duplicate fence: chain commit
+  // guarantees every *acked* op's record was applied on the replica
+  // before the ack left the primary, so a kReplApply record arriving for
+  // a batch at or below this watermark — or for an index this entry
+  // already holds a reply for — is a late duplicate of an op the
+  // failover retry already re-executed here, and must NOT apply.
+  uint64_t done_below = 0;
 };
+
+// Client-side key routing hash, mirrored here for the kSnapshot filter
+// (bluefog_tpu/runtime/router.py `_fnv64` is the Python original — a pure,
+// stable function both sides must agree on).
+uint64_t Fnv64(const std::string& key) {
+  uint64_t h = 0xcbf29ce484222325ull;
+  for (unsigned char b : key) {
+    h ^= b;
+    h *= 0x100000001b3ull;
+  }
+  return h;
+}
+
+// One WAL record: a mutation this shard applied to its routed state,
+// queued (in apply order, seq assigned under the server mutex) for the
+// replicator thread to stream to the ring successor. Carries the ORIGIN
+// client's dedup identity so the replica can pre-record the reply —
+// that is what keeps a failover retry exactly-once (see kReplApply).
+struct ReplRecord {
+  uint64_t seq = 0;       // this server's WAL sequence number
+  uint8_t op = 0;         // original op (kPut/kFetchAdd/kAppendBytes/...)
+  uint8_t record_reply = 0;  // take: replica assembles + records the haul
+  int32_t rank = 0;       // origin client rank (dedup GC attribution)
+  uint64_t cid = 0;       // origin dedup identity (0 = none armed)
+  uint64_t cseq = 0;
+  uint32_t cidx = 0;
+  std::string key;
+  int64_t arg = 0;        // original op argument (value/delta/tag/count)
+  int64_t reply = 0;      // the reply the primary computed
+  std::string data;       // append payload (stored-record bytes, verbatim)
+};
+
+// kReplApply payload header layout (little-endian), before the payload:
+//   u8 op | u8 record_reply | i32 rank | u64 cid | u64 cseq | u32 cidx |
+//   i64 arg | i64 reply
+constexpr size_t kReplHdr = 1 + 1 + 4 + 8 + 8 + 4 + 8 + 8;
 
 // Bounded condvar wait that stays visible to ThreadSanitizer. libstdc++
 // lowers condition_variable::wait_for (steady_clock) to
@@ -599,6 +670,8 @@ inline void BoundedWaitMs(std::condition_variable& cv,
   cv.wait_until(lk, std::chrono::system_clock::now() +
                         std::chrono::milliseconds(ms));
 }
+
+struct ControlClient;  // replicator thread holds one (defined below)
 
 struct ControlServer {
   int listen_fd = -1;
@@ -648,6 +721,117 @@ struct ControlServer {
   std::map<std::string, int64_t> barrier_gen;      // barrier key -> generation
   std::map<std::string, int> barrier_count;
 
+  // -- WAL replication to the ring successor (r16 durable control plane) --
+  //
+  // Ack-before-reply chain commit: a handler applies a mutating op under
+  // `mu`, appends a WAL record (seq assigned under the same hold, so WAL
+  // order == apply order), and blocks until the replicator thread has
+  // streamed the record to the successor and seen its ack — only then is
+  // the client's reply written. An acked write therefore lives on two
+  // shards, and a SIGKILL of this one loses nothing that was acked.
+  // When the successor stops answering (or the ack wait times out) the
+  // plane DEGRADES to unreplicated — availability over replication —
+  // queued and subsequent records are dropped (counted in wal_dropped),
+  // and replication only resumes at the next kSnapshot cut (the rejoin /
+  // resync fence), never mid-stream with a silent gap.
+  bool repl_cfg = false;            // successor configured
+  bool repl_live = false;           // currently replicating (guarded by mu)
+  std::string repl_host;
+  int repl_port = 0;
+  int shard_count = 0;              // ring size / own index (kSnapshot
+  int shard_idx = -1;               //   filter + scoped incarnation GC)
+  double repl_wait_sec = 30.0;      // BLUEFOG_CP_REPL_TIMEOUT
+  size_t repl_depth = 65536;        // BLUEFOG_CP_WAL_DEPTH (records)
+  std::deque<ReplRecord> repl_q;    // guarded by mu
+  uint64_t wal_seq = 0;             // last record enqueued
+  uint64_t wal_acked = 0;           // last record acked by the successor
+  uint64_t wal_dropped_below = 0;   // degrade watermark (waiter escape)
+  std::atomic<long long> wal_dropped{0};
+  std::thread repl_thread;
+  std::condition_variable repl_cv;  // queue arrivals + ack advances
+  // replica side: records at or below the fence are already folded into
+  // the snapshot this server was loaded from (shard rejoin catch-up).
+  // rejoin_pending gates incoming kReplApply records during the window
+  // between the successor serving the snapshot (which re-arms its
+  // stream) and THIS server loading it: records applied to the
+  // still-empty store would land out of order with the snapshot's
+  // contents, so they wait on the gate instead.
+  uint64_t repl_fence = 0;
+  bool rejoin_pending = false;
+  std::atomic<long long> repl_applied_n{0};
+
+  void ReplLoop();  // defined after ControlClient (it dials one)
+
+  // Degrade to unreplicated (caller holds mu): drop the queue, wake every
+  // ack waiter, and count what was lost. Replication resumes only at the
+  // next kSnapshot cut.
+  void ReplDegradeLocked() {
+    wal_dropped_below = wal_seq;  // waiters at or below this never ack
+    if (!repl_live && repl_q.empty()) return;
+    repl_live = false;
+    wal_dropped.fetch_add(static_cast<long long>(repl_q.size()),
+                          std::memory_order_relaxed);
+    repl_q.clear();
+    repl_cv.notify_all();
+  }
+
+  // Append one WAL record (caller holds mu). Returns the record's seq to
+  // wait on, or 0 when replication is off/degraded.
+  uint64_t ReplEnqueueLocked(uint8_t op, const std::string& key, int64_t arg,
+                             int64_t reply, std::string data, int rank,
+                             uint64_t cid, uint64_t cseq, uint32_t cidx,
+                             bool record_reply) {
+    if (!repl_cfg) return 0;
+    if (!repl_live) {
+      wal_dropped.fetch_add(1, std::memory_order_relaxed);
+      return 0;
+    }
+    if (repl_q.size() >= repl_depth) {
+      // WAL depth cap: a wedged successor must not grow this server's
+      // memory without bound — degrade instead of blocking forever
+      ReplDegradeLocked();
+      wal_dropped.fetch_add(1, std::memory_order_relaxed);
+      return 0;
+    }
+    ReplRecord r;
+    r.seq = ++wal_seq;
+    r.op = op;
+    r.record_reply = record_reply ? 1 : 0;
+    r.rank = rank;
+    r.cid = cid;
+    r.cseq = cseq;
+    r.cidx = cidx;
+    r.key = key;
+    r.arg = arg;
+    r.reply = reply;
+    r.data = std::move(data);
+    repl_q.push_back(std::move(r));
+    repl_cv.notify_all();
+    return wal_seq;
+  }
+
+  // Block until the successor acked `seq` — the chain-commit rule: the
+  // client's reply must not be written before the record is durable on
+  // the replica. Bounded by repl_wait_sec; on expiry the plane degrades
+  // (the record may or may not have reached the replica — the dedup
+  // identity it carries keeps even that case exactly-once).
+  void ReplWaitAcked(uint64_t seq) {
+    if (seq == 0) return;
+    std::unique_lock<std::mutex> lk(mu);
+    auto deadline = std::chrono::system_clock::now() +
+        std::chrono::duration_cast<std::chrono::system_clock::duration>(
+            std::chrono::duration<double>(repl_wait_sec));
+    while (repl_live && wal_acked < seq && seq > wal_dropped_below &&
+           !stopping.load()) {
+      if (std::chrono::system_clock::now() >= deadline) {
+        ReplDegradeLocked();
+        break;
+      }
+      repl_cv.wait_until(lk, std::chrono::system_clock::now() +
+                                 std::chrono::milliseconds(200));
+    }
+  }
+
   // Telemetry counter block (r10): per-op dispatch counts plus the fault/
   // recovery events the Python metrics registry surfaces (lock force-
   // releases, barrier withdrawals, dedup replays, fenced ops). Relaxed
@@ -662,7 +846,9 @@ struct ControlServer {
   // One counter-block layout, two readers: bf_cp_server_counters (the
   // in-process owner) and the kStats wire op (external per-shard view
   // mergers). Takes `mu` itself — callers must NOT hold it.
-  static constexpr int kStatSlots = 32 + 11;
+  // Slots [43..47] are the WAL-replication view (`bfrun --status
+  // --strict` reports a degraded shard as under-replicated off them).
+  static constexpr int kStatSlots = 32 + 16;
 
   int FillCounters(long long* out, int n) {
     if (!out || n < kStatSlots) return -1;
@@ -670,6 +856,7 @@ struct ControlServer {
       out[i] = srv_ops[i].load(std::memory_order_relaxed);
     long long recs = 0, rec_bytes = 0, held = 0, slots = 0, slot_bytes = 0;
     long long conns, kvn;
+    long long wal_n, wal_ack, repl_st;
     {
       std::lock_guard<std::mutex> lk(mu);
       conns = static_cast<long long>(handler_fds.size());
@@ -683,6 +870,9 @@ struct ControlServer {
         ++slots;
         if (it.second) slot_bytes += static_cast<long long>(it.second->size());
       }
+      wal_n = static_cast<long long>(wal_seq);
+      wal_ack = static_cast<long long>(wal_acked);
+      repl_st = !repl_cfg ? 0 : (repl_live ? 1 : 2);
     }
     out[32] = conns;
     out[33] = recs;
@@ -695,6 +885,11 @@ struct ControlServer {
     out[40] = kvn;
     out[41] = slots;
     out[42] = slot_bytes;
+    out[43] = wal_n;
+    out[44] = wal_ack;
+    out[45] = wal_dropped.load(std::memory_order_relaxed);
+    out[46] = repl_st;  // 0 = off, 1 = live, 2 = degraded (under-replicated)
+    out[47] = repl_applied_n.load(std::memory_order_relaxed);
     return kStatSlots;
   }
 
@@ -722,6 +917,12 @@ struct ControlServer {
         ++it.second.epoch;
         released = true;
         srv_lock_force_releases.fetch_add(1, std::memory_order_relaxed);
+        // WAL the force-release (arg = -1) so the replica's copy of the
+        // lock frees too; fire-and-forget — cleanup paths must not block
+        // on the successor (queue order still serializes it correctly
+        // against any later grant of the same lock).
+        ReplEnqueueLocked(kUnlock, it.first, -1, 1, std::string(), -1,
+                          0, 0, 0, false);
       }
     }
     if (released) cv.notify_all();
@@ -734,7 +935,18 @@ struct ControlServer {
   // incarnation, and the table must not grow under restart churn), and its
   // origin-tagged mailbox records — deposits of STALE parameters the owner
   // never drained — are dropped with their byte accounting.
-  void GcIncarnationLocked(int rank) {
+  // ``from_wal`` selects the mailbox sweep's scope. A DIRECT attach on a
+  // replicating shard must only sweep mailboxes it is the primary for
+  // (preferred shard == shard_idx): replica-keyspace boxes take every
+  // mutation — appends, counted-prefix drains, and this GC — through the
+  // predecessor's ordered WAL alone, because a second mutation source
+  // would misalign the counted-prefix take applies (a drain of "first N
+  // records" erases the wrong N once the copies disagree). The primary
+  // WALs its own GC as a pseudo-record, so the replica applies it at the
+  // same sequence point (from_wal=true sweeps everything — own-keyspace
+  // boxes were already swept by the direct attach, and re-sweeping is
+  // idempotent). Unsharded/unconfigured servers keep the full sweep.
+  void GcIncarnationLocked(int rank, bool from_wal = false) {
     bool released = false;
     for (auto& it : locks) {
       if (it.second.rank == rank) {
@@ -750,8 +962,15 @@ struct ControlServer {
       for (uint64_t cid : rc->second) dedup.erase(cid);
       rc->second.clear();
     }
+    const bool scoped = !from_wal && shard_count > 1 && shard_idx >= 0;
     const int8_t origin = static_cast<int8_t>(rank & 0x7F);
     for (auto it = mailbox.begin(); it != mailbox.end();) {
+      if (scoped && Fnv64(it->first) %
+              static_cast<uint64_t>(shard_count) !=
+          static_cast<uint64_t>(shard_idx)) {
+        ++it;  // replica-keyspace box: the predecessor's WAL sweeps it
+        continue;
+      }
       auto oi = mailbox_origin.find(it->first);
       auto& box = it->second;
       if (oi == mailbox_origin.end() || oi->second.size() != box.size()) {
@@ -785,6 +1004,10 @@ struct ControlServer {
         ++it;
       }
     }
+    if (!from_wal)
+      // pseudo-record: the replica runs the same GC at this WAL position
+      ReplEnqueueLocked(kAttach, std::string(), rank, 1, std::string(),
+                        rank, 0, 0, 0, false);
     if (released) cv.notify_all();
   }
 
@@ -851,7 +1074,21 @@ struct ControlServer {
       bool quit = false;
       bool replied = false;
       bool conn_abort = false;
+      // WAL seq this request must see acked by the successor before its
+      // reply is written (0 = nothing to replicate for this op)
+      uint64_t repl_wait = 0;
       srv_ops[op & 31].fetch_add(1, std::memory_order_relaxed);
+
+      // Rejoin gate: a restarted shard binds (and is dialable) BEFORE its
+      // snapshot catch-up completes. EVERY op — a churned client's drain
+      // as much as an incoming replication record — parks here until the
+      // store is loaded: serving against the half-loaded store would
+      // lose records now and resurrect them out of order later.
+      if (op != kShutdown) {
+        std::unique_lock<std::mutex> lk(mu);
+        while (rejoin_pending && !stopping.load())
+          BoundedWaitMs(cv, lk, 200);
+      }
 
       // Incarnation fence: once this connection's registered incarnation is
       // superseded, NO op is applied — every request is answered with the
@@ -968,6 +1205,8 @@ struct ControlServer {
           std::unique_lock<std::mutex> lk(mu);
           DedupEntry& e = dedup[ded_cid];
           if (e.seq != ded_seq) {
+            if (e.seq != ~0ull && e.seq > e.done_below)
+              e.done_below = e.seq;  // the superseded batch completed
             e.seq = ded_seq;
             e.ints.clear();
             e.bulks.clear();
@@ -1088,6 +1327,13 @@ struct ControlServer {
                         std::chrono::steady_clock::duration>(
                         std::chrono::duration<double>(lock_lease_sec));
               reply = 1;
+              // WAL the grant: the replica adopts holder (+ a lease
+              // stamped at apply time), so on failover the holder's
+              // unlock lands on a lock it still owns and waiters queue
+              // behind a real holder instead of PeerLostError
+              repl_wait = ReplEnqueueLocked(kLock, key, rank, 1,
+                                            std::string(), rank, 0, 0, 0,
+                                            false);
               break;
             }
             if (lock_lease_sec > 0 &&
@@ -1121,6 +1367,10 @@ struct ControlServer {
             it->second.fd = -1;
             cv.notify_all();
             reply = 1;
+            repl_wait = ReplEnqueueLocked(kUnlock, key, rank, 1,
+                                          std::string(), rank,
+                                          ded ? ded_cid : 0, ded_seq,
+                                          ded_idx, false);
           } else {
             // not ours (anymore): the lease expired or a drop force-
             // released it mid-hold — the critical section was broken;
@@ -1134,12 +1384,17 @@ struct ControlServer {
           int64_t& slot = kv[key];
           reply = slot;
           slot += arg;
+          repl_wait = ReplEnqueueLocked(op, key, arg, reply, std::string(),
+                                        rank, ded ? ded_cid : 0, ded_seq,
+                                        ded_idx, false);
           break;
         }
         case kPut: {
           std::lock_guard<std::mutex> lk(mu);
           kv[key] = arg;
           reply = 1;
+          repl_wait = ReplEnqueueLocked(op, key, arg, reply, std::string(),
+                                        rank, 0, 0, 0, false);
           break;
         }
         case kPutMax: {
@@ -1149,6 +1404,8 @@ struct ControlServer {
           int64_t& slot = kv[key];
           if (arg > slot) slot = arg;
           reply = slot;
+          repl_wait = ReplEnqueueLocked(op, key, arg, reply, std::string(),
+                                        rank, 0, 0, 0, false);
           break;
         }
         case kStats: {
@@ -1200,6 +1457,14 @@ struct ControlServer {
             reply = -2;
             break;
           }
+          // WAL carries the STORED record verbatim (tag prefix included):
+          // the replica pushes it as-is, so the two copies stay byte-
+          // identical and counted-prefix drains align. One payload copy —
+          // the replication-factor-2 cost.
+          repl_wait = ReplEnqueueLocked(op, key, arg,
+                                        static_cast<int64_t>(box.size() + 1),
+                                        rec, rank, ded ? ded_cid : 0,
+                                        ded_seq, ded_idx, false);
           box.emplace_back(std::move(rec));
           // Origin mirror for incarnation GC: tagged records carry the
           // 7-bit origin process id in tag bits 56..62; untagged are -1.
@@ -1246,8 +1511,23 @@ struct ControlServer {
                   taken += static_cast<int64_t>(r.size());
                 box_bytes[key] -= taken;
               }
+              if (!records.empty())
+                // WAL the drain as a counted prefix: the replica erases
+                // the same N records from its byte-identical copy — and,
+                // when the origin identity is armed, assembles THAT prefix
+                // into a recorded reply first, so a take whose reply died
+                // with this shard replays the exact haul on the successor
+                // (zero lost deposits, not a one-cycle window).
+                repl_wait = ReplEnqueueLocked(
+                    kTakeBytes, key,
+                    static_cast<int64_t>(records.size()),
+                    static_cast<int64_t>(records.size()), std::string(),
+                    rank, ded ? ded_cid : 0, ded_seq, ded_idx, ded);
             }
           }
+          // chain-commit: the drain must be durable on the successor
+          // before any byte of the reply reaches the client
+          ReplWaitAcked(repl_wait);
           uint64_t total = 0;
           for (const auto& r : records) total += 4 + r.size();
           uint32_t rlen = static_cast<uint32_t>(total);
@@ -1403,6 +1683,231 @@ struct ControlServer {
           reply = it == box_bytes.end() ? 0 : it->second;
           break;
         }
+        case kReplApply: {
+          // One WAL record from the predecessor shard's replicator: apply
+          // the mutation to OUR store (failover routes the dead shard's
+          // keyspace here, so promotion needs no copy) and pre-record the
+          // origin client's reply under its dedup identity. Never
+          // re-enqueued into our own WAL: replication factor is 2, and
+          // direct ops we serve post-failover chain onward naturally.
+          if (dlen < kReplHdr) {
+            reply = -1;
+            break;
+          }
+          const uint8_t rop = static_cast<uint8_t>(data[0]);
+          const bool rrec = data[1] != 0;
+          int32_t orank;
+          uint64_t ocid, ocseq;
+          uint32_t ocidx;
+          int64_t oarg, oreply;
+          std::memcpy(&orank, data + 2, 4);
+          std::memcpy(&ocid, data + 6, 8);
+          std::memcpy(&ocseq, data + 14, 8);
+          std::memcpy(&ocidx, data + 22, 4);
+          std::memcpy(&oarg, data + 26, 8);
+          std::memcpy(&oreply, data + 34, 8);
+          const char* pay = data + kReplHdr;
+          const size_t pn = dlen - kReplHdr;
+          std::lock_guard<std::mutex> lk(mu);
+          const uint64_t rseq = static_cast<uint64_t>(arg);
+          if (rseq <= repl_fence) {  // already folded into our snapshot
+            reply = 1;
+            break;
+          }
+          // Duplicate fence vs failover retries: up to a pipeline window
+          // of WAL records can still be in flight from a SIGKILLed
+          // predecessor while its clients' retries already landed here
+          // and re-executed the same (cid, seq, idx) ops fresh. Chain
+          // commit means every *acked* op's record applied before its
+          // ack, so a record for a batch this client has completed here
+          // (done_below) or an index we already hold a reply for is a
+          // late duplicate — skip the mutation entirely.
+          if (ocid != 0) {
+            auto dit = dedup.find(ocid);
+            if (dit != dedup.end() &&
+                (ocseq <= dit->second.done_below ||
+                 (dit->second.seq == ocseq &&
+                  (dit->second.ints.size() > ocidx ||
+                   // the retry is EXECUTING this very op right now (its
+                   // mutating cases always run to completion and record)
+                   dit->second.inflight == ocidx)))) {
+              reply = 1;
+              break;
+            }
+          }
+          repl_applied_n.fetch_add(1, std::memory_order_relaxed);
+          std::string bulk;
+          bool has_bulk = false;
+          switch (rop) {
+            case kPut:
+              kv[key] = oarg;
+              break;
+            case kPutMax: {
+              int64_t& slot = kv[key];
+              if (oarg > slot) slot = oarg;
+              break;
+            }
+            case kFetchAdd:
+              kv[key] += oarg;
+              break;
+            case kAppendBytes:
+            case kAppendBytesTagged:
+              mailbox[key].emplace_back(pay, pn);
+              mailbox_origin[key].push_back(
+                  rop == kAppendBytesTagged
+                      ? static_cast<int8_t>(
+                            (static_cast<uint64_t>(oarg) >> 56) & 0x7F)
+                      : static_cast<int8_t>(-1));
+              box_bytes[key] += static_cast<int64_t>(pn);
+              break;
+            case kTakeBytes: {
+              auto it = mailbox.find(key);
+              if (it != mailbox.end()) {
+                auto& box = it->second;
+                size_t n = static_cast<size_t>(oarg);
+                if (n > box.size()) n = box.size();
+                if (rrec) {
+                  for (size_t i = 0; i < n; ++i) {
+                    uint32_t rl = static_cast<uint32_t>(box[i].size());
+                    bulk.append(reinterpret_cast<const char*>(&rl), 4);
+                    bulk.append(box[i]);
+                  }
+                  has_bulk = true;
+                }
+                int64_t taken = 0;
+                for (size_t i = 0; i < n; ++i)
+                  taken += static_cast<int64_t>(box[i].size());
+                box.erase(box.begin(), box.begin() + n);
+                auto oi = mailbox_origin.find(key);
+                if (oi != mailbox_origin.end() && oi->second.size() >= n)
+                  oi->second.erase(oi->second.begin(),
+                                   oi->second.begin() + n);
+                box_bytes[key] -= taken;
+                if (box.empty()) {
+                  mailbox.erase(it);
+                  box_bytes.erase(key);
+                  mailbox_origin.erase(key);
+                }
+              } else if (rrec) {
+                has_bulk = true;  // record the (empty) haul faithfully
+              }
+              break;
+            }
+            case kLock: {
+              LockInfo& L = locks[key];
+              L.rank = static_cast<int>(oarg);
+              L.fd = -1;  // no local connection: lease is the backstop
+              if (lock_lease_sec > 0)
+                L.expiry = std::chrono::steady_clock::now() +
+                    std::chrono::duration_cast<
+                        std::chrono::steady_clock::duration>(
+                        std::chrono::duration<double>(lock_lease_sec));
+              cv.notify_all();
+              break;
+            }
+            case kUnlock: {
+              auto it = locks.find(key);
+              if (it != locks.end() &&
+                  (oarg < 0 || it->second.rank == static_cast<int>(oarg))) {
+                it->second.rank = -1;
+                it->second.fd = -1;
+                if (oarg < 0) ++it->second.epoch;  // force-release
+                cv.notify_all();
+              }
+              break;
+            }
+            case kAttach:  // pseudo-record: incarnation GC at this point
+              GcIncarnationLocked(static_cast<int>(oarg), true);
+              break;
+            default:
+              break;
+          }
+          if (ocid != 0) {
+            // pre-record the origin's reply: its failover retry arrives
+            // with the SAME kSeqPre (cid, seq) and replays from here.
+            // Only move the entry FORWARD — a late record from an older
+            // batch applied its mutation above but must not clobber the
+            // newer batch's recording (its reply will never be asked
+            // for again).
+            const bool fresh = dedup.find(ocid) == dedup.end();
+            DedupEntry& e = dedup[ocid];
+            if (fresh) rank_cids[orank].push_back(ocid);
+            if (e.seq != ocseq &&
+                (e.seq == ~0ull || fresh || ocseq > e.seq)) {
+              if (e.seq != ~0ull && e.seq > e.done_below)
+                e.done_below = e.seq;  // ordered stream: prior batches
+              e.seq = ocseq;           // are fully reflected here
+              e.ints.clear();
+              e.bulks.clear();
+              e.is_bulk.clear();
+              e.inflight = 0xFFFFFFFFu;
+            }
+            if (e.seq == ocseq && e.ints.size() == ocidx) {
+              e.ints.push_back(has_bulk ? 0 : oreply);
+              e.is_bulk.push_back(has_bulk ? 1 : 0);
+              e.bulks.emplace_back(std::move(bulk));
+            }
+          }
+          reply = 1;
+          break;
+        }
+        case kSnapshot: {
+          // Point-in-time state pull (shard rejoin catch-up). Serving it
+          // also re-arms OUR replicator from this cut: the requester ends
+          // up with snapshot + every later WAL record, gap-free.
+          const uint64_t filt = static_cast<uint64_t>(arg);
+          const uint64_t fn = filt >> 32;
+          const uint64_t fi = filt & 0xFFFFFFFFu;
+          std::string blob;
+          {
+            std::lock_guard<std::mutex> lk(mu);
+            auto want = [&](const std::string& k) {
+              return fn == 0 || Fnv64(k) % fn == fi;
+            };
+            auto put_rec = [&](uint8_t type, const std::string& k,
+                               int64_t a, const char* p, size_t n) {
+              blob.push_back(static_cast<char>(type));
+              uint16_t kl = static_cast<uint16_t>(k.size());
+              blob.append(reinterpret_cast<const char*>(&kl), 2);
+              blob.append(k);
+              blob.append(reinterpret_cast<const char*>(&a), 8);
+              uint32_t pl = static_cast<uint32_t>(n);
+              blob.append(reinterpret_cast<const char*>(&pl), 4);
+              if (n) blob.append(p, n);
+            };
+            uint64_t fence = wal_seq;
+            blob.append(reinterpret_cast<const char*>(&fence), 8);
+            for (const auto& it : kv)
+              if (want(it.first))
+                put_rec(0, it.first, it.second, nullptr, 0);
+            for (const auto& it : mailbox) {
+              if (!want(it.first)) continue;
+              auto oi = mailbox_origin.find(it.first);
+              for (size_t i = 0; i < it.second.size(); ++i) {
+                int64_t origin = -1;
+                if (oi != mailbox_origin.end() && i < oi->second.size())
+                  origin = oi->second[i];
+                put_rec(1, it.first, origin, it.second[i].data(),
+                        it.second[i].size());
+              }
+            }
+            for (const auto& it : locks)
+              if (it.second.rank != -1 && want(it.first))
+                put_rec(2, it.first, it.second.rank, nullptr, 0);
+            for (const auto& it : incarnations)
+              put_rec(3, std::to_string(it.first), it.second, nullptr, 0);
+            if (repl_cfg && !repl_live) {
+              repl_live = true;  // resync point: stream resumes from here
+              repl_cv.notify_all();
+            }
+          }
+          uint32_t rlen = static_cast<uint32_t>(blob.size());
+          if (!WriteAll(fd, &rlen, 4) ||
+              (!blob.empty() && !WriteAll(fd, blob.data(), blob.size())))
+            return;
+          replied = true;
+          break;
+        }
         case kShutdown:
           quit = true;
           reply = 1;
@@ -1416,6 +1921,10 @@ struct ControlServer {
         if (ded) ded_abort();
         return;
       }
+      // chain-commit barrier: a mutating op's reply leaves this server
+      // only after the successor acked its WAL record (no-op when
+      // replication is off, degraded, or the op was read-only)
+      ReplWaitAcked(repl_wait);
       if (!replied) {
         // record BEFORE the reply write: a reply lost on the wire must
         // find its value here when the client retries
@@ -1532,6 +2041,17 @@ struct ControlClient {
   // a zombie must stop touching shared state, not reconnect harder.
   int64_t incarnation = -1;
   bool stale = false;  // guarded by mu
+  // Ring-successor failover target (r16 durable sharded plane). When the
+  // primary's redial fails, later attempts dial the successor instead and
+  // STICK there — crucially on the same ControlClient, so the retried
+  // request goes out under the SAME kSeqPre (cid, seq) the primary saw,
+  // and the successor (whose dedup table the primary's WAL pre-populated)
+  // replays the recorded reply instead of double-applying. fo_active is
+  // read lock-free by the router's health probe (it must not contend
+  // with a blocking op holding `mu`).
+  std::string fo_host;
+  int fo_port = 0;
+  std::atomic<int> fo_active{0};
 
   // Register (rank, incarnation) on the CURRENT connection (caller holds
   // mu). Returns 1 on success, kStaleIncarnationReply when superseded
@@ -1568,6 +2088,7 @@ struct ControlClient {
       case kAppendBytesTagged:
       case kTakeBytes:
       case kPutBytesPart:
+      case kReplApply:
         return true;
       default:
         return false;
@@ -1683,8 +2204,11 @@ struct ControlClient {
           return reply;
         }
       }
-      if (attempt >= retries || !Reconnect(attempt))
+      if (attempt >= retries)
         return stale ? kStaleIncarnationReply : -1;
+      // a failed dial burns the attempt, it does not abort the loop —
+      // the NEXT attempt may reach the ring-successor failover target
+      if (!Reconnect(attempt) && stale) return kStaleIncarnationReply;
     }
   }
 
@@ -1693,14 +2217,14 @@ struct ControlClient {
   // take_bytes is non-idempotent (the drain consumes records): it rides the
   // dedup preamble so a retried take replays the server-recorded reply.
   int64_t CallBytes(uint8_t op, const std::string& key, void** out,
-                    int64_t* out_len) {
+                    int64_t* out_len, int64_t arg = 0) {
     std::lock_guard<std::mutex> lk(mu);
     if (stale) return kStaleIncarnationReply;
     const uint64_t seq = AllocSeq(op);
     for (int attempt = 0;; ++attempt) {
       std::vector<char> buf;
       if (seq) EncodePre(&buf, seq, 1);
-      Encode(&buf, op, key, 0);
+      Encode(&buf, op, key, arg);
       if (SendFault(buf, FaultNext())) {
         ClOut(op, static_cast<long long>(buf.size()));
         FaultDelay();
@@ -1724,8 +2248,11 @@ struct ControlClient {
           std::free(payload);
         }
       }
-      if (attempt >= retries || !Reconnect(attempt))
+      if (attempt >= retries)
         return stale ? kStaleIncarnationReply : -1;
+      // a failed dial burns the attempt, it does not abort the loop —
+      // the NEXT attempt may reach the ring-successor failover target
+      if (!Reconnect(attempt) && stale) return kStaleIncarnationReply;
     }
   }
 
@@ -1759,8 +2286,11 @@ struct ControlClient {
           }
         }
       }
-      if (attempt >= retries || !Reconnect(attempt))
+      if (attempt >= retries)
         return stale ? kStaleIncarnationReply : -1;
+      // a failed dial burns the attempt, it does not abort the loop —
+      // the NEXT attempt may reach the ring-successor failover target
+      if (!Reconnect(attempt) && stale) return kStaleIncarnationReply;
     }
   }
 
@@ -1865,8 +2395,9 @@ struct ControlClient {
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
-      if (a >= retries || !Reconnect(a))
+      if (a >= retries)
         return stale ? kStaleIncarnationReply : -1;
+      if (!Reconnect(a) && stale) return kStaleIncarnationReply;
     }
   }
 
@@ -1942,8 +2473,9 @@ struct ControlClient {
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
-      if (stale || a >= retries || !Reconnect(a))
+      if (stale || a >= retries)
         return stale ? kStaleIncarnationReply : -1;
+      if (!Reconnect(a) && stale) return kStaleIncarnationReply;
     }
   }
 
@@ -1978,8 +2510,9 @@ struct ControlClient {
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
-      if (a >= retries || !Reconnect(a))
+      if (a >= retries)
         return stale ? kStaleIncarnationReply : -1;
+      if (!Reconnect(a) && stale) return kStaleIncarnationReply;
     }
   }
 };
@@ -2034,9 +2567,30 @@ bool ControlClient::Reconnect(int attempt) {
   if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
   g_cl_redial_attempts.fetch_add(1, std::memory_order_relaxed);
   FlightRec(kFlightRedialAttempt, attempt, 0);
-  int nfd = DialAndHandshake(host, port, secret, sockbuf);
+  // Failover policy: a redial always tries the primary first (a transient
+  // wire drop with the primary alive must never trigger failover — the
+  // fresh dial succeeds and the op retries in place). Only when the
+  // primary's dial has failed on TWO consecutive attempts — one refused
+  // dial can be a connect-storm backlog overflow on a perfectly live
+  // server, two spanning a backoff interval mean its listener is gone —
+  // does the attempt fall through to the ring successor, and the
+  // redirect then STICKS: the rejoin path hands out fresh clients for a
+  // revived shard, so a redirected client never flaps back mid-stream
+  // (flapping would tear the kSeqPre dedup continuity that keeps
+  // failover retries exactly-once).
+  bool via_fo = fo_active.load(std::memory_order_relaxed) != 0;
+  int nfd = via_fo ? DialAndHandshake(fo_host, fo_port, secret, sockbuf)
+                   : DialAndHandshake(host, port, secret, sockbuf);
+  if (nfd < 0 && !via_fo && !fo_host.empty() && attempt >= 1) {
+    nfd = DialAndHandshake(fo_host, fo_port, secret, sockbuf);
+    via_fo = nfd >= 0;
+  }
   if (nfd < 0) return false;
   fd = nfd;
+  if (via_fo && !fo_active.load(std::memory_order_relaxed)) {
+    fo_active.store(1, std::memory_order_relaxed);
+    FlightRec(kFlightFailover, attempt, 0);
+  }
   g_cl_redials.fetch_add(1, std::memory_order_relaxed);
   FlightRec(kFlightRedial, attempt, 0);
   // A rebuilt stream must re-register its incarnation before any op rides
@@ -2049,6 +2603,106 @@ bool ControlClient::Reconnect(int attempt) {
     return false;
   }
   return true;
+}
+
+// The WAL replicator: one thread per server, draining the ordered record
+// queue to the ring successor in batches (group commit — concurrent
+// handlers' ack waits overlap one inter-shard round-trip). The kReplApply
+// batch rides the replicator client's own kSeqPre dedup, so inter-shard
+// wire drops cannot double-apply a record. A send failure degrades the
+// plane (records dropped, waiters woken) until the next kSnapshot cut
+// re-arms it — never a silent mid-stream gap.
+void ControlServer::ReplLoop() {
+  ControlClient* cl = nullptr;
+  std::vector<ReplRecord> batch;
+  for (;;) {
+    batch.clear();
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      while (!stopping.load() && repl_q.empty())
+        BoundedWaitMs(repl_cv, lk, 200);
+      if (stopping.load()) break;
+      batch.assign(std::make_move_iterator(repl_q.begin()),
+                   std::make_move_iterator(repl_q.end()));
+      repl_q.clear();
+    }
+    if (cl == nullptr) {
+      int nfd = DialAndHandshake(repl_host, repl_port, secret, 0);
+      if (nfd >= 0) {
+        cl = new ControlClient();
+        cl->fd = nfd;
+        cl->rank = -2;  // not a controller rank; kReplApply ignores it
+        cl->host = repl_host;
+        cl->port = repl_port;
+        cl->secret = secret;
+        cl->retries = static_cast<int>(EnvInt("BLUEFOG_CP_RETRIES", 3));
+        if (cl->retries < 0) cl->retries = 0;
+        cl->backoff_ms =
+            static_cast<int>(EnvInt("BLUEFOG_CP_BACKOFF_MS", 50));
+        if (cl->backoff_ms < 0) cl->backoff_ms = 0;
+        uint8_t idb[8];
+        if (RandomBytes(idb, 8)) {
+          std::memcpy(&cl->cid, idb, 8);
+        } else {
+          static std::atomic<uint64_t> ctr{1};
+          cl->cid = (static_cast<uint64_t>(::getpid()) << 32) ^
+                    ctr.fetch_add(1);
+        }
+      }
+    }
+    bool ok = cl != nullptr;
+    if (ok) {
+      const int n = static_cast<int>(batch.size());
+      std::string keys;
+      std::vector<std::string> bodies(static_cast<size_t>(n));
+      std::vector<const void*> ptrs(static_cast<size_t>(n));
+      std::vector<int64_t> lens(static_cast<size_t>(n));
+      std::vector<int64_t> args(static_cast<size_t>(n));
+      std::vector<int64_t> out(static_cast<size_t>(n));
+      for (int i = 0; i < n; ++i) {
+        const ReplRecord& r = batch[static_cast<size_t>(i)];
+        if (i) keys.push_back('\n');
+        keys += r.key;
+        std::string& b = bodies[static_cast<size_t>(i)];
+        b.reserve(kReplHdr + r.data.size());
+        b.push_back(static_cast<char>(r.op));
+        b.push_back(static_cast<char>(r.record_reply));
+        b.append(reinterpret_cast<const char*>(&r.rank), 4);
+        b.append(reinterpret_cast<const char*>(&r.cid), 8);
+        b.append(reinterpret_cast<const char*>(&r.cseq), 8);
+        b.append(reinterpret_cast<const char*>(&r.cidx), 4);
+        b.append(reinterpret_cast<const char*>(&r.arg), 8);
+        b.append(reinterpret_cast<const char*>(&r.reply), 8);
+        b.append(r.data);
+        ptrs[static_cast<size_t>(i)] = b.data();
+        lens[static_cast<size_t>(i)] = static_cast<int64_t>(b.size());
+        args[static_cast<size_t>(i)] = static_cast<int64_t>(r.seq);
+      }
+      ok = cl->CallBytesMultiOutV(kReplApply, keys.c_str(), ptrs.data(),
+                                  lens.data(), args.data(), out.data(),
+                                  n) == n;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (ok) {
+        wal_acked = batch.back().seq;
+      } else {
+        wal_dropped.fetch_add(static_cast<long long>(batch.size()),
+                              std::memory_order_relaxed);
+        ReplDegradeLocked();
+      }
+      repl_cv.notify_all();
+    }
+    if (!ok && cl != nullptr) {
+      ::close(cl->fd);
+      delete cl;
+      cl = nullptr;
+    }
+  }
+  if (cl != nullptr) {
+    ::close(cl->fd);
+    delete cl;
+  }
 }
 
 }  // namespace
@@ -2071,8 +2725,13 @@ void bf_cp_fault(long long drop_after, int delay_ms, int trunc,
 long long bf_cp_fault_drops(void) { return g_fault_drops.load(); }
 long long bf_cp_fault_ops(void) { return g_fault_ops.load(); }
 
-void* bf_cp_serve_auth2(int port, int world, const char* secret,
-                        int64_t max_mailbox_bytes, int sockbuf_bytes) {
+// rejoin_pending != 0 arms the rejoin gate ATOMICALLY with the bind: the
+// accept loop runs from construction, and a restarted shard must not
+// serve a single op against its empty store before the snapshot lands
+// and its own WAL stream is armed (bf_cp_server_set_successor opens it).
+void* bf_cp_serve_auth3(int port, int world, const char* secret,
+                        int64_t max_mailbox_bytes, int sockbuf_bytes,
+                        int rejoin_pending) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -2082,8 +2741,12 @@ void* bf_cp_serve_auth2(int port, int world, const char* secret,
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(static_cast<uint16_t>(port));
+  // Deep accept backlog (clamped to somaxconn): the churn soak's
+  // thousands of raw clients connect in a storm, and an overflowing
+  // backlog refuses dials — which a failover-armed client would read as
+  // the primary's death. The kernel clamp keeps this safe everywhere.
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
-      ::listen(fd, 128) < 0) {
+      ::listen(fd, 4096) < 0) {
     ::close(fd);
     return nullptr;
   }
@@ -2092,6 +2755,7 @@ void* bf_cp_serve_auth2(int port, int world, const char* secret,
   srv->world = world;
   srv->secret = secret ? secret : "";
   srv->max_box_bytes = max_mailbox_bytes;
+  srv->rejoin_pending = rejoin_pending != 0;
   // Leases/deadlines for the blocking primitives (docs/fault_tolerance.md):
   // bound every server-side wait so a dead peer can never park a handler —
   // or a healthy client — forever.
@@ -2099,6 +2763,12 @@ void* bf_cp_serve_auth2(int port, int world, const char* secret,
   srv->barrier_timeout_sec = EnvSeconds("BLUEFOG_CP_BARRIER_TIMEOUT", 600.0);
   srv->accept_thread = std::thread([srv] { srv->AcceptLoop(); });
   return srv;
+}
+
+void* bf_cp_serve_auth2(int port, int world, const char* secret,
+                        int64_t max_mailbox_bytes, int sockbuf_bytes) {
+  return bf_cp_serve_auth3(port, world, secret, max_mailbox_bytes,
+                           sockbuf_bytes, 0);
 }
 
 void* bf_cp_serve_auth(int port, int world, const char* secret,
@@ -2124,9 +2794,11 @@ void bf_cp_server_stop(void* handle) {
   auto* srv = static_cast<ControlServer*>(handle);
   srv->stopping.store(true);
   srv->cv.notify_all();
+  srv->repl_cv.notify_all();
   ::shutdown(srv->listen_fd, SHUT_RDWR);
   ::close(srv->listen_fd);
   srv->accept_thread.join();
+  if (srv->repl_thread.joinable()) srv->repl_thread.join();
   // Wake every blocked handler (recv returns 0 after shutdown; cv waiters
   // see `stopping`), then wait for the detached handlers to drain so the
   // server is quiescent when stop() returns. Freeing is NOT done here:
@@ -2221,6 +2893,140 @@ int bf_cp_is_stale(void* h) {
   auto* cl = static_cast<ControlClient*>(h);
   std::lock_guard<std::mutex> lk(cl->mu);
   return cl->stale ? 1 : 0;
+}
+
+// -- WAL replication / rejoin (r16 durable control plane) -------------------
+
+// Configure this server's ring successor and start the replicator thread.
+// nshards/idx give the server its position in the ring (scoped incarnation
+// GC + the kSnapshot filter). Reads BLUEFOG_CP_REPL_TIMEOUT (handler ack
+// wait, seconds) and BLUEFOG_CP_WAL_DEPTH (queue cap, records) from the
+// environment at call time. 0 on success, -1 when already configured.
+int bf_cp_server_set_successor(void* h, const char* host, int port,
+                               int nshards, int idx) {
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  if (srv->repl_cfg) return -1;
+  srv->repl_host = host ? host : "";
+  srv->repl_port = port;
+  srv->shard_count = nshards;
+  srv->shard_idx = idx;
+  srv->repl_wait_sec = EnvSeconds("BLUEFOG_CP_REPL_TIMEOUT", 30.0);
+  long long depth = EnvInt("BLUEFOG_CP_WAL_DEPTH", 65536);
+  srv->repl_depth = depth > 0 ? static_cast<size_t>(depth) : 65536;
+  srv->repl_cfg = true;
+  srv->repl_live = true;
+  srv->rejoin_pending = false;  // gate opens: every op is replicated now
+  srv->cv.notify_all();
+  srv->repl_thread = std::thread([srv] { srv->ReplLoop(); });
+  return 0;
+}
+
+// Arm the rejoin gate: incoming kReplApply records park until
+// bf_cp_server_load_snapshot clears it. Call BEFORE pulling the snapshot
+// — the successor re-arms its stream the moment it serves the pull, and
+// records applied before the load would interleave out of order.
+void bf_cp_server_set_rejoin_pending(void* h) {
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  srv->rejoin_pending = true;
+}
+
+// Pull a point-in-time snapshot over a CLIENT handle (kSnapshot). filter:
+// 0 = everything, else (nshards << 32 | idx) selects one keyspace. The
+// malloc'd blob (freed with bf_cp_free) starts with the serving shard's
+// WAL fence. Returns blob length, or a negative status.
+int64_t bf_cp_snapshot(void* h, int64_t filter, void** out,
+                       int64_t* out_len) {
+  return static_cast<ControlClient*>(h)->CallBytes(kSnapshot, "", out,
+                                                   out_len, filter);
+}
+
+// Load a snapshot blob into THIS server's store (shard rejoin catch-up;
+// call before announcing the shard alive). set_fence != 0 adopts the
+// blob's WAL fence so replication records already folded into the
+// snapshot are skipped when the predecessor's stream resumes. Returns the
+// number of records applied, or -1 on a malformed blob.
+long long bf_cp_server_load_snapshot(void* h, const void* data,
+                                     int64_t len, int set_fence) {
+  auto* srv = static_cast<ControlServer*>(h);
+  const char* p = static_cast<const char*>(data);
+  if (len < 8) return -1;
+  uint64_t fence;
+  std::memcpy(&fence, p, 8);
+  int64_t off = 8;
+  long long applied = 0;
+  std::lock_guard<std::mutex> lk(srv->mu);
+  while (off < len) {
+    if (off + 1 + 2 > len) return -1;
+    uint8_t type = static_cast<uint8_t>(p[off]);
+    uint16_t kl;
+    std::memcpy(&kl, p + off + 1, 2);
+    off += 3;
+    if (off + kl + 8 + 4 > len) return -1;
+    std::string key(p + off, kl);
+    off += kl;
+    int64_t a;
+    std::memcpy(&a, p + off, 8);
+    off += 8;
+    uint32_t pl;
+    std::memcpy(&pl, p + off, 4);
+    off += 4;
+    if (off + static_cast<int64_t>(pl) > len) return -1;
+    switch (type) {
+      case 0:
+        srv->kv[key] = a;
+        break;
+      case 1:
+        srv->mailbox[key].emplace_back(p + off, pl);
+        srv->mailbox_origin[key].push_back(static_cast<int8_t>(a));
+        srv->box_bytes[key] += static_cast<int64_t>(pl);
+        break;
+      case 2: {
+        LockInfo& L = srv->locks[key];
+        L.rank = static_cast<int>(a);
+        L.fd = -1;  // holder's connection lived on the dead shard:
+        if (srv->lock_lease_sec > 0)  // the lease is the backstop
+          L.expiry = std::chrono::steady_clock::now() +
+              std::chrono::duration_cast<
+                  std::chrono::steady_clock::duration>(
+                  std::chrono::duration<double>(srv->lock_lease_sec));
+        break;
+      }
+      case 3:
+        srv->incarnations[std::atoi(key.c_str())] = a;
+        break;
+      default:
+        break;  // forward compatibility: skip unknown record types
+    }
+    off += pl;
+    ++applied;
+  }
+  if (set_fence) srv->repl_fence = fence;
+  // NOTE: the rejoin gate stays CLOSED — it opens when the successor
+  // stream is armed (bf_cp_server_set_successor). Serving ops between
+  // the load and the arm would ack them unreplicated: a router that
+  // dialed this endpoint early (churned clients attach continuously)
+  // would split the store from the rest of the ring.
+  srv->cv.notify_all();
+  return applied;
+}
+
+// Client-side failover redirect: name the ring successor this client may
+// stick to when its primary stops answering (see ControlClient::Reconnect).
+void bf_cp_set_failover(void* h, const char* host, int port) {
+  auto* cl = static_cast<ControlClient*>(h);
+  std::lock_guard<std::mutex> lk(cl->mu);
+  cl->fo_host = host ? host : "";
+  cl->fo_port = port;
+}
+
+// 1 once this client permanently redirected to its failover target — the
+// router's health probe reads it lock-free (it must not contend with a
+// blocking op holding the client mutex).
+int bf_cp_failed_over(void* h) {
+  return static_cast<ControlClient*>(h)->fo_active.load(
+      std::memory_order_relaxed);
 }
 
 // -- server-side introspection (tests assert the GC left nothing behind) ----

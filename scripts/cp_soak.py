@@ -1,34 +1,44 @@
 #!/usr/bin/env python
-"""Control-plane churn soak: N shard servers vs hundreds of raw clients.
+"""Control-plane churn soak: N shard servers vs thousands of raw clients.
 
-Scenario coverage no unit test reaches (ROADMAP "Control-plane scale-out +
-1000-rank soak"): 500-1000 lightweight raw clients — no JAX anywhere in
-this harness — hammering heartbeats, locks, fetch_add counters, and
-deposit/drain cycles against a SHARDED control plane while the harness
-SIGKILLs a server mid-run and (with ``--churn``) rolls clients through
-incarnation-bumped reattach cycles. Asserted invariants:
+Scenario coverage no unit test reaches (ROADMAP "Durable control plane"):
+up to 5-10k lightweight raw clients — no JAX anywhere in this harness —
+hammering heartbeats, locks, fetch_add counters, and deposit/drain cycles
+against a SHARDED, WAL-REPLICATED control plane while the harness SIGKILLs
+a shard server mid-run, optionally RESTARTS it in place (``--rejoin``:
+snapshot catch-up + even liveness generation), and (with ``--churn``)
+rolls clients through incarnation-bumped reattach cycles. Asserted
+invariants:
 
-* **health convergence** — after the kill, every client's router converges
-  on the same dead-shard set (peer-published failover flags + its own
-  detection), and a fresh probe sees every client's final heartbeat;
+* **health convergence** — after a kill, every client's router converges
+  on the same dead-shard set; after a rejoin, back to the full ring;
 * **exactly-once counters** — each client's private counter hands out
-  contiguous pre-add values within an ownership era (a dedup failure
-  would duplicate or skip); across the failover boundary the era resets
-  at most once, exactly when ownership moved;
-* **conserved deposit mass** — per client, bytes acked == bytes drained
-  + bytes lost, and bytes can only be lost by the kill landing between
-  an append-ack and the drain (at most one cycle per client per kill);
+  contiguous pre-add values. With replication (the default) contiguity
+  must hold ACROSS the failover and rejoin boundaries — the successor
+  continues the replicated value, so a dedup slip, a double-applied
+  failover retry, or a stale rejoin snapshot all surface as a gap;
+* **zero lost deposit mass** — with replication, bytes acked == bytes
+  drained, period: an acked deposit lives on the successor before the ack
+  leaves the primary. ``--no-replication`` restores the r14 allowance of
+  one lossy cycle per client per kill;
 * **bounded server memory** — surviving servers' VmRSS stays under
-  ``--rss-limit-mb`` despite the churn (dedup GC + incarnation GC work).
+  ``--rss-limit-mb`` despite the churn (dedup GC + incarnation GC + WAL
+  draining work).
+
+Client counts beyond ~512 fan out over worker PROCESSES (``--procs``,
+auto-scaled) so the soak is not GIL-bound; the file descriptor limit is
+raised automatically.
 
 Invocations:
-    python scripts/cp_soak.py --clients 500 --churn      # the ROADMAP soak
-    python scripts/cp_soak.py --quick                    # make soak-smoke
+    python scripts/cp_soak.py --clients 5000 --churn --rejoin  # the ROADMAP soak
+    python scripts/cp_soak.py --quick                          # make soak-smoke
+    python scripts/cp_soak.py --quick --rejoin                 # + rejoin churn
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import random
 import signal
@@ -51,7 +61,7 @@ for _name, _path in (("bluefog_tpu", _PKG),
         sys.modules[_name] = _mod
 
 from bluefog_tpu.runtime.native import (  # noqa: E402
-    ControlPlaneClient, PeerLostError, load)
+    ControlPlaneClient, PeerLostError, load)  # noqa: F401
 from bluefog_tpu.runtime.router import ShardRouter  # noqa: E402
 
 SHARD_SERVER = os.path.join(_PKG, "runtime", "shard_server.py")
@@ -69,12 +79,27 @@ def parse_args(argv=None):
     p.add_argument("--kill-shard", type=int, default=None,
                    help="shard index to SIGKILL mid-run (default: the "
                         "last shard; negative disables the kill)")
+    p.add_argument("--rejoin", action="store_true",
+                   help="restart the killed shard in place mid-run "
+                        "(snapshot catch-up + even liveness generation) "
+                        "and assert the ring converges back")
+    p.add_argument("--no-replication", action="store_true",
+                   help="r14 mode: no WAL replication (restores the "
+                        "documented one-cycle loss allowance)")
+    p.add_argument("--procs", type=int, default=0,
+                   help="worker processes to fan the clients over "
+                        "(0 = auto: one per ~512 clients)")
     p.add_argument("--rss-limit-mb", type=float, default=512.0)
     p.add_argument("--record-bytes", type=int, default=2048,
                    help="max deposit record size")
     p.add_argument("--quick", action="store_true",
                    help="smoke preset (<= 60 s): 64 clients, 2 shards, "
                         "~18 s of load, churn on, one injected kill")
+    # internal: worker-process mode (spawned by the parent soak)
+    p.add_argument("--worker-slice", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--endpoints", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--deadline-wall", type=float, default=None,
+                   help=argparse.SUPPRESS)
     args = p.parse_args(argv)
     if args.quick:
         args.shards = 2
@@ -86,15 +111,53 @@ def parse_args(argv=None):
     return args
 
 
-def spawn_shard(index: int, world: int):
-    proc = subprocess.Popen(
-        [sys.executable, SHARD_SERVER, "--port", "0", "--world", str(world),
-         "--shard", str(index)],
-        stdout=subprocess.PIPE, text=True)
+def raise_nofile(need: int) -> None:
+    """Best-effort RLIMIT_NOFILE bump: thousands of raw clients cost a
+    couple of sockets each."""
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = min(hard, max(soft, need))
+        if want > soft:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+    except (ImportError, ValueError, OSError):
+        pass
+
+
+def spawn_shard(index: int, world: int, replicate: bool, port: int = 0,
+                rejoin: bool = False):
+    """One shard server process. With replication the start is two-phase
+    (PORT line -> peers over stdin -> READY line); the caller finishes it
+    with :func:`finish_shard_spawn` once every shard's port is known."""
+    cmd = [sys.executable, SHARD_SERVER, "--port", str(port), "--world",
+           str(world), "--shard", str(index)]
+    if replicate:
+        cmd.append("--expect-peers")
+    if rejoin:
+        cmd.append("--rejoin")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stdin=subprocess.PIPE if replicate else None,
+                            text=True)
+    marker = "BF_SHARD_PORT" if replicate else "BF_SHARD_READY"
     line = proc.stdout.readline()
-    if not line.startswith("BF_SHARD_READY"):
+    if not line.startswith(marker):
         raise RuntimeError(f"shard {index} failed to start: {line!r}")
     return proc, int(line.split()[1])
+
+
+def finish_shard_spawn(procs_ports, replicate: bool) -> None:
+    """Phase 2: write the full ring to every shard and wait for READY."""
+    if not replicate:
+        return
+    ring = ",".join(f"127.0.0.1:{port}" for _, port in procs_ports)
+    for proc, _ in procs_ports:
+        proc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+        proc.stdin.flush()
+    for i, (proc, _) in enumerate(procs_ports):
+        line = proc.stdout.readline()
+        if not line.startswith("BF_SHARD_READY"):
+            raise RuntimeError(f"shard {i} failed to wire peers: {line!r}")
 
 
 def vm_rss_mb(pid: int) -> float:
@@ -112,12 +175,13 @@ class Worker(threading.Thread):
     """One raw client: heartbeat + counter + lock + deposit/drain loop."""
 
     def __init__(self, wid: int, endpoints, deadline: float, churn: bool,
-                 record_bytes: int) -> None:
+                 record_bytes: int, replicated: bool) -> None:
         super().__init__(daemon=True, name=f"soak-{wid}")
         self.wid = wid
         self.endpoints = endpoints
-        self.deadline = deadline
+        self.deadline = deadline  # wall-clock (time.time) epoch
         self.churn = churn
+        self.replicated = replicated
         self.rng = random.Random(1000 + wid)
         self.record_bytes = max(64, record_bytes)
         self.inc = 0
@@ -129,18 +193,22 @@ class Worker(threading.Thread):
         self.lost_bytes = 0
         self.lost_cycles = 0
         self.reattaches = 0
+        self.reattach_giveups = 0
         self.peer_lost = 0
         self.last_hb = 0
         self.dead_seen: set = set()
         self.counter_eras = 1
         self.counter_acks = 0
+        self._trail: list = []  # last few (op, owner, pre, dead) probes
 
     def _attach(self) -> ShardRouter:
         # Same contract as control_plane.attach: retry the connect for a
         # bounded window — a reattach can land in the instant AFTER a
         # shard died but BEFORE any survivor published its dead flag, and
         # the strict router correctly refuses until the flag appears.
-        deadline = time.monotonic() + 10.0
+        # Generous: on an oversubscribed box a single dial can take
+        # seconds while thousands of peers redial through the same kill.
+        deadline = time.monotonic() + 30.0
         while True:
             try:
                 return ShardRouter(self.endpoints, self.wid, streams=1,
@@ -150,12 +218,30 @@ class Worker(threading.Thread):
                     raise
                 time.sleep(0.1)
 
+    def ledger(self) -> dict:
+        return {
+            "wid": self.wid, "ops": self.ops, "errors": self.errors[:4],
+            "acked": self.acked_bytes, "drained": self.drained_bytes,
+            "lost": self.lost_bytes, "lost_cycles": self.lost_cycles,
+            "reattaches": self.reattaches, "peer_lost": self.peer_lost,
+            "giveups": self.reattach_giveups,
+            "last_hb": self.last_hb, "dead_seen": sorted(self.dead_seen),
+            "eras": self.counter_eras, "acks": self.counter_acks,
+            "alive": self.is_alive(),
+        }
+
     def run(self) -> None:  # noqa: C901 — the soak loop is one scenario
         ckey = f"soak.ctr.{self.wid}"
         box = f"soak.box.{self.wid}"
         hb = f"soak.hb.{self.wid}"
         try:
             r = self._attach()
+        except OSError:
+            # same oversubscription allowance as a churn reattach: an
+            # initial attach racing the kill instant can starve past its
+            # window without any invariant being at stake
+            self.reattach_giveups = 1
+            return
         except Exception as exc:  # noqa: BLE001 — recorded, fails the soak
             self.errors.append(f"attach: {exc!r}")
             return
@@ -164,32 +250,47 @@ class Worker(threading.Thread):
         next_churn = time.monotonic() + self.rng.uniform(4.0, 8.0)
         next_poll = time.monotonic() + self.rng.uniform(0.5, 1.5)
         try:
-            while time.monotonic() < self.deadline:
+            while time.time() < self.deadline:
                 self.ops += 1
                 # heartbeat
                 self.last_hb += 1
                 r.put(hb, self.last_hb)
-                # exactly-once counter, era-checked: within one ownership
-                # era the pre-add values must be contiguous (a dedup slip
-                # duplicates or skips); a failover resets the era because
-                # the dead shard's counter state died with it
+                # exactly-once counter. With replication the pre-add
+                # values must be contiguous across EVERY boundary —
+                # failover, rejoin, churn reattach — because the
+                # successor continues the replicated value and the
+                # rejoined shard catches up by snapshot. Unreplicated
+                # (r14) mode re-learns the era on ownership moves.
                 owner = r.owner_of(ckey)
                 if owner != cur_owner:
-                    cur_owner, expected = owner, None
+                    cur_owner = owner
                     self.counter_eras += 1
+                    if not self.replicated:
+                        expected = None
                 pre = r.fetch_add(ckey, 1)
                 self.counter_acks += 1
+                # short diagnostic trail: which store served which value
+                # (rendered into the era-violation message — the routing
+                # flip history is what makes those failures debuggable)
+                self._trail.append((self.ops, cur_owner, pre,
+                                    sorted(r.dead_shards())))
+                del self._trail[:-8]
                 owner2 = r.owner_of(ckey)
                 if owner2 != cur_owner:
-                    cur_owner, expected = owner2, pre + 1
+                    cur_owner = owner2
                     self.counter_eras += 1
-                elif expected is None:
+                    if not self.replicated:
+                        expected = pre + 1
+                        continue
+                if expected is None:
                     expected = pre + 1
                 else:
                     if pre != expected:
                         self.errors.append(
                             f"counter era violation: pre={pre} "
-                            f"expected={expected}")
+                            f"expected={expected} op={self.ops} "
+                            f"owner={cur_owner} t={time.time() % 1000:.2f} "
+                            f"trail={self._trail}")
                     expected = pre + 1
                 # occasional contended lock (typed degradation tolerated)
                 if self.ops % 7 == 0:
@@ -199,8 +300,7 @@ class Worker(threading.Thread):
                         r.unlock(lk)
                     except PeerLostError:
                         self.peer_lost += 1
-                # deposit/drain cycle with a mass ledger: bytes can only
-                # be lost when the kill lands between ack and drain
+                # deposit/drain cycle with a mass ledger
                 nrec = self.rng.randint(1, 4)
                 blobs = [bytes([self.rng.randint(0, 255)]) *
                          self.rng.randint(64, self.record_bytes)
@@ -229,8 +329,19 @@ class Worker(threading.Thread):
                     # GC its dedup/mailbox state on every shard)
                     r.close()
                     self.inc += 1
-                    r = self._attach()
-                    cur_owner, expected = r.owner_of(ckey), None
+                    try:
+                        r = self._attach()
+                    except OSError:
+                        # liveness, not integrity: under extreme
+                        # oversubscription a reattach can starve past its
+                        # window. The worker retires cleanly (its mass
+                        # ledger is complete — churn lands between
+                        # cycles); the driver bounds how many may do so.
+                        self.reattach_giveups = 1
+                        return
+                    cur_owner = r.owner_of(ckey)
+                    if not self.replicated:
+                        expected = None
                     self.reattaches += 1
                     next_churn = now + self.rng.uniform(4.0, 8.0)
             self.dead_seen |= r.poll_shard_health()
@@ -243,100 +354,226 @@ class Worker(threading.Thread):
                 pass
 
 
-def main(argv=None) -> int:
+def run_workers(args, endpoints, deadline_wall: float,
+                replicated: bool) -> list:
+    """Run this process's worker slice to completion; returns ledgers."""
+    base, count = 0, args.clients
+    if args.worker_slice:
+        base, count = (int(x) for x in args.worker_slice.split(":"))
+    raise_nofile(8 * count + 512)
+    workers = [Worker(base + i, endpoints, deadline_wall, args.churn,
+                      args.record_bytes, replicated)
+               for i in range(count)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=args.duration + 120)
+    return [w.ledger() for w in workers]
+
+
+def worker_main(args) -> int:
+    """Child-process mode: run a slice, print one JSON ledger line."""
+    endpoints = [(h, int(p)) for h, _, p in
+                 (e.rpartition(":") for e in args.endpoints.split(","))]
+    ledgers = run_workers(args, endpoints, args.deadline_wall,
+                          not args.no_replication)
+    print("BF_SOAK_LEDGERS " + json.dumps(ledgers), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
     args = parse_args(argv)
     if load() is None:
         print("cp_soak: native runtime unavailable", file=sys.stderr)
         return 1
+    if args.worker_slice:
+        return worker_main(args)
     t0 = time.time()
     os.environ.setdefault("BLUEFOG_CP_BACKOFF_MS", "20")
-    servers = [spawn_shard(i, 1) for i in range(args.shards)]
+    replicate = not args.no_replication and args.shards > 1
+    if args.rejoin and not replicate:
+        print("cp_soak: --rejoin requires replication", file=sys.stderr)
+        return 1
+    procs = args.procs or max(1, min(16, args.clients // 512))
+    raise_nofile(8 * args.clients + 1024)
+
+    servers = [spawn_shard(i, 1, replicate) for i in range(args.shards)]
+    finish_shard_spawn(servers, replicate)
     endpoints = [("127.0.0.1", port) for _, port in servers]
     print(f"cp_soak: {args.shards} shard(s) up "
           f"({','.join(str(p) for _, p in servers)}); "
-          f"{args.clients} client(s), {args.duration:.0f}s"
+          f"{args.clients} client(s) over {procs} proc(es), "
+          f"{args.duration:.0f}s"
           + (", churn" if args.churn else "")
+          + (", WAL replication" if replicate else ", NO replication")
           + (f", SIGKILL shard {args.kill_shard} mid-run"
-             if args.kill_shard >= 0 else ""))
+             if args.kill_shard >= 0 else "")
+          + (", rejoin mid-run" if args.rejoin else ""))
 
-    deadline = time.monotonic() + args.duration
-    workers = [Worker(i, endpoints, deadline, args.churn, args.record_bytes)
-               for i in range(args.clients)]
-    for w in workers:
-        w.start()
+    deadline_wall = time.time() + args.duration
+    eps_spec = ",".join(f"{h}:{p}" for h, p in endpoints)
 
+    children: list = []
+    workers: list = []
+    if procs > 1:
+        per = (args.clients + procs - 1) // procs
+        for k in range(procs):
+            base = k * per
+            count = min(per, args.clients - base)
+            if count <= 0:
+                break
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--worker-slice", f"{base}:{count}",
+                   "--endpoints", eps_spec,
+                   "--deadline-wall", str(deadline_wall),
+                   "--duration", str(args.duration),
+                   "--record-bytes", str(args.record_bytes)]
+            if args.churn:
+                cmd.append("--churn")
+            if args.no_replication:
+                cmd.append("--no-replication")
+            children.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                             text=True))
+    else:
+        worker_thread = threading.Thread(
+            target=lambda: workers.extend(
+                run_workers(args, endpoints, deadline_wall, replicate)))
+        worker_thread.start()
+
+    # --- shard kill / rejoin schedule (parent drives it) -------------------
     killed = None
+    rejoined = False
     if 0 <= args.kill_shard < args.shards:
-        time.sleep(args.duration * 0.45)
-        victim, _ = servers[args.kill_shard]
+        time.sleep(max(0.0, deadline_wall - time.time()
+                       - 0.65 * args.duration))
+        victim, vport = servers[args.kill_shard]
         victim.send_signal(signal.SIGKILL)
         victim.wait()
         killed = args.kill_shard
-        print(f"cp_soak: SIGKILLed shard {killed} at t+{args.duration * 0.45:.0f}s")
+        print(f"cp_soak: SIGKILLed shard {killed} at "
+              f"t+{0.35 * args.duration:.0f}s")
+        if args.rejoin:
+            time.sleep(max(0.0, deadline_wall - time.time()
+                           - 0.4 * args.duration))
+            proc, port = spawn_shard(killed, 1, True, port=vport,
+                                     rejoin=True)
+            # phase 2 for the single restarted shard: full ring over stdin
+            ring = ",".join(f"127.0.0.1:{p}" for _, p in
+                            [sp if i != killed else (proc, port)
+                             for i, sp in enumerate(servers)])
+            proc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+            proc.stdin.flush()
+            line = proc.stdout.readline()
+            if not line.startswith("BF_SHARD_READY"):
+                print(f"cp_soak: rejoin failed: {line!r}", file=sys.stderr)
+                return 1
+            servers[killed] = (proc, port)
+            rejoined = True
+            print(f"cp_soak: shard {killed} REJOINED at "
+                  f"t+{0.6 * args.duration:.0f}s")
 
-    for w in workers:
-        w.join(timeout=args.duration + 120)
-    stuck = [w.wid for w in workers if w.is_alive()]
+    # --- collect ledgers ---------------------------------------------------
+    ledgers: list = []
+    if procs > 1:
+        for ch in children:
+            out, _ = ch.communicate(timeout=args.duration + 180)
+            for line in out.splitlines():
+                if line.startswith("BF_SOAK_LEDGERS "):
+                    ledgers.extend(json.loads(line.split(None, 1)[1]))
+    else:
+        worker_thread.join(timeout=args.duration + 180)
+        ledgers = workers
 
     failures: list = []
+    stuck = [w["wid"] for w in ledgers if w["alive"]]
+    if len(ledgers) != args.clients:
+        failures.append(f"{args.clients - len(ledgers)} client ledger(s) "
+                        "missing (worker process died?)")
     if stuck:
         failures.append(f"{len(stuck)} client(s) never finished: "
                         f"{stuck[:10]}")
-    for w in workers:
-        for e in w.errors:
-            failures.append(f"client {w.wid}: {e}")
-        if w.lost_cycles > (1 if killed is not None else 0):
+    lossy_allowance = 0 if replicate else (1 if killed is not None else 0)
+    for w in ledgers:
+        for e in w["errors"]:
+            failures.append(f"client {w['wid']}: {e}")
+        if w["lost_cycles"] > lossy_allowance:
             failures.append(
-                f"client {w.wid}: {w.lost_cycles} lossy deposit cycles "
-                "(only the kill window may lose one)")
-        if w.acked_bytes != w.drained_bytes + w.lost_bytes:
+                f"client {w['wid']}: {w['lost_cycles']} lossy deposit "
+                f"cycle(s), {w['lost']} B lost"
+                + (" — replication promises ZERO" if replicate else
+                   " (only the kill window may lose one)"))
+        if w["acked"] != w["drained"] + w["lost"]:
             failures.append(
-                f"client {w.wid}: mass leak — acked {w.acked_bytes} != "
-                f"drained {w.drained_bytes} + lost {w.lost_bytes}")
-        if killed is not None and not stuck and \
-                w.dead_seen != {killed} and killed not in w.dead_seen:
+                f"client {w['wid']}: mass leak — acked {w['acked']} != "
+                f"drained {w['drained']} + lost {w['lost']}")
+        if killed is not None and not rejoined and not w["alive"] and \
+                not w["giveups"] and killed not in w["dead_seen"]:
             failures.append(
-                f"client {w.wid}: never converged on dead shard "
-                f"{killed} (saw {sorted(w.dead_seen)})")
+                f"client {w['wid']}: never converged on dead shard "
+                f"{killed} (saw {w['dead_seen']})")
+    giveups = sum(w.get("giveups", 0) for w in ledgers)
+    if giveups > max(1, args.clients // 200):
+        failures.append(
+            f"{giveups} churn reattach giveups exceed the 0.5% "
+            "oversubscription allowance (attach liveness regressed)")
 
     # fresh probe: health view converges from the outside too, and every
     # client's final heartbeat reads back through failover routing
     probe = ShardRouter(endpoints, 10 ** 6, streams=1, lenient=True)
-    probe.poll_shard_health()
-    if killed is not None and killed not in probe.dead_shards():
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        dead = probe.poll_shard_health()
+        want = set() if (killed is None or rejoined) else {killed}
+        if dead == want:
+            break
+        time.sleep(0.3)
+    if killed is not None and not rejoined and \
+            killed not in probe.dead_shards():
         failures.append(
             f"probe router did not converge on dead shard {killed}")
-    finished = [w for w in workers if not w.is_alive() and not w.errors]
-    hb_vals = probe.get_many([f"soak.hb.{w.wid}" for w in finished])
-    hb_bad = sum(1 for w, v in zip(finished, hb_vals) if v != w.last_hb)
-    # a heartbeat written to the victim's keyspace JUST before the kill is
-    # allowed to be stale only if the client never wrote again after
-    # failover — it always does (the loop outlives the kill), so mismatch
-    # means failover routing diverged between writer and prober
+    if rejoined and probe.dead_shards():
+        failures.append(
+            f"ring did not converge back after rejoin (probe still sees "
+            f"{sorted(probe.dead_shards())} dead)")
+    finished = [w for w in ledgers if not w["alive"] and not w["errors"]]
+    hb_vals = probe.get_many([f"soak.hb.{w['wid']}" for w in finished])
+    hb_bad = sum(1 for w, v in zip(finished, hb_vals) if v != w["last_hb"])
     if hb_bad:
         failures.append(f"{hb_bad} final heartbeat(s) unreadable through "
                         "failover routing")
+    repl_views = []
+    if replicate:
+        for name, st in probe.server_stats_all():
+            if st:
+                repl_views.append(
+                    f"{name} repl={st['repl_status']} "
+                    f"lag={st['wal_enqueued'] - st['wal_acked']} "
+                    f"dropped={st['wal_dropped']}")
+    probe.close()
 
     rss = {i: vm_rss_mb(proc.pid) for i, (proc, _) in enumerate(servers)
-           if i != killed}
+           if proc.poll() is None}
     for i, mb in rss.items():
         if mb > args.rss_limit_mb:
             failures.append(f"shard {i} RSS {mb:.0f} MB exceeds the "
                             f"{args.rss_limit_mb:.0f} MB bound")
 
-    total_ops = sum(w.ops for w in workers)
-    total_acked = sum(w.acked_bytes for w in workers)
-    total_lost = sum(w.lost_bytes for w in workers)
-    lossy = sum(w.lost_cycles for w in workers)
+    total_ops = sum(w["ops"] for w in ledgers)
+    total_acked = sum(w["acked"] for w in ledgers)
+    total_lost = sum(w["lost"] for w in ledgers)
+    lossy = sum(w["lost_cycles"] for w in ledgers)
     print(f"cp_soak: {total_ops} cycles, "
-          f"{sum(w.counter_acks for w in workers)} counter acks "
-          f"({sum(w.counter_eras for w in workers)} eras), "
+          f"{sum(w['acks'] for w in ledgers)} counter acks "
+          f"({sum(w['eras'] for w in ledgers)} eras), "
           f"{total_acked / 1e6:.1f} MB deposited, "
-          f"{total_lost} B lost in {lossy} kill-window cycle(s), "
-          f"{sum(w.reattaches for w in workers)} churn reattaches, "
-          f"{sum(w.peer_lost for w in workers)} typed PeerLost, "
+          f"{total_lost} B lost in {lossy} cycle(s), "
+          f"{sum(w['reattaches'] for w in ledgers)} churn reattaches "
+          f"({giveups} giveups), "
+          f"{sum(w['peer_lost'] for w in ledgers)} typed PeerLost, "
           f"survivor RSS {max(rss.values()):.0f} MB, "
           f"wall {time.time() - t0:.1f}s")
+    if repl_views:
+        print("cp_soak: replication: " + "; ".join(repl_views))
 
     for i, (proc, _) in enumerate(servers):
         if proc.poll() is None:

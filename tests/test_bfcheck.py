@@ -466,7 +466,8 @@ def test_protocol_table_internally_consistent():
     assert codes == sorted(codes)
     assert protocol.RETRY_UNSAFE == {
         "barrier", "unlock", "fetch_add", "append_bytes",
-        "append_bytes_tagged", "take_bytes", "put_bytes_part"}
+        "append_bytes_tagged", "take_bytes", "put_bytes_part",
+        "repl_apply"}
     assert protocol.spec("barrier").cxx == "kBarrier"
     with pytest.raises(KeyError):
         protocol.spec("nope")

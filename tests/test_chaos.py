@@ -1420,6 +1420,300 @@ def test_shard_kill_mid_gossip_run_completes(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# durable control plane (r16): WAL replication, lock handoff, shard rejoin
+# ---------------------------------------------------------------------------
+
+def _spawn_shard_repl(i: int, port: int = 0, rejoin: bool = False,
+                      world: int = 1):
+    """Phase 1 of a replicated shard spawn: returns (proc, port) after the
+    BF_SHARD_PORT line; finish with :func:`_finish_repl_spawn`."""
+    cmd = [sys.executable, str(SHARD_SERVER), "--port", str(port),
+           "--world", str(world), "--shard", str(i), "--expect-peers"]
+    if rejoin:
+        cmd.append("--rejoin")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stdin=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("BF_SHARD_PORT"), f"shard {i}: {line!r}"
+    return proc, int(line.split()[1])
+
+
+def _finish_repl_spawn(servers) -> None:
+    ring = ",".join(f"127.0.0.1:{port}" for _, port in servers)
+    for proc, _ in servers:
+        proc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+        proc.stdin.flush()
+    for i, (proc, _) in enumerate(servers):
+        line = proc.stdout.readline()
+        assert line.startswith("BF_SHARD_READY"), f"shard {i}: {line!r}"
+
+
+@pytest.fixture()
+def repl_pair(monkeypatch):
+    """Two real shard server PROCESSES with WAL replication wired
+    (SIGKILL-able) + fast reconnects."""
+    monkeypatch.setenv("BLUEFOG_CP_BACKOFF_MS", "20")
+    servers = [_spawn_shard_repl(i) for i in range(2)]
+    _finish_repl_spawn(servers)
+    yield servers
+    native.fault_disarm()
+    _stop_shards(servers)
+
+
+def test_repl_deposit_zero_loss_on_shard_kill(repl_pair):
+    """THE tentpole acceptance: SIGKILL a shard with NON-EMPTY undrained
+    mailboxes — every acked deposit is drained from the promoted ring
+    successor, byte for byte. Not a 'documented one-cycle window': zero
+    lost deposits."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    r = ShardRouter(_endpoints(repl_pair), 0, streams=1)
+    rng = np.random.default_rng(_seed(41))
+    box = next(f"zl.box.{j}" for j in range(64)
+               if r.shard_of(f"zl.box.{j}") == 1)
+    blobs = [bytes(rng.integers(0, 256, size=int(rng.integers(200, 4000)),
+                                dtype=np.uint8)) for _ in range(12)]
+    replies = r.append_bytes_many([box] * len(blobs), blobs)
+    assert all(rep >= 1 for rep in replies)
+    proc, _ = repl_pair[1]
+    proc.send_signal(signal.SIGKILL)   # dies holding 12 undrained records
+    proc.wait()
+    drained = [bytes(x) for lst in r.take_bytes_many([box]) for x in lst]
+    assert drained == blobs, (
+        f"lost deposits across the kill: {len(drained)}/{len(blobs)} "
+        "records survived")
+    assert r.dead_shards() == {1}
+    r.close()
+
+
+def test_repl_fetch_add_continuous_across_kill(repl_pair):
+    """With WAL replication the counter CONTINUES on the successor — the
+    r14 'era restarts at 0' contract is upgraded to cross-era continuity:
+    a skipped or repeated pre-add value on either side of the SIGKILL
+    would be a double- or lost apply."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    r = ShardRouter(_endpoints(repl_pair), 0, streams=1)
+    key = next(f"cc.ctr.{j}" for j in range(64)
+               if r.shard_of(f"cc.ctr.{j}") == 1)
+    native.fault_arm(f"drop_after=6,seed={_seed(43)}")
+    pre = [r.fetch_add(key, 1) for _ in range(25)]
+    assert pre == list(range(25))
+    proc, _ = repl_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    post = [r.fetch_add(key, 1) for _ in range(25)]
+    native.fault_disarm()
+    assert post == list(range(25, 50)), \
+        f"counter not continuous across failover: {post[:5]}..."
+    assert r.get(key) == 50
+    assert r.dead_shards() == {1}
+    r.close()
+
+
+def test_repl_lock_handoff_on_shard_kill(repl_pair):
+    """Satellite acceptance: the lock holder's shard is SIGKILLed
+    mid-critical-section; a waiter acquires on the promoted successor
+    WITHOUT PeerLostError, and the holder's unlock hands off cleanly
+    (the successor adopted holder state via the WAL)."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    eps = _endpoints(repl_pair)
+    holder = ShardRouter(eps, 0, streams=1)
+    waiter = ShardRouter(eps, 1, streams=1)
+    key = next(f"lh.lock.{j}" for j in range(64)
+               if holder.shard_of(f"lh.lock.{j}") == 1)
+    holder.lock(key)
+    time.sleep(0.2)  # let the grant replicate
+    acquired = threading.Event()
+
+    def wait_lock():
+        waiter.lock(key)   # blocks on shard 1, dies with it, fails over
+        acquired.set()
+
+    th = threading.Thread(target=wait_lock, daemon=True)
+    th.start()
+    time.sleep(0.3)
+    proc, _ = repl_pair[1]
+    proc.send_signal(signal.SIGKILL)   # mid-critical-section
+    proc.wait()
+    time.sleep(0.5)
+    assert not acquired.is_set(), \
+        "waiter acquired while the holder still held the handoff lock"
+    holder.unlock(key)     # fails over; the replica knows the holder
+    th.join(timeout=20)
+    assert acquired.is_set(), \
+        "waiter never acquired on the promoted successor"
+    waiter.unlock(key)
+    holder.close()
+    waiter.close()
+
+
+def test_repl_shard_rejoin_catches_up(repl_pair):
+    """Shard rejoin within a job: the restarted process catches up from
+    its successor's snapshot + WAL, publishes an even liveness
+    generation, and the routers move the keyspace back — counters stay
+    continuous and failover-era deposits survive the whole lifecycle."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    eps = _endpoints(repl_pair)
+    r = ShardRouter(eps, 0, streams=1)
+    key = next(f"rj.ctr.{j}" for j in range(64)
+               if r.shard_of(f"rj.ctr.{j}") == 1)
+    box = next(f"rj.box.{j}" for j in range(64)
+               if r.shard_of(f"rj.box.{j}") == 1)
+    assert [r.fetch_add(key, 1) for _ in range(10)] == list(range(10))
+    proc, port = repl_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    # failover era: counter continues, deposits land on the survivor
+    assert [r.fetch_add(key, 1) for _ in range(5)] == list(range(10, 15))
+    r.append_bytes_many([box] * 2, [b"alpha" * 40, b"beta" * 30])
+    # restart IN PLACE on the same port with snapshot catch-up
+    nproc, nport = _spawn_shard_repl(1, port=port, rejoin=True)
+    repl_pair[1] = (nproc, nport)
+    ring = ",".join(f"127.0.0.1:{p}" for _, p in
+                    [repl_pair[0], (nproc, port)])
+    nproc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+    nproc.stdin.flush()
+    assert nproc.stdout.readline().startswith("BF_SHARD_READY")
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and r.poll_shard_health():
+        time.sleep(0.2)
+    assert r.dead_shards() == set(), "routers never moved the ring back"
+    # the rejoined shard serves its keyspace with full state
+    assert [r.fetch_add(key, 1) for _ in range(5)] == list(range(15, 20))
+    drained = [bytes(x) for lst in r.take_bytes_many([box]) for x in lst]
+    assert drained == [b"alpha" * 40, b"beta" * 30], \
+        "failover-era deposits lost across the rejoin"
+    r.close()
+
+
+def test_repl_status_reports_degraded_survivor(repl_pair):
+    """After the kill the survivor serves UNREPLICATED (its successor is
+    gone): its stats block must say so (repl_status == 2) — the signal
+    `bfrun --status --strict` turns into an under-replication finding
+    with exit 2."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    r = ShardRouter(_endpoints(repl_pair), 0, streams=1)
+    r.put("ds.x", 1)  # traffic so both replicators are live
+    for name, st in r.server_stats_all():
+        assert st["repl_status"] == 1, (name, st["repl_status"])
+    proc, _ = repl_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    # drive some writes so the survivor notices its dead successor
+    for i in range(20):
+        r.put(f"ds.k{i}", i)
+    deadline = time.monotonic() + 10
+    degraded = False
+    while time.monotonic() < deadline and not degraded:
+        for name, st in r.server_stats_all():
+            if st is not None and st["repl_status"] == 2:
+                degraded = True
+        time.sleep(0.1)
+    assert degraded, "survivor never reported itself under-replicated"
+    r.close()
+
+
+def test_single_endpoint_plane_r8_semantics_pinned(monkeypatch):
+    """Satellite regression pin: an UNSHARDED (single-endpoint) plane
+    keeps the r8 lease/force-release behavior byte-identical — no WAL
+    machinery engages (repl_status 0), a lease expiry wakes the waiter
+    with PeerLostError, the broken holder's unlock reports
+    PeerLostError, and a connection-close force-releases instantly."""
+    monkeypatch.setenv("BLUEFOG_CP_LOCK_LEASE", "1.0")
+    srv = native.ControlPlaneServer(2, _free_port())
+    try:
+        assert srv.stats()["repl_status"] == 0
+        holder = native.ControlPlaneClient("127.0.0.1", srv.port, 0,
+                                           streams=1)
+        waiter = native.ControlPlaneClient("127.0.0.1", srv.port, 1,
+                                           streams=1)
+        holder.lock("pin.lease")
+        t0 = time.monotonic()
+        with pytest.raises(native.PeerLostError):
+            waiter.lock("pin.lease")   # lease expiry wakes it typed
+        assert time.monotonic() - t0 < 30
+        with pytest.raises(native.PeerLostError):
+            holder.unlock("pin.lease")  # broken critical section, typed
+        # connection-close force-release: instant (not lease-bound), and
+        # the blocked waiter wakes TYPED — it never silently inherits the
+        # possibly-torn critical section; the lock is left free and a
+        # fresh acquire succeeds
+        holder.lock("pin.close")
+        closer = threading.Thread(target=lambda: (time.sleep(0.3),
+                                                  holder.close()),
+                                  daemon=True)
+        closer.start()
+        t0 = time.monotonic()
+        with pytest.raises(native.PeerLostError):
+            waiter.lock("pin.close")   # woken the moment the fd closes
+        assert time.monotonic() - t0 < 5
+        waiter.lock("pin.close")       # left free: clean re-acquire
+        waiter.unlock("pin.close")
+        closer.join()
+        waiter.close()
+        assert srv.stats()["wal_enqueued"] == 0
+    finally:
+        srv.stop()
+
+
+def test_repl_kill_with_undrained_mailboxes_mid_optimizer(monkeypatch):
+    """Chaos demo (acceptance): a hosted-window job over a REPLICATED
+    shard pair wins a SIGKILL landing while deposit mailboxes are
+    NON-EMPTY — win_put deposits to every out-neighbor, the shard dies
+    undrained, and win_update drains everything from the promoted
+    successor: the all-rank result matches the numpy oracle exactly
+    (zero lost deposits — a lost record would break the average)."""
+    import bluefog_tpu as bf
+    import jax.numpy as jnp
+
+    from conftest import cpu_devices
+
+    servers = [_spawn_shard_repl(i) for i in range(2)]
+    _finish_repl_spawn(servers)
+    try:
+        eps = ",".join(f"127.0.0.1:{p}" for _, p in servers)
+        for k, v in {
+            "BLUEFOG_CP_HOSTS": eps,
+            "BLUEFOG_CP_WORLD": "1",
+            "BLUEFOG_CP_RANK": "0",
+            "BLUEFOG_CP_BACKOFF_MS": "20",
+            "BLUEFOG_WIN_PLANE": "hosted",
+            "BLUEFOG_WIN_HOST_PLANE": "1",
+        }.items():
+            monkeypatch.setenv(k, v)
+        cp.reset_for_test()
+        bf.init(devices=cpu_devices(8))
+        assert cp.active()
+        xs = (np.arange(16, dtype=np.float64) ** 2).reshape(8, 2)
+        x = jnp.asarray(xs, jnp.float32)
+        assert bf.win_create(x, "r16.demo")
+        try:
+            bf.win_put(x, "r16.demo")   # deposits queued, NOT drained
+            proc, _ = servers[1]
+            proc.send_signal(signal.SIGKILL)  # dies with full mailboxes
+            proc.wait()
+            got = np.asarray(bf.win_update("r16.demo"))
+            topo = bf.load_topology()
+            want = np.zeros_like(xs)
+            for rk in range(8):
+                nbrs = bf.topology_util.in_neighbor_ranks(topo, rk)
+                want[rk] = (xs[rk] + sum(xs[s] for s in nbrs)) / (
+                    len(nbrs) + 1)
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+            assert cp.client().dead_shards() == {1}
+        finally:
+            bf.win_free("r16.demo")
+    finally:
+        bf.shutdown()
+        cp.reset_for_test()
+        _stop_shards(servers)
+
+
+# ---------------------------------------------------------------------------
 # end-to-end quarantined rejoin through bf.init (subprocess)
 # ---------------------------------------------------------------------------
 
