@@ -255,7 +255,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64)]
     lib.bf_cp_server_load_snapshot.restype = ctypes.c_longlong
     lib.bf_cp_server_load_snapshot.argtypes = [
-        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int]
     lib.bf_cp_server_set_rejoin_pending.restype = None
     lib.bf_cp_server_set_rejoin_pending.argtypes = [ctypes.c_void_p]
     lib.bf_cp_set_failover.restype = None
@@ -696,13 +697,22 @@ class ControlPlaneServer:
         not-yet-loaded snapshot contents."""
         self._lib.bf_cp_server_set_rejoin_pending(self._h)
 
-    def load_snapshot(self, blob: bytes, set_fence: bool = True) -> int:
+    def load_snapshot(self, blob: bytes, set_fence: bool = True,
+                      adopt_wal: bool = False) -> int:
         """Apply a snapshot blob pulled from a peer shard (rejoin
         catch-up); returns the record count applied. ``set_fence`` adopts
         the blob's WAL fence so the predecessor's resumed stream skips
-        records already folded into the snapshot."""
+        records already folded into the snapshot — pass it only for a
+        blob served by the ring PREDECESSOR (the fence is a position in
+        its WAL). ``adopt_wal`` resumes this server's own WAL numbering
+        from the fence the serving shard holds against our stream — pass
+        it only for a blob served by the ring SUCCESSOR (our stream's
+        receiver); restarting at zero would leave every post-rejoin
+        record at or below the receiver's stale fence, silently
+        dropped-and-acked."""
         r = int(self._lib.bf_cp_server_load_snapshot(
-            self._h, blob, len(blob), 1 if set_fence else 0))
+            self._h, blob, len(blob), 1 if set_fence else 0,
+            1 if adopt_wal else 0))
         if r < 0:
             raise RuntimeError("malformed control-plane snapshot blob")
         return r
@@ -950,13 +960,20 @@ class ControlPlaneClient:
         target (lock-free read — safe next to a blocked op)."""
         return bool(self._lib.bf_cp_failed_over(self._h))
 
-    def snapshot(self, filter_shards: int = 0, filter_idx: int = 0) -> bytes:
+    def snapshot(self, filter_shards: int = 0, filter_idx: int = 0,
+                 rearm: bool = False) -> bytes:
         """Pull a point-in-time state snapshot from the connected server
         (kSnapshot; the shard-rejoin catch-up transport). With
         ``filter_shards`` > 0 only keys whose preferred shard
-        (fnv64 % filter_shards) equals ``filter_idx`` are included."""
-        arg = (int(filter_shards) << 32) | (int(filter_idx) & 0xFFFFFFFF) \
-            if filter_shards else 0
+        (fnv64 % filter_shards) equals ``filter_idx`` are included.
+        ``rearm`` declares this caller the serving shard's WAL-stream
+        RECEIVER catching up: the server resumes its degraded stream
+        from this exact cut. Only the rejoin protocol may set it — a
+        pull whose caller does not load the cut into the receiving
+        replica would turn the degrade-era drop into a silent mid-stream
+        gap (diagnostic pulls must leave it False)."""
+        arg = ((int(filter_shards) << 32) | (int(filter_idx) & 0xFFFFFFFF)
+               if filter_shards else 0) | ((1 << 62) if rearm else 0)
         out = ctypes.c_void_p()
         out_len = ctypes.c_int64()
         r = self._lib.bf_cp_snapshot(self._h, arg, ctypes.byref(out),

@@ -98,13 +98,17 @@ OPS: Tuple[OpSpec, ...] = (
            "server handle"),
     OpSpec("repl_apply", 21, "kReplApply", False,
            "one WAL record streamed shard-to-shard by the replicator "
-           "thread (durable control plane); double-applied it would "
+           "thread (durable control plane); the record key rides the "
+           "body length-prefixed (a '\\n' in a user-derived key must not "
+           "corrupt the batch key framing); double-applied it would "
            "duplicate a replicated deposit or double-advance a replicated "
            "counter, so the inter-shard stream rides kSeqPre dedup like "
            "any other non-idempotent op"),
     OpSpec("snapshot", 22, "kSnapshot", True,
-           "pure point-in-time state dump (shard rejoin catch-up); "
-           "re-reading it merely re-serializes the store"),
+           "point-in-time state dump (shard rejoin catch-up); re-reading "
+           "it merely re-serializes the store, and the receiver-flagged "
+           "variant's stream re-arm is idempotent too (already-live "
+           "streams are untouched)"),
 )
 
 # name -> wire code (the table every Python-side consumer keys off)

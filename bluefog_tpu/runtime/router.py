@@ -285,15 +285,37 @@ class ShardRouter:
         """The key's current owner: the first LIVE shard on its ring."""
         n = len(self._st.endpoints)
         pref = _fnv64(key) % n
-        with self._st.mu:
-            for k in range(n):
-                idx = (pref + k) % n
-                if idx not in self._st.dead and \
-                        self._clients[idx] is not None:
-                    return idx
+        for attempt in range(2):
+            with self._st.mu:
+                for k in range(n):
+                    idx = (pref + k) % n
+                    if idx not in self._st.dead and \
+                            self._clients[idx] is not None:
+                        return idx
+            # Last-chance probe before declaring the whole plane gone: a
+            # shard may have REJOINED since this router last looked (its
+            # even liveness generation is on the ring, but a router that
+            # was blocked through the entire failover era — or adopted a
+            # peer's flag just as the peer's shard was already
+            # restarting — only polls health later, and with every shard
+            # flagged dead there is no live client left to poll THROUGH).
+            # A fresh dial per endpoint decides; anything that answers
+            # rejoins the routing table.
+            if attempt == 0 and not self._recover_all_dead():
+                break
         raise OSError(
             "all control-plane shards are dead: "
             + ", ".join(f"{h}:{p}" for h, p in self._st.endpoints))
+
+    def _recover_all_dead(self) -> bool:
+        """Every shard is flagged dead: re-dial each endpoint once and
+        adopt any that actually serves (``_mark_alive`` re-verifies under
+        the shared state lock). Returns True when at least one shard came
+        back. Genuinely dead endpoints refuse the dial fast, so the probe
+        costs one connect attempt per shard on an already-fatal path."""
+        for idx in range(len(self._st.endpoints)):
+            self._mark_alive(idx, "all-dead recovery probe")
+        return len(self.dead_shards()) < len(self._st.endpoints)
 
     def _live(self) -> List[int]:
         with self._st.mu:

@@ -117,14 +117,24 @@ def _parse_peers(spec: str):
 
 
 def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
-    """Restarted-shard catch-up: pull a snapshot from the ring successor
-    (which held this shard's replicated state and served its keyspace
-    since the death), load it, and publish the next EVEN liveness
-    generation so routers move the keyspace back. Serving the snapshot
-    also re-arms the successor-side predecessor stream from the same cut,
-    so snapshot + resumed WAL records are gap-free. For rings larger than
-    two, the predecessor's keyspace (this shard's replica role) is pulled
-    from the predecessor itself — the pull doubles as ITS resync cut."""
+    """Restarted-shard catch-up, two pulls with distinct roles:
+
+    1. From the ring SUCCESSOR — this shard's own keyspace, which the
+       successor replicated and has been serving since the death. The
+       load also RESUMES this shard's WAL numbering (``adopt_wal``) from
+       the fence the successor holds against this shard's stream: a
+       restart back at zero would leave every post-rejoin record at or
+       below that stale fence — silently dropped-and-acked by the
+       successor, i.e. lost on this shard's next death.
+    2. From the ring PREDECESSOR — ITS keyspace (this shard's replica
+       role). The pull carries the receiver flag (``rearm``): serving it
+       re-arms the predecessor's degraded stream from that exact cut,
+       and ``set_fence`` adopts the cut's fence so the resumed stream
+       skips records already folded in — gap-free.
+
+    For a two-shard ring both roles are the same endpoint, so one
+    unfiltered receiver-flagged pull carries everything at a single cut
+    (two filtered pulls would open a gap between their cuts)."""
     n = len(peers)
     succ = (idx + 1) % n
     pred = (idx - 1) % n
@@ -136,17 +146,20 @@ def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
             cl = ControlPlaneClient(host, port, 0, secret=secret, streams=1)
             try:
                 if n <= 2:
-                    # successor == predecessor: one pull carries both the
-                    # served keyspace and the replica keyspace, and the
-                    # fence re-arms the (single) incoming stream
-                    srv.load_snapshot(cl.snapshot(), set_fence=True)
+                    # successor == predecessor: one cut carries both the
+                    # served keyspace and the replica keyspace; the fence,
+                    # the WAL resume, and the stream re-arm all anchor to
+                    # that single cut
+                    srv.load_snapshot(cl.snapshot(rearm=True),
+                                      set_fence=True, adopt_wal=True)
                 else:
-                    srv.load_snapshot(cl.snapshot(n, idx), set_fence=False)
+                    srv.load_snapshot(cl.snapshot(n, idx), set_fence=False,
+                                      adopt_wal=True)
                     ph, pp = peers[pred]
                     pcl = ControlPlaneClient(ph, pp, 0, secret=secret,
                                              streams=1)
                     try:
-                        srv.load_snapshot(pcl.snapshot(n, pred),
+                        srv.load_snapshot(pcl.snapshot(n, pred, rearm=True),
                                           set_fence=True)
                     finally:
                         pcl.close()

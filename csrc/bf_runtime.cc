@@ -38,6 +38,7 @@
 #include <map>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -751,14 +752,54 @@ struct ControlServer {
   std::condition_variable repl_cv;  // queue arrivals + ack advances
   // replica side: records at or below the fence are already folded into
   // the snapshot this server was loaded from (shard rejoin catch-up).
-  // rejoin_pending gates incoming kReplApply records during the window
-  // between the successor serving the snapshot (which re-arms its
-  // stream) and THIS server loading it: records applied to the
-  // still-empty store would land out of order with the snapshot's
+  // The fence is ONLY meaningful against the predecessor's CURRENT WAL
+  // numbering — which is why a rejoining shard RESUMES its own wal_seq
+  // from the fence its successor holds (served in the snapshot header,
+  // adopted by bf_cp_server_load_snapshot): a restart back at zero would
+  // put every new record at or below this stale fence, silently
+  // dropped-and-acked. rejoin_pending gates incoming kReplApply records
+  // during the window between the successor serving the snapshot (which
+  // re-arms its stream) and THIS server loading it: records applied to
+  // the still-empty store would land out of order with the snapshot's
   // contents, so they wait on the gate instead.
   uint64_t repl_fence = 0;
   bool rejoin_pending = false;
   std::atomic<long long> repl_applied_n{0};
+
+  // Keyspaces this shard currently serves as FAILOVER primary (guarded
+  // by mu), recomputed from the replicated bf.cp.shard_dead.<i> liveness
+  // generations (odd = dead) every time one is written — directly, via
+  // the WAL, or in a loaded snapshot. For each dead shard the ring is
+  // walked past consecutive dead entries; the first live shard is the
+  // failover primary routers send that keyspace to. Direct incarnation
+  // GC must sweep these keyspaces too: their preferred shard is dead and
+  // will never WAL the sweep, while this shard is their only live
+  // server (the pseudo-record it WALs instead stays correct once the
+  // dead shard rejoins by snapshot).
+  std::set<int> fo_keyspaces;
+
+  static bool IsDeadFlagKey(const std::string& k) {
+    return k.rfind("bf.cp.shard_dead.", 0) == 0;
+  }
+
+  void RecomputeFoKeyspacesLocked() {
+    fo_keyspaces.clear();
+    if (shard_count <= 1 || shard_idx < 0) return;
+    std::vector<bool> dead(static_cast<size_t>(shard_count), false);
+    for (int i = 0; i < shard_count; ++i) {
+      auto it = kv.find("bf.cp.shard_dead." + std::to_string(i));
+      dead[static_cast<size_t>(i)] =
+          it != kv.end() && (it->second % 2) == 1;
+    }
+    for (int i = 0; i < shard_count; ++i) {
+      // a death claim about OURSELVES is spurious (we are running it)
+      if (!dead[static_cast<size_t>(i)] || i == shard_idx) continue;
+      int j = (i + 1) % shard_count;
+      while (j != i && j != shard_idx && dead[static_cast<size_t>(j)])
+        j = (j + 1) % shard_count;
+      if (j == shard_idx) fo_keyspaces.insert(i);
+    }
+  }
 
   void ReplLoop();  // defined after ControlClient (it dials one)
 
@@ -818,17 +859,19 @@ struct ControlServer {
   void ReplWaitAcked(uint64_t seq) {
     if (seq == 0) return;
     std::unique_lock<std::mutex> lk(mu);
-    auto deadline = std::chrono::system_clock::now() +
-        std::chrono::duration_cast<std::chrono::system_clock::duration>(
+    // steady_clock, like the lock-lease deadlines: a wall-clock step
+    // (NTP correction) must neither spuriously degrade replication nor
+    // stretch the bounded wait past BLUEFOG_CP_REPL_TIMEOUT.
+    auto deadline = std::chrono::steady_clock::now() +
+        std::chrono::duration_cast<std::chrono::steady_clock::duration>(
             std::chrono::duration<double>(repl_wait_sec));
     while (repl_live && wal_acked < seq && seq > wal_dropped_below &&
            !stopping.load()) {
-      if (std::chrono::system_clock::now() >= deadline) {
+      if (std::chrono::steady_clock::now() >= deadline) {
         ReplDegradeLocked();
         break;
       }
-      repl_cv.wait_until(lk, std::chrono::system_clock::now() +
-                                 std::chrono::milliseconds(200));
+      BoundedWaitMs(repl_cv, lk, 200);
     }
   }
 
@@ -936,16 +979,22 @@ struct ControlServer {
   // origin-tagged mailbox records — deposits of STALE parameters the owner
   // never drained — are dropped with their byte accounting.
   // ``from_wal`` selects the mailbox sweep's scope. A DIRECT attach on a
-  // replicating shard must only sweep mailboxes it is the primary for
-  // (preferred shard == shard_idx): replica-keyspace boxes take every
-  // mutation — appends, counted-prefix drains, and this GC — through the
-  // predecessor's ordered WAL alone, because a second mutation source
-  // would misalign the counted-prefix take applies (a drain of "first N
-  // records" erases the wrong N once the copies disagree). The primary
-  // WALs its own GC as a pseudo-record, so the replica applies it at the
-  // same sequence point (from_wal=true sweeps everything — own-keyspace
-  // boxes were already swept by the direct attach, and re-sweeping is
-  // idempotent). Unsharded/unconfigured servers keep the full sweep.
+  // replicating shard must only sweep mailboxes it is currently the
+  // primary for — preferred shard == shard_idx, PLUS any keyspace it
+  // serves as failover primary (fo_keyspaces: the preferred shard is
+  // dead and will never WAL the sweep, while this shard is those boxes'
+  // only live server — skipping them would let the owner later drain a
+  // churned client's stale deposits, exactly what incarnation GC
+  // exists to prevent). Replica-keyspace boxes of a LIVE predecessor
+  // take every mutation — appends, counted-prefix drains, and this GC —
+  // through the predecessor's ordered WAL alone, because a second
+  // mutation source would misalign the counted-prefix take applies (a
+  // drain of "first N records" erases the wrong N once the copies
+  // disagree). The primary WALs its own GC as a pseudo-record, so the
+  // replica applies it at the same sequence point (from_wal=true sweeps
+  // everything — own-keyspace boxes were already swept by the direct
+  // attach, and re-sweeping is idempotent). Unsharded/unconfigured
+  // servers keep the full sweep.
   void GcIncarnationLocked(int rank, bool from_wal = false) {
     bool released = false;
     for (auto& it : locks) {
@@ -965,11 +1014,13 @@ struct ControlServer {
     const bool scoped = !from_wal && shard_count > 1 && shard_idx >= 0;
     const int8_t origin = static_cast<int8_t>(rank & 0x7F);
     for (auto it = mailbox.begin(); it != mailbox.end();) {
-      if (scoped && Fnv64(it->first) %
-              static_cast<uint64_t>(shard_count) !=
-          static_cast<uint64_t>(shard_idx)) {
-        ++it;  // replica-keyspace box: the predecessor's WAL sweeps it
-        continue;
+      if (scoped) {
+        const int pref = static_cast<int>(
+            Fnv64(it->first) % static_cast<uint64_t>(shard_count));
+        if (pref != shard_idx && fo_keyspaces.count(pref) == 0) {
+          ++it;  // live predecessor's keyspace: its WAL sweeps the box
+          continue;
+        }
       }
       auto oi = mailbox_origin.find(it->first);
       auto& box = it->second;
@@ -1392,6 +1443,7 @@ struct ControlServer {
         case kPut: {
           std::lock_guard<std::mutex> lk(mu);
           kv[key] = arg;
+          if (IsDeadFlagKey(key)) RecomputeFoKeyspacesLocked();
           reply = 1;
           repl_wait = ReplEnqueueLocked(op, key, arg, reply, std::string(),
                                         rank, 0, 0, 0, false);
@@ -1403,6 +1455,10 @@ struct ControlServer {
           std::lock_guard<std::mutex> lk(mu);
           int64_t& slot = kv[key];
           if (arg > slot) slot = arg;
+          // liveness generation writes (the router's death announcement /
+          // a rejoiner's alive publish) re-derive which keyspaces this
+          // shard serves as failover primary
+          if (IsDeadFlagKey(key)) RecomputeFoKeyspacesLocked();
           reply = slot;
           repl_wait = ReplEnqueueLocked(op, key, arg, reply, std::string(),
                                         rank, 0, 0, 0, false);
@@ -1690,7 +1746,7 @@ struct ControlServer {
           // origin client's reply under its dedup identity. Never
           // re-enqueued into our own WAL: replication factor is 2, and
           // direct ops we serve post-failover chain onward naturally.
-          if (dlen < kReplHdr) {
+          if (dlen < kReplHdr + 2) {
             reply = -1;
             break;
           }
@@ -1706,8 +1762,20 @@ struct ControlServer {
           std::memcpy(&ocidx, data + 22, 4);
           std::memcpy(&oarg, data + 26, 8);
           std::memcpy(&oreply, data + 34, 8);
-          const char* pay = data + kReplHdr;
-          const size_t pn = dlen - kReplHdr;
+          // The record KEY rides the body, length-prefixed — never the
+          // multi-op frame key: that batch joins keys with '\n', and a
+          // control-plane key embeds user-derived queue/collective names
+          // which may themselves contain a newline. Framing the key here
+          // keeps the batch split-proof for every possible key.
+          uint16_t rklen;
+          std::memcpy(&rklen, data + kReplHdr, 2);
+          if (kReplHdr + 2 + static_cast<size_t>(rklen) > dlen) {
+            reply = -1;
+            break;
+          }
+          const std::string rkey(data + kReplHdr + 2, rklen);
+          const char* pay = data + kReplHdr + 2 + rklen;
+          const size_t pn = dlen - kReplHdr - 2 - rklen;
           std::lock_guard<std::mutex> lk(mu);
           const uint64_t rseq = static_cast<uint64_t>(arg);
           if (rseq <= repl_fence) {  // already folded into our snapshot
@@ -1740,28 +1808,30 @@ struct ControlServer {
           bool has_bulk = false;
           switch (rop) {
             case kPut:
-              kv[key] = oarg;
+              kv[rkey] = oarg;
+              if (IsDeadFlagKey(rkey)) RecomputeFoKeyspacesLocked();
               break;
             case kPutMax: {
-              int64_t& slot = kv[key];
+              int64_t& slot = kv[rkey];
               if (oarg > slot) slot = oarg;
+              if (IsDeadFlagKey(rkey)) RecomputeFoKeyspacesLocked();
               break;
             }
             case kFetchAdd:
-              kv[key] += oarg;
+              kv[rkey] += oarg;
               break;
             case kAppendBytes:
             case kAppendBytesTagged:
-              mailbox[key].emplace_back(pay, pn);
-              mailbox_origin[key].push_back(
+              mailbox[rkey].emplace_back(pay, pn);
+              mailbox_origin[rkey].push_back(
                   rop == kAppendBytesTagged
                       ? static_cast<int8_t>(
                             (static_cast<uint64_t>(oarg) >> 56) & 0x7F)
                       : static_cast<int8_t>(-1));
-              box_bytes[key] += static_cast<int64_t>(pn);
+              box_bytes[rkey] += static_cast<int64_t>(pn);
               break;
             case kTakeBytes: {
-              auto it = mailbox.find(key);
+              auto it = mailbox.find(rkey);
               if (it != mailbox.end()) {
                 auto& box = it->second;
                 size_t n = static_cast<size_t>(oarg);
@@ -1778,15 +1848,15 @@ struct ControlServer {
                 for (size_t i = 0; i < n; ++i)
                   taken += static_cast<int64_t>(box[i].size());
                 box.erase(box.begin(), box.begin() + n);
-                auto oi = mailbox_origin.find(key);
+                auto oi = mailbox_origin.find(rkey);
                 if (oi != mailbox_origin.end() && oi->second.size() >= n)
                   oi->second.erase(oi->second.begin(),
                                    oi->second.begin() + n);
-                box_bytes[key] -= taken;
+                box_bytes[rkey] -= taken;
                 if (box.empty()) {
                   mailbox.erase(it);
-                  box_bytes.erase(key);
-                  mailbox_origin.erase(key);
+                  box_bytes.erase(rkey);
+                  mailbox_origin.erase(rkey);
                 }
               } else if (rrec) {
                 has_bulk = true;  // record the (empty) haul faithfully
@@ -1794,7 +1864,7 @@ struct ControlServer {
               break;
             }
             case kLock: {
-              LockInfo& L = locks[key];
+              LockInfo& L = locks[rkey];
               L.rank = static_cast<int>(oarg);
               L.fd = -1;  // no local connection: lease is the backstop
               if (lock_lease_sec > 0)
@@ -1806,7 +1876,7 @@ struct ControlServer {
               break;
             }
             case kUnlock: {
-              auto it = locks.find(key);
+              auto it = locks.find(rkey);
               if (it != locks.end() &&
                   (oarg < 0 || it->second.rank == static_cast<int>(oarg))) {
                 it->second.rank = -1;
@@ -1852,11 +1922,19 @@ struct ControlServer {
           break;
         }
         case kSnapshot: {
-          // Point-in-time state pull (shard rejoin catch-up). Serving it
-          // also re-arms OUR replicator from this cut: the requester ends
-          // up with snapshot + every later WAL record, gap-free.
+          // Point-in-time state pull (shard rejoin catch-up). arg packs
+          // bit 62 = "requester is OUR stream receiver, re-arm from this
+          // cut", bits 32..61 = filter shard count, bits 0..31 = filter
+          // index. The blob header carries (a) OUR wal_seq — the fence
+          // the requester adopts against the stream WE send it — and
+          // (b) OUR repl_fence — the position the requester's own WAL
+          // numbering must RESUME from when we are its receiver (a
+          // restart back at zero would put every new record at or below
+          // the stale fence we hold: silently dropped-and-acked, lost
+          // on the requester's next death).
           const uint64_t filt = static_cast<uint64_t>(arg);
-          const uint64_t fn = filt >> 32;
+          const bool rearm = ((filt >> 62) & 1u) != 0;
+          const uint64_t fn = (filt >> 32) & 0x3FFFFFFFu;
           const uint64_t fi = filt & 0xFFFFFFFFu;
           std::string blob;
           {
@@ -1877,6 +1955,8 @@ struct ControlServer {
             };
             uint64_t fence = wal_seq;
             blob.append(reinterpret_cast<const char*>(&fence), 8);
+            uint64_t resume = repl_fence;
+            blob.append(reinterpret_cast<const char*>(&resume), 8);
             for (const auto& it : kv)
               if (want(it.first))
                 put_rec(0, it.first, it.second, nullptr, 0);
@@ -1896,7 +1976,18 @@ struct ControlServer {
                 put_rec(2, it.first, it.second.rank, nullptr, 0);
             for (const auto& it : incarnations)
               put_rec(3, std::to_string(it.first), it.second, nullptr, 0);
-            if (repl_cfg && !repl_live) {
+            // Re-arm OUR degraded outgoing stream ONLY when the requester
+            // declares itself that stream's receiver (the rejoin pull of
+            // OUR keyspace by our ring successor): it loads this very
+            // cut, so cut + resumed records are gap-free. Any other pull
+            // — a rejoiner fetching its own keyspace from its successor,
+            // a diagnostic ControlPlaneClient.snapshot() — must NOT
+            // resume the stream: the real receiver never loaded this
+            // cut, and the records dropped while degraded would become
+            // exactly the silent mid-stream gap degrade exists to
+            // prevent. The flag rides the pull itself (not a separate
+            // op) so cut and re-arm stay atomic under one mutex hold.
+            if (rearm && repl_cfg && !repl_live) {
               repl_live = true;  // resync point: stream resumes from here
               repl_cv.notify_all();
             }
@@ -2661,10 +2752,14 @@ void ControlServer::ReplLoop() {
       std::vector<int64_t> out(static_cast<size_t>(n));
       for (int i = 0; i < n; ++i) {
         const ReplRecord& r = batch[static_cast<size_t>(i)];
+        // The frame keys stay EMPTY ('\n' separators only): the record
+        // key rides the body, length-prefixed, because the multi-op key
+        // string splits on '\n' and control-plane keys embed
+        // user-derived names that may contain one — a newline key would
+        // shift every later record in the batch onto the wrong key.
         if (i) keys.push_back('\n');
-        keys += r.key;
         std::string& b = bodies[static_cast<size_t>(i)];
-        b.reserve(kReplHdr + r.data.size());
+        b.reserve(kReplHdr + 2 + r.key.size() + r.data.size());
         b.push_back(static_cast<char>(r.op));
         b.push_back(static_cast<char>(r.record_reply));
         b.append(reinterpret_cast<const char*>(&r.rank), 4);
@@ -2673,6 +2768,9 @@ void ControlServer::ReplLoop() {
         b.append(reinterpret_cast<const char*>(&r.cidx), 4);
         b.append(reinterpret_cast<const char*>(&r.arg), 8);
         b.append(reinterpret_cast<const char*>(&r.reply), 8);
+        uint16_t kl = static_cast<uint16_t>(r.key.size());
+        b.append(reinterpret_cast<const char*>(&kl), 2);
+        b.append(r.key);
         b.append(r.data);
         ptrs[static_cast<size_t>(i)] = b.data();
         lens[static_cast<size_t>(i)] = static_cast<int64_t>(b.size());
@@ -2917,25 +3015,34 @@ int bf_cp_server_set_successor(void* h, const char* host, int port,
   srv->repl_cfg = true;
   srv->repl_live = true;
   srv->rejoin_pending = false;  // gate opens: every op is replicated now
+  // the ring position is known only now: derive which keyspaces this
+  // shard already serves as failover primary (liveness flags may have
+  // arrived in a rejoin snapshot or as early direct writes)
+  srv->RecomputeFoKeyspacesLocked();
   srv->cv.notify_all();
   srv->repl_thread = std::thread([srv] { srv->ReplLoop(); });
   return 0;
 }
 
-// Arm the rejoin gate: incoming kReplApply records park until
-// bf_cp_server_load_snapshot clears it. Call BEFORE pulling the snapshot
-// — the successor re-arms its stream the moment it serves the pull, and
-// records applied before the load would interleave out of order.
+// Arm the rejoin gate: incoming kReplApply records park until the
+// catch-up completes (bf_cp_server_set_successor opens it). Call BEFORE
+// pulling the snapshots — the ring predecessor re-arms its stream the
+// moment it serves the receiver-flagged pull, and records applied
+// before the load would interleave out of order.
 void bf_cp_server_set_rejoin_pending(void* h) {
   auto* srv = static_cast<ControlServer*>(h);
   std::lock_guard<std::mutex> lk(srv->mu);
   srv->rejoin_pending = true;
 }
 
-// Pull a point-in-time snapshot over a CLIENT handle (kSnapshot). filter:
-// 0 = everything, else (nshards << 32 | idx) selects one keyspace. The
-// malloc'd blob (freed with bf_cp_free) starts with the serving shard's
-// WAL fence. Returns blob length, or a negative status.
+// Pull a point-in-time snapshot over a CLIENT handle (kSnapshot). filter
+// packs (nshards << 32 | idx) to select one keyspace (0 = everything),
+// plus bit 62 — set ONLY by the rejoin protocol when the caller is the
+// serving shard's stream receiver, re-arming its degraded replicator
+// from this exact cut. The malloc'd blob (freed with bf_cp_free) starts
+// with the serving shard's WAL fence and the resume position it holds
+// for its predecessor's stream. Returns blob length, or a negative
+// status.
 int64_t bf_cp_snapshot(void* h, int64_t filter, void** out,
                        int64_t* out_len) {
   return static_cast<ControlClient*>(h)->CallBytes(kSnapshot, "", out,
@@ -2945,16 +3052,25 @@ int64_t bf_cp_snapshot(void* h, int64_t filter, void** out,
 // Load a snapshot blob into THIS server's store (shard rejoin catch-up;
 // call before announcing the shard alive). set_fence != 0 adopts the
 // blob's WAL fence so replication records already folded into the
-// snapshot are skipped when the predecessor's stream resumes. Returns the
-// number of records applied, or -1 on a malformed blob.
+// snapshot are skipped when the predecessor's stream resumes — only
+// meaningful when the SERVING shard is this server's ring predecessor
+// (the fence is a position in ITS WAL). adopt_wal != 0 resumes THIS
+// server's own WAL numbering from the fence the serving shard holds
+// against our stream — only meaningful when the serving shard is our
+// ring SUCCESSOR (our stream's receiver): restarting the numbering at
+// zero would put every post-rejoin record at or below the receiver's
+// stale fence, silently dropped-and-acked — lost on our next death.
+// Returns the number of records applied, or -1 on a malformed blob.
 long long bf_cp_server_load_snapshot(void* h, const void* data,
-                                     int64_t len, int set_fence) {
+                                     int64_t len, int set_fence,
+                                     int adopt_wal) {
   auto* srv = static_cast<ControlServer*>(h);
   const char* p = static_cast<const char*>(data);
-  if (len < 8) return -1;
-  uint64_t fence;
+  if (len < 16) return -1;
+  uint64_t fence, resume;
   std::memcpy(&fence, p, 8);
-  int64_t off = 8;
+  std::memcpy(&resume, p + 8, 8);
+  int64_t off = 16;
   long long applied = 0;
   std::lock_guard<std::mutex> lk(srv->mu);
   while (off < len) {
@@ -3003,6 +3119,15 @@ long long bf_cp_server_load_snapshot(void* h, const void* data,
     ++applied;
   }
   if (set_fence) srv->repl_fence = fence;
+  if (adopt_wal) {
+    srv->wal_seq = resume;
+    srv->wal_acked = resume;
+    srv->wal_dropped_below = resume;
+  }
+  // liveness flags may ride the snapshot KV records; fo_keyspaces is
+  // re-derived now and again at set_successor (when the ring position
+  // becomes known)
+  srv->RecomputeFoKeyspacesLocked();
   // NOTE: the rejoin gate stays CLOSED — it opens when the successor
   // stream is armed (bf_cp_server_set_successor). Serving ops between
   // the load and the arm would ack them unreplicated: a router that

@@ -6,9 +6,11 @@ up to 5-10k lightweight raw clients — no JAX anywhere in this harness —
 hammering heartbeats, locks, fetch_add counters, and deposit/drain cycles
 against a SHARDED, WAL-REPLICATED control plane while the harness SIGKILLs
 a shard server mid-run, optionally RESTARTS it in place (``--rejoin``:
-snapshot catch-up + even liveness generation), and (with ``--churn``)
-rolls clients through incarnation-bumped reattach cycles. Asserted
-invariants:
+snapshot catch-up + even liveness generation — then kills and rejoins the
+restarted shard's ring PREDECESSOR too, so both sides of the ring cross a
+death/restart boundary and a stale replication fence cannot hide), and
+(with ``--churn``) rolls clients through incarnation-bumped reattach
+cycles. Asserted invariants:
 
 * **health convergence** — after a kill, every client's router converges
   on the same dead-shard set; after a rejoin, back to the full ring;
@@ -443,34 +445,57 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
     # --- shard kill / rejoin schedule (parent drives it) -------------------
     killed = None
     rejoined = False
+
+    def rejoin_shard(idx: int, at_frac: float) -> bool:
+        time.sleep(max(0.0, deadline_wall - time.time()
+                       - (1.0 - at_frac) * args.duration))
+        proc, port = spawn_shard(idx, 1, True, port=servers[idx][1],
+                                 rejoin=True)
+        # phase 2 for the single restarted shard: full ring over stdin
+        ring = ",".join(f"127.0.0.1:{p}" for _, p in
+                        [sp if i != idx else (proc, port)
+                         for i, sp in enumerate(servers)])
+        proc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+        proc.stdin.flush()
+        line = proc.stdout.readline()
+        if not line.startswith("BF_SHARD_READY"):
+            print(f"cp_soak: rejoin failed: {line!r}", file=sys.stderr)
+            return False
+        servers[idx] = (proc, port)
+        print(f"cp_soak: shard {idx} REJOINED at "
+              f"t+{at_frac * args.duration:.0f}s")
+        return True
+
     if 0 <= args.kill_shard < args.shards:
         time.sleep(max(0.0, deadline_wall - time.time()
                        - 0.65 * args.duration))
-        victim, vport = servers[args.kill_shard]
+        victim, _ = servers[args.kill_shard]
         victim.send_signal(signal.SIGKILL)
         victim.wait()
         killed = args.kill_shard
         print(f"cp_soak: SIGKILLed shard {killed} at "
               f"t+{0.35 * args.duration:.0f}s")
         if args.rejoin:
-            time.sleep(max(0.0, deadline_wall - time.time()
-                           - 0.4 * args.duration))
-            proc, port = spawn_shard(killed, 1, True, port=vport,
-                                     rejoin=True)
-            # phase 2 for the single restarted shard: full ring over stdin
-            ring = ",".join(f"127.0.0.1:{p}" for _, p in
-                            [sp if i != killed else (proc, port)
-                             for i, sp in enumerate(servers)])
-            proc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
-            proc.stdin.flush()
-            line = proc.stdout.readline()
-            if not line.startswith("BF_SHARD_READY"):
-                print(f"cp_soak: rejoin failed: {line!r}", file=sys.stderr)
+            if not rejoin_shard(killed, 0.6):
                 return 1
-            servers[killed] = (proc, port)
             rejoined = True
-            print(f"cp_soak: shard {killed} REJOINED at "
-                  f"t+{0.6 * args.duration:.0f}s")
+            # Round 2: churn the OTHER side of the ring — kill and rejoin
+            # the restarted shard's ring predecessor, the shard whose
+            # post-rejoin WAL stream must land above the fence the first
+            # rejoiner adopted (a stale-fence regression silently drops
+            # those acked records and surfaces here as lost deposit mass
+            # and counter-era gaps).
+            if args.shards >= 2:
+                second = (killed - 1) % args.shards
+                time.sleep(max(0.0, deadline_wall - time.time()
+                               - 0.28 * args.duration))
+                victim2, _ = servers[second]
+                victim2.send_signal(signal.SIGKILL)
+                victim2.wait()
+                print(f"cp_soak: SIGKILLed shard {second} (round 2) at "
+                      f"t+{0.72 * args.duration:.0f}s")
+                if not rejoin_shard(second, 0.85):
+                    return 1
 
     # --- collect ledgers ---------------------------------------------------
     ledgers: list = []

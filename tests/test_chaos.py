@@ -1617,6 +1617,186 @@ def test_repl_status_reports_degraded_survivor(repl_pair):
     r.close()
 
 
+def test_repl_rejoin_churn_both_shards(repl_pair):
+    """Stale-fence regression: shard 1 rejoins (adopting a fence over
+    shard 0's WAL stream), THEN shard 0 dies and rejoins. The restarted
+    shard 0 must RESUME its WAL numbering from the fence its successor
+    holds — a restart back at zero would leave every post-rejoin record
+    at or below that stale fence, silently dropped-and-acked by shard 1,
+    i.e. shard 0's next death loses acked writes."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    eps = _endpoints(repl_pair)
+    ring = ",".join(f"127.0.0.1:{p}" for _, p in repl_pair)
+    r = ShardRouter(eps, 0, streams=1)
+    k0 = next(f"bb.ctr.{j}" for j in range(64)
+              if r.shard_of(f"bb.ctr.{j}") == 0)
+    box0 = next(f"bb.box.{j}" for j in range(64)
+                if r.shard_of(f"bb.box.{j}") == 0)
+
+    def rejoin(slot: int):
+        proc, port = repl_pair[slot]
+        nproc, _ = _spawn_shard_repl(slot, port=port, rejoin=True)
+        nproc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+        nproc.stdin.flush()
+        assert nproc.stdout.readline().startswith("BF_SHARD_READY"), \
+            f"shard {slot} failed to rejoin"
+        repl_pair[slot] = (nproc, port)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and r.poll_shard_health():
+            time.sleep(0.2)
+        assert r.dead_shards() == set(), \
+            f"routers never moved the ring back after shard {slot} rejoin"
+
+    # era 0: advance shard 0's WAL well past the record count of era 3 —
+    # the stale fence must be LARGER than what a zero-based restart would
+    # silently drop for the regression to bite
+    assert [r.fetch_add(k0, 1) for _ in range(40)] == list(range(40))
+    # era 1: shard 1 dies; traffic degrades shard 0's stream, then
+    # shard 1 rejoins — its snapshot fences shard 0's stream at ~43
+    p1, _ = repl_pair[1]
+    p1.send_signal(signal.SIGKILL)
+    p1.wait()
+    assert [r.fetch_add(k0, 1) for _ in range(3)] == [40, 41, 42]
+    rejoin(1)
+    # post-rejoin records ride shard 0's re-armed stream to shard 1
+    assert [r.fetch_add(k0, 1) for _ in range(4)] == [43, 44, 45, 46]
+    # era 2: shard 0 dies; its keyspace fails over to the REJOINED
+    # shard 1, which must hold the full replicated counter
+    p0, _ = repl_pair[0]
+    p0.send_signal(signal.SIGKILL)
+    p0.wait()
+    assert [r.fetch_add(k0, 1) for _ in range(2)] == [47, 48], \
+        "replicated state missing on the rejoined successor"
+    # era 3: shard 0 restarts in place — THE regression window: every
+    # record it now acks must land above shard 1's fence
+    rejoin(0)
+    assert [r.fetch_add(k0, 1) for _ in range(5)] == list(range(49, 54))
+    blobs = [b"era3-%d" % i * 30 for i in range(6)]
+    assert all(n >= 1 for n in r.append_bytes_many([box0] * len(blobs),
+                                                   blobs))
+    # era 4: shard 0 dies AGAIN — everything it acked in era 3 must
+    # drain from shard 1, byte for byte
+    np0, _ = repl_pair[0]
+    np0.send_signal(signal.SIGKILL)
+    np0.wait()
+    assert [r.fetch_add(k0, 1) for _ in range(2)] == [54, 55], \
+        "era-3 counter records were dropped by a stale replication fence"
+    drained = [bytes(x) for lst in r.take_bytes_many([box0]) for x in lst]
+    assert drained == blobs, (
+        f"era-3 deposits lost across the second death: {len(drained)}/"
+        f"{len(blobs)} records survived (stale repl_fence ate the "
+        "rejoined shard's WAL stream)")
+    r.close()
+
+
+def test_repl_degraded_stream_not_rearmed_by_diagnostic_snapshot(repl_pair):
+    """A snapshot pull that is NOT the stream receiver's rejoin catch-up
+    (a diagnostic unfiltered pull, or a rejoiner fetching its OWN
+    keyspace) must leave a degraded stream degraded: the real receiver
+    never loads that cut, so resuming would hide the degrade-era drops
+    as a silent mid-stream gap."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    (p0, _), (_, port1) = repl_pair
+    r = ShardRouter(_endpoints(repl_pair), 0, streams=1)
+    r.put("dg.seed", 1)
+    p0.send_signal(signal.SIGKILL)   # shard 1's successor dies
+    p0.wait()
+    k1 = next(f"dg.k.{j}" for j in range(64)
+              if r.shard_of(f"dg.k.{j}") == 1)
+    deadline = time.monotonic() + 10
+    degraded = False
+    while time.monotonic() < deadline and not degraded:
+        r.put(k1, 1)   # traffic so the survivor notices its dead successor
+        degraded = any(st is not None and st["repl_status"] == 2
+                       for _, st in r.server_stats_all())
+        time.sleep(0.05)
+    assert degraded, "survivor never degraded"
+    cl = native.ControlPlaneClient("127.0.0.1", port1, 0, streams=1)
+    assert len(cl.snapshot()) >= 16        # diagnostic unfiltered pull
+    assert len(cl.snapshot(2, 1)) >= 16    # own-keyspace (non-receiver)
+    cl.close()
+    # read stats BEFORE any further write: an erroneous re-arm is only
+    # observable until the next record send re-degrades the stream (the
+    # write it drops in between is exactly the silent gap at stake)
+    for _, st in r.server_stats_all():
+        if st is not None:
+            assert st["repl_status"] == 2, \
+                "a non-receiver snapshot pull re-armed the degraded stream"
+    r.close()
+
+
+def test_repl_newline_key_survives_kill(repl_pair):
+    """Control-plane keys embed user-derived queue/collective names — a
+    '\\n' in one must not corrupt the WAL batch framing (keys ride the
+    record body, length-prefixed): every record in the batch must land
+    on its own key on the replica."""
+    (_, port0), (p1, port1) = repl_pair
+    cl = native.ControlPlaneClient("127.0.0.1", port1, 0, streams=1)
+    nl = "nl.q.job\nevil"
+    cl.put(nl, 77)
+    assert cl.append_bytes(nl + ".box", b"payload-1" * 20) == 1
+    # rides the same replicator batch window as the newline records: a
+    # mis-split would shift these onto the wrong keys
+    cl.put("nl.plain", 88)
+    assert cl.append_bytes("nl.plain.box", b"payload-2" * 20) == 1
+    cl.close()
+    p1.send_signal(signal.SIGKILL)
+    p1.wait()
+    sv = native.ControlPlaneClient("127.0.0.1", port0, 0, streams=1)
+    assert sv.get(nl) == 77
+    assert sv.get("nl.plain") == 88
+    assert [bytes(x) for x in sv.take_bytes(nl + ".box")] == \
+        [b"payload-1" * 20]
+    assert [bytes(x) for x in sv.take_bytes("nl.plain.box")] == \
+        [b"payload-2" * 20]
+    sv.close()
+
+
+def test_repl_failover_primary_sweeps_adopted_keyspace_on_attach():
+    """Incarnation-GC scope under failover: a direct kAttach on a
+    replicating shard must also sweep mailboxes of a keyspace it serves
+    as FAILOVER primary (its preferred shard is dead and will never WAL
+    the sweep) — otherwise a churned client's stale deposits linger and
+    the owner can drain them, exactly what incarnation GC prevents."""
+    s0 = native.ControlPlaneServer(1, _free_port())
+    s1 = native.ControlPlaneServer(1, _free_port())
+    try:
+        s0.set_successor("127.0.0.1", s1.port, 2, 0)
+        s1.set_successor("127.0.0.1", s0.port, 2, 1)
+        from bluefog_tpu.runtime.router import _fnv64
+        box = next(f"fg.box.{j}" for j in range(64)
+                   if _fnv64(f"fg.box.{j}") % 2 == 1)
+        # rank 3 (incarnation 1) registers on BOTH shards — what a
+        # router's per-shard attach does — and deposits into a shard-1
+        # box; chain commit replicates the record to shard 0
+        dep0 = native.ControlPlaneClient("127.0.0.1", s0.port, 3,
+                                         streams=1, incarnation=1)
+        dep1 = native.ControlPlaneClient("127.0.0.1", s1.port, 3,
+                                         streams=1, incarnation=1)
+        dep1.append_bytes_tagged_many(
+            [box], [b"stale-parameters"], [(((3 & 0x7F) << 32) | 1) << 24])
+        assert s0.mailbox_records_from(3) == 1   # the replica copy
+        # shard 1 dies; a router publishes the odd liveness generation
+        s1.stop()
+        cl0 = native.ControlPlaneClient("127.0.0.1", s0.port, 0, streams=1)
+        cl0.put_max("bf.cp.shard_dead.1", 1)
+        # churn: rank 3 restarts and attaches DIRECTLY to the failover
+        # primary — the dead preferred shard can never WAL this sweep
+        fresh = native.ControlPlaneClient("127.0.0.1", s0.port, 3,
+                                          streams=1, incarnation=2)
+        assert s0.mailbox_records_from(3) == 0, \
+            "failover-adopted keyspace kept the dead incarnation's deposits"
+        fresh.close()
+        cl0.close()
+        dep0.close()
+        dep1.close()
+    finally:
+        s1.stop()
+        s0.stop()
+
+
 def test_single_endpoint_plane_r8_semantics_pinned(monkeypatch):
     """Satellite regression pin: an UNSHARDED (single-endpoint) plane
     keeps the r8 lease/force-release behavior byte-identical — no WAL
