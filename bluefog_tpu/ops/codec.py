@@ -325,6 +325,25 @@ def resolve(spec) -> Optional[WireCodec]:
     return None
 
 
+def state_codec_for(codec: Optional[WireCodec]) -> Optional[WireCodec]:
+    """The codec a window publishes its ABSOLUTE state rows under.
+
+    Quantizers publish through themselves (bounded-error dense state).
+    Top-k — whose sparse records cannot carry absolute state — used to
+    publish RAW rows, which made the win_get/pull leg pay full bytes
+    under the one codec that compresses the deposit wire hardest (ISSUE
+    r17 satellite); it now falls back to int8 absolute-state payloads
+    behind the same ``_parse_published`` magic framing (the reader
+    dispatches on the payload's own codec id, so no reader changes).
+    ``None`` (codec off) keeps the raw legacy publish byte-identical.
+    """
+    if codec is None:
+        return None
+    if codec.state_codec:
+        return codec
+    return Int8Codec()
+
+
 def by_id(cid: int) -> WireCodec:
     """Decode-side lookup: the drain learns the codec from the deposit
     header (codec id in the mode byte's high nibble), never from its own
@@ -364,5 +383,5 @@ def quantize_blend(x, cid: int):
 __all__: List[str] = [
     "CODEC_NONE", "CODEC_INT8", "CODEC_FP8", "CODEC_TOPK",
     "WireCodec", "Int8Codec", "Fp8Codec", "TopKCodec",
-    "resolve", "by_id", "quantize_blend",
+    "resolve", "by_id", "state_codec_for", "quantize_blend",
 ]

@@ -10,17 +10,30 @@ parameter leaf — fewer collective launches, full ICI packet utilization.
 ``pack`` flattens a pytree of rank-stacked [n, ...] leaves into a single
 [n, total] buffer (casting to the widest needed dtype); ``unpack`` restores
 the original structure. Both are jit-friendly (static shapes from the spec).
+
+**Shard dimension** (ISSUE r17, docs/sharded_windows.md): a spec built
+with ``shard=ShardSpec`` additionally knows how the leaf list splits into
+``S`` shards (``ops.partition``'s resolved piece table). ``pack_shard``
+extracts ONE shard's pieces into a fixed ``[n, row_len]`` row (zero-padded
+to the largest shard, so one window shape carries every shard in
+rotation); ``scatter_shard`` writes a combined shard row back into the
+full leaves — both compiled per (spec, shard) like pack/unpack. The
+host-side ``pack_row``/``assemble_rows`` mirror the same piece table for
+the one-sided paths (rejoin state transfer, donor reads) that cannot
+dispatch a program. ``shard=None`` keeps every byte of the legacy layout.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, List, NamedTuple, Sequence, Tuple
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from . import partition as _partition
 
 
 class PackSpec(NamedTuple):
@@ -31,9 +44,13 @@ class PackSpec(NamedTuple):
     sizes: Tuple[int, ...]
     total: int
     buffer_dtype: Any
+    # resolved shard partition (ops.partition.ShardSpec) or None — the
+    # default keeps the legacy single-row layout byte for byte
+    shard: Optional[_partition.ShardSpec] = None
 
 
-def make_spec(tree, rank_stacked: bool = True) -> PackSpec:
+def make_spec(tree, rank_stacked: bool = True,
+              shard: Optional[_partition.ShardSpec] = None) -> PackSpec:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     shapes = []
     dtypes = []
@@ -52,7 +69,7 @@ def make_spec(tree, rank_stacked: bool = True) -> PackSpec:
     buffer_dtype = jnp.result_type(*dtypes) if dtypes else jnp.float32
     return PackSpec(
         treedef, tuple(shapes), tuple(dtypes), tuple(offsets), tuple(sizes),
-        off, buffer_dtype,
+        off, buffer_dtype, shard,
     )
 
 
@@ -102,7 +119,8 @@ def unpack_row(row: np.ndarray, spec: PackSpec,
     return out
 
 
-def pack_row(leaf_rows: Sequence, spec: PackSpec, codec=None) -> np.ndarray:
+def pack_row(leaf_rows: Sequence, spec: PackSpec, codec=None,
+             shard: Optional[int] = None) -> np.ndarray:
     """Host-side inverse of :func:`unpack_row`: per-leaf arrays for ONE
     rank -> that rank's flat [total] packed row (buffer dtype).
 
@@ -111,14 +129,151 @@ def pack_row(leaf_rows: Sequence, spec: PackSpec, codec=None) -> np.ndarray:
     compressed gossip wire uses for whole-row host-side transforms
     (docs/compression.md); the deposit hot path in ``ops/windows.py``
     calls the codec on its already-flat rows directly.
+
+    ``shard`` (sharded specs only): pack shard ``shard``'s pieces instead
+    of the whole tree — a flat ``[spec.shard.row_len]`` row, zero-padded
+    past the shard's own total so every shard frames to one window shape.
     """
     bt = np.dtype(spec.buffer_dtype)
+    if shard is not None:
+        sh = spec.shard
+        if sh is None:
+            raise ValueError("pack_row(shard=...) needs a sharded spec "
+                             "(make_spec(..., shard=ShardSpec))")
+        row = np.zeros((sh.row_len,), bt)
+        off = 0
+        for piece in sh.pieces[shard]:
+            i, ax, a, b = piece
+            leaf = np.asarray(leaf_rows[i])
+            part = leaf if ax < 0 else \
+                leaf[(slice(None),) * ax + (slice(a, b),)]
+            flat = np.ascontiguousarray(part).reshape(-1).astype(
+                bt, copy=False)
+            row[off:off + flat.size] = flat
+            off += flat.size
+        if codec is not None:
+            return codec.encode(row)
+        return row
     row = np.concatenate([
         np.asarray(x).reshape(-1).astype(bt) for x in leaf_rows
     ]) if leaf_rows else np.zeros((0,), bt)
     if codec is not None:
         return codec.encode(row)
     return row
+
+
+def assemble_rows(shard_rows: Sequence[np.ndarray], spec: PackSpec,
+                  codec=None) -> List[np.ndarray]:
+    """Reassemble ONE rank's full per-leaf arrays from all S shard rows
+    (each the padded ``[row_len]`` flat row :func:`pack_row` produced —
+    the shape published rows and donor transfers carry). The host-side
+    inverse of the rotation: the rejoin path collects a donor's shards
+    over S gossip steps and rebuilds the tree here, with no compiled
+    dispatch (one-sided, like :func:`unpack_row`)."""
+    sh = spec.shard
+    if sh is None:
+        raise ValueError("assemble_rows needs a sharded spec")
+    if len(shard_rows) != sh.factor:
+        raise ValueError(
+            f"assemble_rows: got {len(shard_rows)} shard rows for a "
+            f"factor-{sh.factor} spec")
+    out = [np.zeros(shape, np.dtype(dt))
+           for shape, dt in zip(spec.shapes, spec.dtypes)]
+    for s in range(sh.factor):
+        row = shard_rows[s]
+        if codec is not None:
+            row = codec.decode(
+                np.asarray(row).reshape(-1).view(np.uint8),
+                np.dtype(spec.buffer_dtype), sh.row_len)
+        row = np.asarray(row).reshape(-1)
+        off = 0
+        for piece in sh.pieces[s]:
+            i, ax, a, b = piece
+            shape = _partition.piece_shape(spec.shapes[i], piece)
+            size = int(np.prod(shape)) if shape else 1
+            part = row[off:off + size].reshape(shape).astype(
+                np.dtype(spec.dtypes[i]))
+            if ax < 0:
+                out[i][...] = part
+            else:
+                out[i][(slice(None),) * ax + (slice(a, b),)] = part
+            off += size
+    return out
+
+
+def pack_shard(tree, spec: PackSpec, shard: int):
+    """Rank-stacked leaves -> this shard's ``[n, row_len]`` padded row
+    (the compiled intra-host "shard" half of the FSDP-style rotation:
+    per-rank slicing only, no cross-rank movement — under a rank-sharded
+    jit this lowers to a per-device gather, exactly the r13 local-mesh
+    discipline)."""
+    sh = spec.shard
+    leaves = jax.tree_util.tree_leaves(tree)
+    n = leaves[0].shape[0] if leaves else 0
+    bt = spec.buffer_dtype
+    flats = []
+    got = 0
+    for piece in sh.pieces[shard]:
+        i, ax, a, b = piece
+        leaf = leaves[i]
+        part = leaf if ax < 0 else jax.lax.slice_in_dim(
+            leaf, a, b, axis=ax + 1)
+        flats.append(part.reshape(n, -1).astype(bt))
+        got += flats[-1].shape[1]
+    pad = sh.row_len - got
+    if pad:
+        flats.append(jnp.zeros((n, pad), bt))
+    return jnp.concatenate(flats, axis=1) if flats else \
+        jnp.zeros((n, sh.row_len), bt)
+
+
+def scatter_shard(leaves: Sequence, buf, spec: PackSpec, shard: int):
+    """The gather half: write a combined ``[n, row_len]`` shard row back
+    into the full rank-stacked leaves (only this shard's pieces change;
+    the pad tail is ignored). Returns the new leaf list."""
+    sh = spec.shard
+    out = list(leaves)
+    n = buf.shape[0]
+    off = 0
+    for piece in sh.pieces[shard]:
+        i, ax, a, b = piece
+        shape = _partition.piece_shape(spec.shapes[i], piece)
+        size = int(np.prod(shape)) if shape else 1
+        chunk = jax.lax.dynamic_slice_in_dim(buf, off, size, axis=1)
+        chunk = chunk.reshape((n,) + shape).astype(out[i].dtype)
+        if ax < 0:
+            out[i] = chunk
+        else:
+            idx = (slice(None),) * (ax + 1) + (slice(a, b),)
+            out[i] = out[i].at[idx].set(chunk)
+        off += size
+    return out
+
+
+@functools.lru_cache(maxsize=512)
+def _pack_shard_compiled(spec: PackSpec, shard: int):
+    return jax.jit(lambda tree: pack_shard(tree, spec, shard))
+
+
+@functools.lru_cache(maxsize=512)
+def _scatter_shard_compiled(spec: PackSpec, shard: int):
+    # Donate the leaves: they are replaced by the outputs, so XLA updates
+    # the touched pieces in place instead of double-buffering the full
+    # model — the whole point of shard-sized gossip memory (the rlimit
+    # acceptance demo fails without it). The shard buffer is NOT donated:
+    # its shape aliases no output, so donation would only warn.
+    return jax.jit(
+        lambda leaves, buf: tuple(scatter_shard(leaves, buf, spec, shard)),
+        donate_argnums=(0,))
+
+
+def pack_shard_jit(tree, spec: PackSpec, shard: int):
+    """``pack_shard`` through a per-(spec, shard) cached jit."""
+    return _pack_shard_compiled(spec, shard)(tree)
+
+
+def scatter_shard_jit(leaves, buf, spec: PackSpec, shard: int):
+    return _scatter_shard_compiled(spec, shard)(tuple(leaves), buf)
 
 
 @functools.lru_cache(maxsize=512)

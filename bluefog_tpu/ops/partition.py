@@ -1,0 +1,231 @@
+"""Partition rules: regex rules → per-leaf shard cuts for sharded windows.
+
+The sharded window plane (ISSUE r17, docs/sharded_windows.md) packs a
+rank's gossip row as ONE SHARD of the parameter tree instead of the full
+tree, so every win-op wire payload, mailbox slot, and published row
+shrinks by the shard factor. This module is the layer that decides HOW a
+pytree splits into ``S`` shards:
+
+* :func:`match_partition_rules` — the SNIPPETS-shape rule matcher: an
+  ordered list of ``(regex, axis_spec)`` pairs applied to ``/``-joined
+  leaf path names; first match wins. ``axis_spec`` is an axis index,
+  ``"largest"`` (shard the leaf's largest axis — the ``auto`` rule), or
+  ``"none"`` (never split this leaf).
+* :func:`build_shard_spec` — resolves the per-leaf decisions into a
+  :class:`ShardSpec`: an explicit, hashable piece table (leaf, axis,
+  start, stop) per shard. Leaves below the size floor (or whose chosen
+  axis is shorter than ``S``) are never cut; they are greedily assigned
+  whole to the lightest shard so shard totals stay balanced.
+
+The spec is resolved ONCE at window creation (the analog of
+``match_partition_rules`` → per-param ``PartitionSpec`` over a named mesh
+in the exemplars) and then rides ``ops.fusion.PackSpec`` — every
+pack/unpack, wire payload, and rejoin reassembly derives from the same
+piece table, so shard boundaries can never drift between controllers
+that resolved the same rules over the same tree.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from ..runtime.logging import logger
+
+# one piece of one shard's packed row: elements [start, stop) of `axis`
+# of leaf `leaf` (axis=-1 ⇒ the whole, uncut leaf)
+Piece = Tuple[int, int, int, int]  # (leaf, axis, start, stop)
+
+
+class ShardSpec(NamedTuple):
+    """Resolved partition of a leaf list into ``factor`` shards.
+
+    ``pieces[s]`` lists shard ``s``'s pieces in leaf order; ``totals[s]``
+    is its element count; ``row_len`` is ``max(totals)`` — the padded
+    length every shard's packed row is framed to, so ONE window (one
+    fixed row shape) carries every shard in rotation. Hashable by
+    construction: it keys the compiled pack/scatter program caches.
+    """
+
+    factor: int
+    pieces: Tuple[Tuple[Piece, ...], ...]
+    totals: Tuple[int, ...]
+    row_len: int
+
+
+def leaf_names(tree) -> List[str]:
+    """``/``-joined path names for the tree's leaves, in flatten order
+    (the names the partition-rule regexes match against)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    out = []
+    for path, _ in flat:
+        parts = []
+        for p in path:
+            key = getattr(p, "key", getattr(p, "name",
+                                            getattr(p, "idx", None)))
+            parts.append(str(key))
+        out.append("/".join(parts) if parts else "")
+    return out
+
+
+def parse_rules(spec: Optional[str]):
+    """``BLUEFOG_WIN_SHARD_RULES`` grammar → ordered ``(regex, axis)``.
+
+    Comma-separated ``regex=axis`` terms; ``axis`` is an integer axis
+    index, ``largest``, or ``none``. A malformed term is skipped with a
+    warning (a typo must degrade to the auto rule, never crash a job at
+    window creation). Empty/None → ``[(".*", "largest")]`` (the auto
+    rule: shard every eligible leaf's largest axis).
+    """
+    if not spec:
+        return [(re.compile(".*"), "largest")]
+    rules = []
+    for term in str(spec).split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "=" not in term:
+            logger.warning(
+                "BLUEFOG_WIN_SHARD_RULES term %r is not regex=axis; "
+                "skipping it", term)
+            continue
+        pat, _, ax = term.rpartition("=")
+        ax = ax.strip().lower()
+        if ax not in ("largest", "none"):
+            try:
+                ax = int(ax)
+            except ValueError:
+                logger.warning(
+                    "BLUEFOG_WIN_SHARD_RULES axis %r is not an integer, "
+                    "'largest', or 'none'; skipping %r", ax, term)
+                continue
+        try:
+            rules.append((re.compile(pat.strip()), ax))
+        except re.error as exc:
+            logger.warning(
+                "BLUEFOG_WIN_SHARD_RULES regex %r does not compile (%s); "
+                "skipping it", pat, exc)
+    rules.append((re.compile(".*"), "largest"))  # auto backstop
+    return rules
+
+
+def match_partition_rules(rules, names: Sequence[str],
+                          shapes: Sequence[Tuple[int, ...]]):
+    """Per-leaf axis decision: first rule whose regex ``search``es the
+    leaf's path name wins (the SNIPPETS ``match_partition_rules`` shape).
+    Returns a list of axis indices (or None for uncut). Scalars are
+    never partitioned."""
+    out: List[Optional[int]] = []
+    for name, shape in zip(names, shapes):
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            out.append(None)
+            continue
+        ax: Optional[int] = None
+        for pat, spec in rules:
+            if pat.search(name) is None:
+                continue
+            if spec == "none":
+                ax = None
+            elif spec == "largest":
+                ax = int(np.argmax(shape))
+            else:
+                ax = int(spec) if -len(shape) <= int(spec) < len(shape) \
+                    else None
+                if ax is not None and ax < 0:
+                    ax += len(shape)
+            break
+        out.append(ax)
+    return out
+
+
+def _split_bounds(dim: int, factor: int) -> List[Tuple[int, int]]:
+    """np.array_split boundaries: ``factor`` contiguous chunks of ``dim``
+    (the first ``dim % factor`` chunks one longer)."""
+    q, r = divmod(dim, factor)
+    bounds = []
+    off = 0
+    for i in range(factor):
+        n = q + (1 if i < r else 0)
+        bounds.append((off, off + n))
+        off += n
+    return bounds
+
+
+def build_shard_spec(shapes: Sequence[Tuple[int, ...]],
+                     dtypes: Sequence,
+                     factor: int,
+                     names: Optional[Sequence[str]] = None,
+                     rules_spec: Optional[str] = None,
+                     floor_bytes: int = 0) -> ShardSpec:
+    """Resolve the partition of a leaf list into ``factor`` shards.
+
+    ``shapes`` are per-leaf shapes WITHOUT the rank dimension (the same
+    convention as ``fusion.PackSpec.shapes``). Leaves smaller than
+    ``floor_bytes`` — or whose chosen axis is shorter than ``factor`` —
+    stay whole and are greedily packed onto the lightest shard, so tiny
+    biases/norm scales never fragment into sub-cacheline wire pieces.
+    """
+    factor = max(1, int(factor))
+    names = list(names) if names is not None else \
+        [str(i) for i in range(len(shapes))]
+    rules = parse_rules(rules_spec)
+    axes = match_partition_rules(rules, names, shapes)
+    pieces: List[List[Piece]] = [[] for _ in range(factor)]
+    totals = np.zeros(factor, np.int64)
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        size = int(np.prod(shape)) if shape else 1
+        ax = axes[i]
+        nbytes = size * np.dtype(dtype).itemsize
+        if factor == 1 or ax is None or nbytes < floor_bytes or \
+                shape[ax] < factor:
+            s = int(np.argmin(totals))
+            pieces[s].append((i, -1, 0, size))
+            totals[s] += size
+            continue
+        per = size // shape[ax]
+        for s, (a, b) in enumerate(_split_bounds(shape[ax], factor)):
+            pieces[s].append((i, ax, a, b))
+            totals[s] += (b - a) * per
+    return ShardSpec(
+        factor,
+        tuple(tuple(p) for p in pieces),
+        tuple(int(t) for t in totals),
+        int(totals.max()) if len(totals) else 0,
+    )
+
+
+def spec_for_tree(tree, factor: int, rules_spec: Optional[str] = None,
+                  floor_bytes: int = 0, rank_stacked: bool = True
+                  ) -> ShardSpec:
+    """:func:`build_shard_spec` over a (rank-stacked) pytree."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    shapes = [tuple(x.shape[1:]) if rank_stacked else tuple(x.shape)
+              for x in leaves]
+    dtypes = [x.dtype for x in leaves]
+    return build_shard_spec(shapes, dtypes, factor,
+                            names=leaf_names(tree),
+                            rules_spec=rules_spec, floor_bytes=floor_bytes)
+
+
+def piece_shape(shape: Tuple[int, ...], piece: Piece) -> Tuple[int, ...]:
+    """The sub-array shape one piece selects out of a leaf of ``shape``."""
+    _, ax, a, b = piece
+    if ax < 0:
+        return shape
+    return shape[:ax] + (b - a,) + shape[ax + 1:]
+
+
+def piece_size(shape: Tuple[int, ...], piece: Piece) -> int:
+    sh = piece_shape(shape, piece)
+    return int(np.prod(sh)) if sh else 1
+
+
+__all__ = [
+    "ShardSpec", "Piece", "leaf_names", "parse_rules",
+    "match_partition_rules", "build_shard_spec", "spec_for_tree",
+    "piece_shape", "piece_size",
+]

@@ -245,6 +245,12 @@ class PlanePlanner:
         self.edges: FrozenSet[Edge] = frozenset(
             (int(s), int(d)) for s, d in edges)
         self.owner_of = dict(owner_of)
+        # One full window row per deposit. Under sharded windows
+        # (docs/sharded_windows.md) the window's row IS the shard row, so
+        # this estimate — and every verdict derived from it — already
+        # operates on shard-sized wire cost; measured attribution hints
+        # are post-codec AND post-shard for the same reason (flow events
+        # record the real payload).
         self.row_bytes = int(row_bytes)
         # Wire codec discount (docs/compression.md): with a codec on the
         # hosted wire, a deposit ships ~codec.nominal_ratio of the row, so

@@ -602,6 +602,16 @@ class Window:
         # while top-k — index records over a dense exchange — does not.
         # None keeps the legacy wire byte-identical (test-pinned).
         self.codec = _wire_codec.resolve(knob_env("BLUEFOG_WIN_CODEC"))
+        # Sharded window plane (ISSUE r17, docs/sharded_windows.md): when
+        # a window carries rotating shard rows, the optimizer binds the
+        # shard factor and advances the active shard index every gossip
+        # step. Deposits then carry the shard index on the wire so an
+        # owner whose rotation drifted from an origin's NEVER folds a
+        # different shard's coordinates into its slots (the value is
+        # dropped with a counter; the exact-mass p contribution still
+        # folds). factor 1 / shard -1 keeps the legacy wire byte-identical.
+        self.shard_factor = 1
+        self.active_shard = -1
         # Error-feedback state (top-k): one acc-dtype row per owned source
         # rank, held next to the fused flat window the optimizers pack
         # (optimizers._WindowOptimizer). `_ef_rows` is the residual/unsent
@@ -726,6 +736,21 @@ class Window:
         # writing controller and state_mu serializes its deposits).
         self._dep_seq = 0
 
+    # -- sharded rotation (ISSUE r17) --------------------------------------
+
+    def bind_shard(self, factor: int, start: int = 0) -> None:
+        """Declare this window's rows as rotating shard rows (the window
+        optimizer calls this once right after win_create)."""
+        self.shard_factor = max(1, int(factor))
+        self.active_shard = int(start) if self.shard_factor > 1 else -1
+        _metrics.gauge("win.shard_factor").set(float(self.shard_factor))
+
+    def set_active_shard(self, shard: int) -> None:
+        """Advance the rotation (called before each sharded gossip step's
+        ops; serialized against the drain by state_mu)."""
+        with self.state_mu:
+            self.active_shard = int(shard) % self.shard_factor
+
     # -- self_value: a property so both planes share the publish contract ---
 
     @property
@@ -753,6 +778,24 @@ class Window:
     def _dep_key(self, dst: int, k: int) -> str:
         return f"w.{self.name}.dep.{dst}.{k}"
 
+    def _sidx_key(self, rank: int) -> str:
+        return f"w.{self.name}.sidx.{rank}"
+
+    def read_published_shard(self, rank: int):
+        """``(row, shard_index)`` of a rank's published tensor on a
+        sharded window (shard_index is None when the owner never
+        published or the window is unsharded). The rejoin reassembly
+        polls this across a donor's gossip steps until it has collected
+        every shard (docs/sharded_windows.md)."""
+        sidx = None
+        if self.shard_factor > 1:
+            try:
+                v = int(_cp.client().get(self._sidx_key(rank)))
+            except (OSError, RuntimeError):
+                v = 0
+            sidx = (v - 1) if v > 0 else None
+        return self.read_published_row(rank), sidx
+
     def _publish_self(self, rank: int) -> None:
         """Refresh rank's 'exposed window' copy on the server (win_get)."""
         self._publish_selves([rank])
@@ -775,15 +818,26 @@ class Window:
         ranks = list(ranks)
         if not ranks:
             return
-        codec = self.codec
-        if codec is not None and codec.state_codec:
+        if self.shard_factor > 1:
+            # rotation index published NEXT TO the rows (one pipelined
+            # put_many): a donor/rejoiner reading a published row must
+            # know WHICH shard's coordinates it carries
+            _cp.client().put_many(
+                [self._sidx_key(r) for r in ranks],
+                [self.active_shard + 1] * len(ranks))
+        # Published-state codec: the configured codec itself for the
+        # quantizers, the int8 absolute-state fallback for top-k (sparse
+        # records cannot carry absolute state — codec.state_codec_for),
+        # raw legacy rows when no codec is configured.
+        pub = _wire_codec.state_codec_for(self.codec)
+        if pub is not None:
             blobs = []
             raw_b = wire_b = 0
             for r in ranks:
-                enc = codec.encode(self._rows[r])
+                enc = pub.encode(self._rows[r])
                 blob = np.empty(_PUB_HDR + enc.nbytes, np.uint8)
                 blob[:_PUB_HDR] = np.frombuffer(
-                    struct.pack("<IBBH", _PUB_MAGIC, codec.cid, 0, 0),
+                    struct.pack("<IBBH", _PUB_MAGIC, pub.cid, 0, 0),
                     np.uint8)
                 blob[_PUB_HDR:] = enc
                 blobs.append(blob)
@@ -970,6 +1024,21 @@ class Window:
         if codec_id:
             wt, expect = struct.unpack_from("<dQ", rec, hdr_end)
             hdr_end += _DEP_EXT
+        shard = -1
+        if raw_mode & _DEP_SHARD_FLAG:
+            shard, = struct.unpack_from("<i", rec, hdr_end)
+            hdr_end += _DEP_SHARD_EXT
+        # Rotation-drift guard: a shard-carrying deposit whose index is
+        # not THIS owner's active shard holds a different subspace's
+        # coordinates — folding it would mix misaligned coordinates. The
+        # value is discarded (the slot keeps its last same-shard content,
+        # i.e. one-rotation-stale — the per-shard analog of the hosted
+        # plane's usual staleness); the exact p mass still folds so
+        # push-sum conservation survives drift. win.shard_stale_drops
+        # counts it: persistent growth means a controller's comm-round
+        # counter drifted (see straggler detection, docs/metrics.md).
+        discard = shard >= 0 and shard != self.active_shard
+        if codec_id or discard:
             staging = np.empty(expect, np.uint8)
             target = staging
         elif mode == _DEP_PUT:
@@ -981,7 +1050,8 @@ class Window:
             target = staging
         pend = _PendingDeposit(mode, has_p, pc, seq, nchunks, target,
                                staging, codec_id=codec_id, wt=wt,
-                               expect=int(expect))
+                               expect=int(expect), shard=shard,
+                               discard=discard)
         # compact single-record form: a header carrying payload inline
         body = rec[hdr_end:]
         if len(body):
@@ -1031,6 +1101,16 @@ class Window:
         fl.rec(_flight.FLOW_F,
                fl.intern(f"drain.{(pend.seq >> 32) & 0x7F}"),
                pend.got, pend.seq)
+        if pend.discard:
+            # rotation drift: value dropped (wrong shard's coordinates),
+            # exact p mass kept — see _start_deposit
+            _metrics.counter("win.shard_stale_drops").inc()
+            if pend.has_p:
+                if pend.mode == _DEP_ACC:
+                    self.host.add_p_mail(pair[0], pair[1], pend.pc)
+                else:
+                    self.host.set_p_mail(pair[0], pair[1], pend.pc)
+            return
         if pend.codec_id:
             # compressed deposit: decode the self-describing payload back
             # to a full wire-dtype row, apply the edge weight the sender
@@ -1764,9 +1844,16 @@ _DEFAULT_MAX_SENT = 16 << 20
 # error-feedback residual per row); the payload itself is the codec's
 # self-describing record (ops/codec.py), so its length differs from the
 # row size and the drain completes it by the header's byte count.
-_DEP_MODE_MASK = 0x0F
+_DEP_MODE_MASK = 0x07
 _DEP_CODEC_SHIFT = 4
 _DEP_EXT = struct.calcsize("<dQ")
+# Sharded-rotation extension (ISSUE r17, docs/sharded_windows.md): bit 3
+# of the mode byte's low nibble flags a shard-carrying deposit; an i32
+# shard index follows the base header (after the codec extension when
+# both ride). The legacy wire never sets the bit (mode byte low nibble is
+# 0/1 there), so unsharded windows stay byte-identical.
+_DEP_SHARD_FLAG = 0x08
+_DEP_SHARD_EXT = struct.calcsize("<i")
 # Published-row ("exposed window") state-codec framing: raw rows have no
 # header (the legacy format, length == row bytes); encoded rows carry
 # u32 magic | u8 codec id | 3 reserved bytes, then the self-describing
@@ -1839,12 +1926,13 @@ class _PendingDeposit:
 
     __slots__ = ("mode", "has_p", "pc", "seq", "nchunks", "cap", "hdr_len",
                  "got", "seen", "staging", "target", "t0", "codec_id", "wt",
-                 "expect")
+                 "expect", "shard", "discard")
 
     def __init__(self, mode: int, has_p: int, pc: float, seq: int,
                  nchunks: int, target: np.ndarray, staging,
                  codec_id: int = 0, wt: float = 1.0,
-                 expect: int = 0) -> None:
+                 expect: int = 0, shard: int = -1,
+                 discard: bool = False) -> None:
         self.mode = mode
         self.has_p = has_p
         self.pc = pc
@@ -1859,6 +1947,8 @@ class _PendingDeposit:
         self.codec_id = codec_id  # wire codec (0 = legacy raw payload)
         self.wt = wt            # receiver-side edge weight (codec wire)
         self.expect = expect    # this deposit's payload byte count
+        self.shard = shard      # rotation index on the wire (-1 = none)
+        self.discard = discard  # shard mismatch: drop value, keep p
         self.t0 = time.monotonic()
 
 
@@ -1898,7 +1988,8 @@ def _max_sent_bytes() -> int:
 
 
 def _pack_deposit(mode: int, has_p: int, pc: float, payload,
-                  codec_id: int = 0, wt: float = 1.0) -> List:
+                  codec_id: int = 0, wt: float = 1.0,
+                  shard: int = -1) -> List:
     """Split one deposit into its wire records: a header record followed by
     bounded payload chunks.
 
@@ -1913,7 +2004,12 @@ def _pack_deposit(mode: int, has_p: int, pc: float, payload,
     ``codec_id``/``wt`` (compressed wire): the codec id joins the mode
     byte's high nibble and the extension header carries the edge weight
     plus the encoded byte count (the drain cannot derive it from the row
-    size). ``codec_id=0`` emits exactly the legacy record layout."""
+    size). ``codec_id=0`` emits exactly the legacy record layout.
+
+    ``shard`` >= 0 (sharded rotation, ISSUE r17): sets the mode byte's
+    shard flag and appends the i32 shard index so the owner's drain can
+    reject a drifted rotation's coordinates (``shard=-1`` emits the
+    legacy layout bit for bit)."""
     cap = _max_sent_bytes()
     if isinstance(payload, np.ndarray):
         # extension dtypes (ml_dtypes bf16/f8) lack the buffer protocol;
@@ -1921,10 +2017,14 @@ def _pack_deposit(mode: int, has_p: int, pc: float, payload,
         payload = payload.reshape(-1).view(np.uint8)
     mv = memoryview(payload).cast("B")
     chunks = [mv[i:i + cap] for i in range(0, mv.nbytes, cap)]
-    hdr = struct.pack("<BBdI", mode | (codec_id << _DEP_CODEC_SHIFT),
-                      has_p, pc, len(chunks))
+    mode_byte = mode | (codec_id << _DEP_CODEC_SHIFT)
+    if shard >= 0:
+        mode_byte |= _DEP_SHARD_FLAG
+    hdr = struct.pack("<BBdI", mode_byte, has_p, pc, len(chunks))
     if codec_id:
         hdr += struct.pack("<dQ", float(wt), mv.nbytes)
+    if shard >= 0:
+        hdr += struct.pack("<i", int(shard))
     return [hdr, *chunks]
 
 
@@ -2202,6 +2302,9 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                 dep_edge_of: List[Tuple[int, int, int]] = []  # per record
                 dep_flows: List[Tuple[Tuple[int, int, int], int]] = []
                 deposited = set()
+                # sharded rotation: every deposit names the active shard
+                # so a drifted owner can reject it (ISSUE r17)
+                dep_shard = win.active_shard if win.shard_factor > 1 else -1
                 fl = _flight.recorder()
                 try:
                     for src in win.owned:
@@ -2246,7 +2349,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                 payload = enc
                                 recs = _pack_deposit(
                                     mode, int(use_p), pc, payload,
-                                    codec_id=win.codec.cid, wt=wt)
+                                    codec_id=win.codec.cid, wt=wt,
+                                    shard=dep_shard)
                                 key = win._dep_key(dst, k)
                             else:
                                 # wire payload stays a live numpy buffer:
@@ -2256,7 +2360,8 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                     (x * np.asarray(wt, acc_t)).astype(
                                         wire_t, copy=False))
                                 recs = _pack_deposit(
-                                    mode, int(use_p), pc, payload)
+                                    mode, int(use_p), pc, payload,
+                                    shard=dep_shard)
                                 key = win._dep_key(dst, k)
                             if dst not in owned:
                                 win._dep_seq += 1
@@ -2289,8 +2394,12 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                             dep_tags = [dep_tags[i] for i in keep]
                             dep_edge_of = [dep_edge_of[i] for i in keep]
                     if dep_names:
-                        with fl.span("win.wire",
-                                     a=sum(_blen(b) for b in dep_blobs)):
+                        wire_out = sum(_blen(b) for b in dep_blobs)
+                        # per-step win-op wire bytes, counter-delta-
+                        # verified by win_microbench's sharded probe (the
+                        # shard factor's ≥0.9·S reduction claim)
+                        _metrics.counter("win.deposit_bytes").inc(wire_out)
+                        with fl.span("win.wire", a=wire_out):
                             replies = _cp.client().append_bytes_tagged_many(
                                 dep_names, dep_blobs, dep_tags)
                         # backstop only: the pre-check above keeps the

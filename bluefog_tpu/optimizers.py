@@ -644,6 +644,29 @@ class _WindowOptimizer(_FusedOptimizer):
         self._rows_epoch: Optional[int] = None
         self._rows_sync_count = 0
         self._last_row_value = None
+        # Sharded rotation state (ISSUE r17): factor resolved in init()
+        # (needs _fused_pack); _comm_rounds drives the active shard —
+        # every controller advances it on the same comm cadence, so the
+        # rotation stays aligned as long as step counters do (drift is
+        # caught by the wire's shard guard + straggler detection).
+        self._shard_factor = 1
+        self._comm_rounds = 0
+        self._rejoin_shards: Dict[Tuple[str, int], Dict[int, Any]] = {}
+
+    def _resolve_shard_factor(self) -> int:
+        S = int(knob_env("BLUEFOG_WIN_SHARD") or 1)
+        if S <= 1:
+            return 1
+        if not self._fused_pack:
+            logger.warning(
+                "BLUEFOG_WIN_SHARD=%d needs the fused window "
+                "(BLUEFOG_FUSION_THRESHOLD > 0 packs the tree into one "
+                "flat row the partition can cut); running unsharded", S)
+            return 1
+        return S
+
+    def _active_shard(self) -> int:
+        return self._comm_rounds % self._shard_factor
 
     def init(self, params, model_state=None) -> TrainState:
         state = super().init(params, model_state)
@@ -656,17 +679,42 @@ class _WindowOptimizer(_FusedOptimizer):
             self._groups = [list(range(len(leaves)))]
         else:
             self._groups = [[i] for i in range(len(leaves))]
+        self._fused_pack = len(self._groups) == 1
+        # Sharded window rows (ISSUE r17, docs/sharded_windows.md):
+        # BLUEFOG_WIN_SHARD=S rotates the gossip wire over S shards of
+        # the param tree — the window's row, mailbox slots, deposits and
+        # published copies are all shard-sized (≈1/S of the tree), and
+        # each gossip step ships only the active shard. Partition rules
+        # (BLUEFOG_WIN_SHARD_RULES, ops/partition.py) pick each leaf's
+        # shard axis; resolved ONCE here into the PackSpec every pack,
+        # wire payload, and rejoin reassembly derives from.
+        self._shard_factor = self._resolve_shard_factor()
+        self._comm_rounds = 0
+        shard_part = None
+        if self._shard_factor > 1:
+            from .ops import partition as _partition
+
+            floor_kb = knob_env("BLUEFOG_WIN_SHARD_FLOOR_KB") or 0.0
+            shard_part = _partition.spec_for_tree(
+                state.params, self._shard_factor,
+                rules_spec=knob_env("BLUEFOG_WIN_SHARD_RULES"),
+                floor_bytes=int(float(floor_kb) * 1024))
         self._specs = [
-            _fusion.make_spec([leaves[i] for i in idxs])
+            _fusion.make_spec([leaves[i] for i in idxs], shard=shard_part)
             for idxs in self._groups
         ]
-        self._fused_pack = len(self._groups) == 1
         self._win_names = [
             f"{self._prefix}.{gi}" for gi in range(len(self._groups))]
         for nm, idxs, spec in zip(self._win_names, self._groups, self._specs):
-            packed = _fusion.pack_jit([leaves[i] for i in idxs], spec)
+            if self._shard_factor > 1:
+                packed = _fusion.pack_shard_jit(
+                    [leaves[i] for i in idxs], spec, 0)
+            else:
+                packed = _fusion.pack_jit([leaves[i] for i in idxs], spec)
             if not _windows.win_create(packed, nm, zero_init=self._zero_init):
                 raise RuntimeError(f"window {nm} already exists")
+            if self._shard_factor > 1:
+                _windows._get_window(nm).bind_shard(self._shard_factor)
         from .runtime import heartbeat as _hb
 
         if _hb.quarantine_pending():
@@ -829,6 +877,8 @@ class _WindowOptimizer(_FusedOptimizer):
         win_update publish cannot tear the read."""
         from .runtime.native import PeerLostError
 
+        if self._shard_factor > 1:
+            return self._transfer_rank_sharded(rank, donor, deadline)
         rows = []
         for nm in self._win_names:
             win = _windows._get_window(nm)
@@ -843,6 +893,48 @@ class _WindowOptimizer(_FusedOptimizer):
         for nm, row in zip(self._win_names, rows):
             _windows._get_window(nm).install_row(rank, row)
         return True
+
+    def _transfer_rank_sharded(self, rank: int, donor: int,
+                               deadline: float) -> bool:
+        """Sharded rejoin reassembly (ISSUE r17): the donor's published
+        row carries only its CURRENT shard, and its rotation advances one
+        shard per gossip step — so the rejoiner polls the donor across
+        its steps, collecting each shard index exactly once, until all S
+        shards of the tree are in hand (``fusion.assemble_rows`` rebuilds
+        the full leaves in ``_adopt_window_rows``). A stalled donor
+        (never stepping, so never rotating) times out into the next
+        candidate / the checkpoint fallback like any other failed
+        transfer."""
+        from .runtime.native import PeerLostError
+
+        ok = True
+        for nm in self._win_names:
+            win = _windows._get_window(nm)
+            got = self._rejoin_shards.setdefault((nm, rank), {})
+            while len(got) < self._shard_factor and \
+                    time.monotonic() < deadline:
+                try:
+                    with _windows.win_mutex(nm, ranks=[donor]):
+                        row, sidx = win.read_published_shard(donor)
+                except (PeerLostError, OSError):
+                    return False
+                if row is not None and sidx is not None and sidx not in got:
+                    got[int(sidx)] = np.array(row)
+                    continue  # a new shard may already be up — re-read now
+                time.sleep(0.05)
+            if len(got) < self._shard_factor:
+                ok = False
+                break
+        if ok:
+            # keep the window's published copy fresh for the shard it is
+            # currently rotated to (the first put re-publishes anyway)
+            for nm in self._win_names:
+                win = _windows._get_window(nm)
+                cur = self._rejoin_shards[(nm, rank)].get(
+                    max(win.active_shard, 0))
+                if cur is not None and rank in win.owned:
+                    win.install_row(rank, cur)
+        return ok
 
     def _rejoin_state_transfer(self, state: TrainState) -> TrainState:
         st = _global_state()
@@ -901,9 +993,19 @@ class _WindowOptimizer(_FusedOptimizer):
         for nm, idxs, spec in zip(self._win_names, self._groups,
                                   self._specs):
             win = _windows._get_window(nm)
-            rows = {r: _fusion.unpack_row(self._window_row_to_params(win, r),
-                                          spec)
-                    for r in win.owned}
+            if self._shard_factor > 1:
+                # reassemble the full per-leaf arrays from the S shard
+                # rows the sharded transfer collected (host-side, no
+                # compiled dispatch — the one-sided rejoin contract)
+                rows = {}
+                for r in win.owned:
+                    got = self._rejoin_shards.get((nm, r), {})
+                    rows[r] = _fusion.assemble_rows(
+                        [got[s] for s in range(self._shard_factor)], spec)
+            else:
+                rows = {r: _fusion.unpack_row(
+                            self._window_row_to_params(win, r), spec)
+                        for r in win.owned}
             for j, i in enumerate(idxs):
                 leaf = leaves[i]
                 shape = tuple(leaf.shape)
@@ -954,9 +1056,14 @@ class _WindowOptimizer(_FusedOptimizer):
             win = _windows._get_window(nm)
             per_leaf_rows = [_windows._owned_rows(leaves[i], win.owned)
                              for i in idxs]
+            # sharded windows hold shard-sized rows: reseed the shard the
+            # window is currently rotated to (the next put refreshes it)
+            shard = max(win.active_shard, 0) if self._shard_factor > 1 \
+                else None
             for r in win.owned:
                 win.install_row(r, _fusion.pack_row(
-                    [rows[r] for rows in per_leaf_rows], spec))
+                    [rows[r] for rows in per_leaf_rows], spec,
+                    shard=shard))
 
     def _dead_ranks(self) -> set:
         """Mesh ranks hosted by dead controllers, consulted EVERY gossip
@@ -1038,12 +1145,26 @@ class _WindowOptimizer(_FusedOptimizer):
             # tried and measured ~45 ms SLOWER at MLP scale on the CPU
             # mesh: the in-program concat defeats the donated in-place
             # optimizer update.)
+            shard = -1
             with timeline_context(self.name, "PACK"), \
                     _metrics.timed("opt.pack_sec"), fl.span("opt.pack"):
-                packed = [
-                    _fusion.pack_jit([leaves[i] for i in idxs], spec)
-                    for idxs, spec in zip(self._groups, self._specs)
-                ]
+                if self._shard_factor > 1:
+                    # rotate: pack ONLY the active shard's pieces — the
+                    # window row, every deposit, and the published copy
+                    # this step are shard-sized (1/S of the tree)
+                    shard = self._active_shard()
+                    _windows._get_window(
+                        self._win_names[0]).set_active_shard(shard)
+                    packed = [
+                        _fusion.pack_shard_jit(
+                            [leaves[i] for i in idxs], spec, shard)
+                        for idxs, spec in zip(self._groups, self._specs)
+                    ]
+                else:
+                    packed = [
+                        _fusion.pack_jit([leaves[i] for i in idxs], spec)
+                        for idxs, spec in zip(self._groups, self._specs)
+                    ]
             with _metrics.timed("opt.gossip_sec"), fl.span("opt.gossip"):
                 if self._fused_pack:
                     # Single window: one mutex acquisition spans the whole
@@ -1075,8 +1196,19 @@ class _WindowOptimizer(_FusedOptimizer):
                 out = list(leaves)
                 for idxs, spec, buf in zip(self._groups, self._specs,
                                            mixed):
-                    for i, v in zip(idxs, _fusion.unpack_jit(buf, spec)):
-                        out[i] = v
+                    if shard >= 0:
+                        # scatter the combined shard back into the full
+                        # leaves: only this shard's pieces change
+                        group = [out[i] for i in idxs]
+                        for i, v in zip(idxs, _fusion.scatter_shard_jit(
+                                group, buf, spec, shard)):
+                            out[i] = v
+                    else:
+                        for i, v in zip(idxs,
+                                        _fusion.unpack_jit(buf, spec)):
+                            out[i] = v
+                if shard >= 0:
+                    self._comm_rounds += 1
             params = jax.tree_util.tree_unflatten(self._treedef, out)
             state = TrainState(params, state.opt_state, state.model_state)
         return state, metrics
@@ -1516,6 +1648,15 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         return (win._rows[rank].astype(np.float64) / p).astype(win.dtype)
 
     def _transfer_rank(self, rank: int, donor: int, deadline: float) -> bool:
+        if self._shard_factor > 1:
+            # A donor's mass split halves its p AND its numerator row,
+            # but a sharded window row is only the ACTIVE shard's
+            # numerator — splitting it would de-bias the other S-1
+            # shards' implicit numerators without transferring them.
+            # Sharded push-sum rejoin therefore skips the donor path and
+            # falls back to the checkpoint re-mint (conservation caveat
+            # logged there; docs/sharded_windows.md).
+            return False
         cl = _cp.client()
         for nm in self._win_names:
             cl.put(f"w.{nm}.msreq.{rank}", donor + 1)

@@ -1629,6 +1629,15 @@ struct ControlServer {
           // values — the pointer swap is atomic under the lock).
           auto val = std::make_shared<const std::string>(data, dlen);
           std::lock_guard<std::mutex> lk(mu);
+          // WAL the value: published window rows live in bytes_kv, and
+          // before this record class a shard death lost them until the
+          // owner's next publish (ROADMAP "replicating published window
+          // rows"). One payload copy — the same replication-factor-2
+          // cost the mailbox pays.
+          repl_wait = ReplEnqueueLocked(kPutBytes, key,
+                                        static_cast<int64_t>(dlen), 1,
+                                        std::string(data, dlen), rank,
+                                        0, 0, 0, false);
           bytes_kv[key] = std::move(val);
           reply = 1;
           break;
@@ -1666,6 +1675,9 @@ struct ControlServer {
           if (total == 0) {
             std::lock_guard<std::mutex> lk(mu);
             bytes_kv[key] = std::make_shared<const std::string>();
+            repl_wait = ReplEnqueueLocked(kPutBytes, key, 0, 1,
+                                          std::string(), rank,
+                                          0, 0, 0, false);
             reply = 1;
             break;
           }
@@ -1686,8 +1698,15 @@ struct ControlServer {
             if (it != put_staging.end()) {
               it->second.got += static_cast<int64_t>(dlen);
               if (it->second.got >= static_cast<int64_t>(total)) {
-                bytes_kv[key] = std::make_shared<const std::string>(
+                auto val = std::make_shared<const std::string>(
                     std::move(it->second.buf));
+                // WAL the ASSEMBLED value once, at the stripe that
+                // completed it (the same visibility point readers get):
+                // a striped publish replicates as one kPutBytes record
+                repl_wait = ReplEnqueueLocked(
+                    kPutBytes, key, static_cast<int64_t>(val->size()), 1,
+                    *val, rank, 0, 0, 0, false);
+                bytes_kv[key] = std::move(val);
                 put_staging.erase(it);
               }
             }
@@ -1886,6 +1905,13 @@ struct ControlServer {
               }
               break;
             }
+            case kPutBytes:
+              // published window rows (and any raw byte value): the
+              // replica adopts the whole value — failover serves
+              // win_get/rejoin reads with no re-derivation gap
+              bytes_kv[rkey] =
+                  std::make_shared<const std::string>(pay, pn);
+              break;
             case kAttach:  // pseudo-record: incarnation GC at this point
               GcIncarnationLocked(static_cast<int>(oarg), true);
               break;
@@ -1976,6 +2002,11 @@ struct ControlServer {
                 put_rec(2, it.first, it.second.rank, nullptr, 0);
             for (const auto& it : incarnations)
               put_rec(3, std::to_string(it.first), it.second, nullptr, 0);
+            for (const auto& it : bytes_kv)
+              if (it.second && want(it.first))
+                put_rec(4, it.first,
+                        static_cast<int64_t>(it.second->size()),
+                        it.second->data(), it.second->size());
             // Re-arm OUR degraded outgoing stream ONLY when the requester
             // declares itself that stream's receiver (the rejoin pull of
             // OUR keyspace by our ring successor): it loads this very
@@ -3111,6 +3142,10 @@ long long bf_cp_server_load_snapshot(void* h, const void* data,
       }
       case 3:
         srv->incarnations[std::atoi(key.c_str())] = a;
+        break;
+      case 4:  // raw byte values (published window rows ride here)
+        srv->bytes_kv[key] =
+            std::make_shared<const std::string>(p + off, pl);
         break;
       default:
         break;  // forward compatibility: skip unknown record types

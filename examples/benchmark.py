@@ -34,7 +34,8 @@ import bluefog_tpu as bf
 def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet34", "resnet18", "vgg16", "mlp"])
+                   choices=["resnet50", "resnet34", "resnet18", "vgg16",
+                            "mlp", "lm"])
     p.add_argument("--batch-size", type=int, default=64,
                    help="per-chip batch size")
     p.add_argument("--num-warmup-batches", type=int, default=10)
@@ -57,6 +58,15 @@ def make_model(args):
         model = bf.models.MLP(features=(512, 512, 10))
         sample = jnp.zeros((args.batch_size, 32, 32, 3), jnp.float32)
         classes = 10
+    elif args.model == "lm":
+        # LM-shaped param tree — embedding + attention-block + norm
+        # leaves — the fixture the sharded-window partition rules are
+        # exercised on (opt_matrix_bench --sharded, ISSUE r17)
+        model = bf.models.TransformerLM(
+            vocab_size=512, num_layers=2, num_heads=4, d_model=128,
+            d_ff=512)
+        sample = jnp.zeros((args.batch_size, 32), jnp.int32)
+        classes = 512
     else:
         cls = {"resnet50": bf.models.ResNet50, "resnet34": bf.models.ResNet34,
                "resnet18": bf.models.ResNet18, "vgg16": bf.models.VGG16}[args.model]
@@ -73,8 +83,10 @@ def main():
     n = bf.size()
     model, sample, classes = make_model(args)
     rng = jax.random.PRNGKey(0)
-    has_bn = args.model != "mlp"
-    variables = model.init(rng, sample, train=True)
+    is_lm = args.model == "lm"
+    has_bn = args.model not in ("mlp", "lm")
+    variables = model.init(rng, sample) if is_lm else \
+        model.init(rng, sample, train=True)
 
     if has_bn:
         # Dropout-bearing models (vgg16) train with their standard dropout
@@ -95,6 +107,13 @@ def main():
                 logits, labels).mean()
             return loss, (updates["batch_stats"], {})
         kw = {"with_model_state": True}
+    elif is_lm:
+        def loss_fn(p, batch):
+            tokens, labels = batch
+            logits = model.apply({"params": p}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+        kw = {}
     else:
         def loss_fn(p, batch):
             images, labels = batch
@@ -124,12 +143,22 @@ def main():
         variables["params"],
         model_state=variables.get("batch_stats") if has_bn else None)
 
-    images = jax.device_put(
-        np.random.RandomState(0).randn(
-            n, *sample.shape).astype(np.float32),
-        bf.rank_sharding(bf.mesh()))
-    labels = jax.device_put(
-        jnp.zeros((n, args.batch_size), jnp.int32), bf.rank_sharding(bf.mesh()))
+    if is_lm:
+        images = jax.device_put(
+            np.random.RandomState(0).randint(
+                0, classes, size=(n, *sample.shape)).astype(np.int32),
+            bf.rank_sharding(bf.mesh()))
+        labels = jax.device_put(
+            jnp.zeros((n, *sample.shape), jnp.int32),
+            bf.rank_sharding(bf.mesh()))
+    else:
+        images = jax.device_put(
+            np.random.RandomState(0).randn(
+                n, *sample.shape).astype(np.float32),
+            bf.rank_sharding(bf.mesh()))
+        labels = jax.device_put(
+            jnp.zeros((n, args.batch_size), jnp.int32),
+            bf.rank_sharding(bf.mesh()))
     batch = (images, labels)
 
     dynamic = (not args.disable_dynamic_topology and

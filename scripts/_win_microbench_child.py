@@ -338,6 +338,72 @@ def main() -> None:
             finally:
                 os.environ.pop("BLUEFOG_WIN_CODEC", None)
 
+    # -- sharded-window sweep (--sharded, ISSUE r17): replay win_put /
+    # win_update on shard-row-sized windows and COUNTER-DELTA-VERIFY the
+    # wire-byte claim — shard factor S cuts per-op deposit bytes by
+    # ≥ 0.9·S (win.deposit_bytes counts exactly the bytes handed to the
+    # server wire, headers included, per controller). mbps rows report
+    # the shard row's payload rate at the same op shape as the full
+    # window's series above (docs/sharded_windows.md).
+    factors = [int(f) for f in os.environ.get("BLUEFOG_WB_SHARD",
+                                              "").split(",") if f]
+    if factors:
+        from bluefog_tpu.ops import windows as _win_ops
+        from bluefog_tpu.runtime import metrics as _metrics2
+
+        tag, dtype, elems, rounds = CONFIGS[0]
+
+        def dep_bytes():
+            return _metrics2.snapshot().get("counters", {}).get(
+                "win.deposit_bytes", 0.0)
+
+        per_op: dict = {}
+        for S in [1] + factors:
+            rl = -(-elems // S)
+            xs = np.zeros((N, rl), dtype)
+            xs[:] = np.arange(N, dtype=np.float32)[:, None].astype(dtype)
+            name = f"wb.sh.{S}"
+            assert bf.win_create(xs, name, zero_init=True)
+            win = _win_ops._get_window(name)
+            if S > 1:
+                win.bind_shard(S)
+            barrier()
+            nops = WARMUP + rounds
+            ts = []
+            b0 = dep_bytes()
+            for r in range(nops):
+                barrier()
+                t0 = time.perf_counter()
+                bf.win_put(xs, name)
+                if r >= WARMUP:
+                    ts.append(time.perf_counter() - t0)
+                barrier()
+                bf.win_update(name)
+                if S > 1:
+                    win.set_active_shard((r + 1) % S)  # rotate like the
+                    # optimizer's comm-round schedule
+            per_op[S] = (dep_bytes() - b0) / nops
+            row_b = rl * np.dtype(dtype).itemsize
+            report(cl, pid, tag, f"sharded_s{S}.win_put", ts, 3 * row_b)
+            if pid == 0:
+                print(json.dumps({
+                    "config": tag, "op": "win_put", "sharded": S,
+                    "wire_bytes_per_op": round(per_op[S], 1)}), flush=True)
+            barrier()
+            bf.win_free(name)
+        if pid == 0:
+            for S in factors:
+                red = per_op[1] / per_op[S] if per_op[S] else 0.0
+                ok = red >= 0.9 * S
+                print(json.dumps({
+                    "config": tag, "op": "shard_wire_reduction",
+                    "sharded": S, "reduction_x": round(red, 2),
+                    "bar": round(0.9 * S, 2), "ok": bool(ok)}), flush=True)
+                assert ok, (
+                    f"shard factor {S} cut win-op wire bytes only "
+                    f"{red:.2f}x (< 0.9*S): {per_op[1]:.0f} -> "
+                    f"{per_op[S]:.0f} B/op")
+
     bf.shutdown()
     if pid == 0:
         print("WIN_MICROBENCH_OK", flush=True)

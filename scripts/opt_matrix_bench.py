@@ -22,6 +22,16 @@ Usage:  python scripts/opt_matrix_bench.py [--chip] [--quick] [--modes ...]
            the acceptance bar is >= 1.5x. Then replays the plane
            equivalence suite (tests/test_win_planes.py) so the speedup and
            the bit-exactness/mass-conservation proofs come from one run.
+  --sharded: sweep BLUEFOG_WIN_SHARD x BLUEFOG_WIN_CODEC (SHARD_SWEEP)
+           over the win_put optimizer on the world-1 hosted harness with
+           the LM-shaped model (--model lm: embedding + attention-block +
+           norm leaves), so the partition rules are exercised on
+           realistic shapes. NOTE the world-1 harness has no
+           cross-controller wire, so `speedup_vs_s1` < 1 isolates the
+           HOST-SIDE rotation cost (pack/scatter + smaller-buffer op
+           overhead); the wire win itself is win_microbench --sharded's
+           counter-delta-verified 4-process measurement
+           (docs/sharded_windows.md).
   --codec: sweep BLUEFOG_WIN_CODEC (none, int8, fp8, topk:0.01) over the
            win_put optimizer on the same world-1 hosted-window harness
            (plane pinned to `hosted`). NOTE the world-1 harness has no
@@ -83,6 +93,13 @@ HYBRID_SWEEP = [("hosted", "0"), ("auto", "0"), ("auto", "1")]
 
 # wire-codec sweep on the forced-hosted harness; "none" is the baseline
 CODEC_SWEEP = ["none", "int8", "fp8", "topk:0.01"]
+
+# sharded-window sweep (ISSUE r17): shard factor x codec, on the
+# LM-shaped param tree fixture (examples/benchmark.py --model lm:
+# embedding + attention-block + norm leaves) so the partition rules are
+# exercised on realistic shapes; S=1 is the per-codec baseline
+SHARD_SWEEP = [(1, "none"), (2, "none"), (4, "none"),
+               (1, "int8"), (4, "int8")]
 
 
 def _free_port() -> int:
@@ -202,6 +219,62 @@ def run_codecs(modes, quick: bool) -> int:
     return rc
 
 
+def run_sharded_mode(mode: str, shard: int, codec: str,
+                     quick: bool = False) -> dict:
+    """One benchmark child on the world-1 hosted-window harness with the
+    shard factor (and optionally the wire codec) pinned, over the
+    LM-shaped model so the partition rules cut realistic leaves
+    (embedding rows, qkv/mlp matrices, whole norm scales)."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        BLUEFOG_CP_HOST="127.0.0.1", BLUEFOG_CP_PORT=str(_free_port()),
+        BLUEFOG_CP_WORLD="1", BLUEFOG_CP_RANK="0",
+        BLUEFOG_WIN_PLANE="hosted")
+    if shard > 1:
+        env["BLUEFOG_WIN_SHARD"] = str(shard)
+    else:
+        env.pop("BLUEFOG_WIN_SHARD", None)
+    if codec != "none":
+        env["BLUEFOG_WIN_CODEC"] = codec
+    else:
+        env.pop("BLUEFOG_WIN_CODEC", None)
+    env.pop("BLUEFOG_CP_FAULT", None)  # never bench under fault injection
+    cmd = [sys.executable, "-m", "bluefog_tpu.launcher",
+           "--simulate", "8", "--"]
+    reps = ("1", "2", "1") if quick else ("3", "5", "3")
+    cmd += [sys.executable, str(REPO / "examples" / "benchmark.py"),
+            "--model", "lm", "--batch-size", "8",
+            "--num-warmup-batches", reps[0], "--num-batches-per-iter",
+            reps[1], "--num-iters", reps[2], "--dist-optimizer", mode,
+            "--disable-dynamic-topology"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
+                       cwd=REPO, env=env)
+    m = RATE_RE.search(r.stdout)
+    base = {"mode": mode, "shard": shard, "codec": codec}
+    if r.returncode != 0 or not m:
+        return {**base, "error": (r.stdout + r.stderr)[-500:]}
+    return {**base, "img_per_sec": float(m.group(1)),
+            "ci": float(m.group(2))}
+
+
+def run_sharded(modes, quick: bool) -> int:
+    rc = 0
+    for mode in modes:
+        baselines = {}
+        for shard, codec in SHARD_SWEEP:
+            res = run_sharded_mode(mode, shard, codec, quick=quick)
+            res["where"] = "cpu-mesh-8dev-lm-b8-cp1-hosted-win"
+            if "error" in res:
+                rc = 1
+            elif shard == 1:
+                baselines[codec] = res["img_per_sec"]
+            elif baselines.get(codec):
+                res["speedup_vs_s1"] = round(
+                    res["img_per_sec"] / baselines[codec], 2)
+            print(json.dumps(res), flush=True)
+    return rc
+
+
 def run_chip_mode(mode: str) -> dict:
     cmd = [sys.executable, str(REPO / "examples" / "benchmark.py"),
            "--model", "resnet50", "--batch-size", "64",
@@ -222,9 +295,12 @@ def main() -> int:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--hybrid", action="store_true")
     ap.add_argument("--codec", action="store_true")
+    ap.add_argument("--sharded", action="store_true")
     ap.add_argument("--modes", nargs="*", default=None)
     args = ap.parse_args()
     rc = 0
+    if args.sharded:
+        return run_sharded(args.modes or ["win_put"], quick=args.quick)
     if args.codec:
         return run_codecs(args.modes or ["win_put"], quick=args.quick)
     if args.hybrid:

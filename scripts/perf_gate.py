@@ -16,9 +16,10 @@ Only the *stable* quick-mode series gate: the hosted window ops
 (win_put / win_accumulate / win_update / win_get MB/s), the optimizer
 step rates, and — since r15, after two stable rounds per the
 stable-series rule — the ``hybrid.*`` plane-sweep rates. Sub-millisecond
-raw-socket probes and the new ``codec.*`` compressed-wire series are
-reported in the JSON but never gate (codec.* graduates the same way
-hybrid.* did once it shows two stable rounds).
+raw-socket probes, the ``codec.*`` compressed-wire series, and the
+``sharded.*`` sharded-window series are reported in the JSON but never
+gate (each graduates the same way hybrid.* did once it shows two stable
+rounds).
 
 Exit codes: 0 pass, 1 regression (or a bench failed), 2 usage/baseline
 problems.
@@ -72,13 +73,28 @@ def collect_once() -> dict:
     # the --codec sweep rides the SAME 4-process run (extra rows after the
     # plain series, which stay untouched): `codec.*` series are info-only
     # per the stable-series rule (see gating())
+    # the --codec and --sharded sweeps ride the SAME 4-process run (extra
+    # rows after the plain series, which stay untouched): `codec.*` and
+    # `sharded.*` series are info-only per the stable-series rule (see
+    # gating()); the sharded run also counter-delta ASSERTS the ≥0.9·S
+    # wire-byte reduction inside the child — a broken claim fails the run
     text = _run([sys.executable, "scripts/win_microbench.py", "--quick",
-                 "--codec", "int8,topk:0.01"], timeout=900)
+                 "--codec", "int8,topk:0.01", "--sharded", "2,4"],
+                timeout=900)
     for line in text.splitlines():
         line = line.strip()
         if not line.startswith("{"):
             continue
         row = json.loads(line)
+        if row.get("sharded") is not None or \
+                str(row.get("op", "")).startswith("sharded_"):
+            if row.get("mbps") is not None:
+                out[f"sharded.{row['config']}.{row['op']}.mbps"] = \
+                    row["mbps"]
+            elif row.get("reduction_x") is not None:
+                out[f"sharded.{row['config']}.s{row['sharded']}"
+                    ".wire_reduction_x"] = row["reduction_x"]
+            continue
         if row.get("codec"):
             if row.get("mbps") is not None:
                 out[f"codec.{row['codec']}.{row['config']}.{row['op']}"
@@ -142,11 +158,11 @@ def collect(repeats: int) -> dict:
 def gating(metrics: dict) -> dict:
     keep = {}
     for name, v in metrics.items():
-        if name.startswith("codec."):
-            # r15 compressed-wire series: info-only until two stable
-            # rounds (the gate's stable-series rule) — then delete this
-            # branch and refresh the baseline, exactly as the hybrid.*
-            # series graduated in r15
+        if name.startswith("codec.") or name.startswith("sharded."):
+            # r15 compressed-wire and r17 sharded-window series:
+            # info-only until two stable rounds (the gate's stable-series
+            # rule) — then delete this branch and refresh the baseline,
+            # exactly as the hybrid.* series graduated in r15
             continue
         if name.startswith("opt.") or name.startswith("hybrid.") or \
                 any(name.endswith(f"{op}.mbps") or f".{op}." in name
@@ -190,7 +206,7 @@ def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
             "repeats": repeats,
             "band": band,
             "harnesses": ["win_microbench --quick --codec int8,topk:0.01 "
-                          "(codec.* info-only)",
+                          "--sharded 2,4 (codec.*/sharded.* info-only)",
                           "opt_matrix_bench --quick --modes "
                           + " ".join(_OPT_MODES),
                           "opt_matrix_bench --quick --hybrid"],

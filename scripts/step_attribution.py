@@ -139,12 +139,23 @@ def main(argv=None) -> int:
               "(did the job run a window optimizer?)", file=sys.stderr)
         return 1
     pairs = flow_pairs(docs)
+    # sharded-window rotation (ISSUE r17): the win.shard_factor gauge
+    # rides every dump's metrics snapshot; surfacing it here keeps the
+    # per-edge byte totals honest — shard-sized flow events ARE the real
+    # wire cost, and a consumer (plan.load_attribution overrides) can
+    # tell a 1/S-sized edge from a small model. Additive, schema-stable
+    # field: schema_version stays 1 and absent means unsharded.
+    shard_factor = {
+        str(r): int(doc.get("metrics", {}).get("gauges", {}).get(
+            "win.shard_factor", 1) or 1)
+        for r, doc in docs.items()}
     if args.json:
         # --json is a MACHINE interface now: the per-edge plane planner
         # consumes it (bluefog_tpu.ops.plan.load_attribution). The literal
         # must match plan.ATTRIBUTION_SCHEMA_VERSION — kept inline so this
         # script stays importable without jax; a test pins the pair.
         print(json.dumps({"schema_version": 1,
+                          "shard_factor": shard_factor,
                           "ranks": {str(r): rep
                                     for r, rep in reports.items()},
                           "flow_pairs": {e: {**d, "transit_us":
@@ -153,6 +164,10 @@ def main(argv=None) -> int:
         return 0
     for rank, rep in reports.items():
         print(f"== rank {rank} ==")
+        sf = shard_factor.get(str(rank), 1)
+        if sf > 1:
+            print(f"  sharded window rotation: factor {sf} "
+                  "(per-edge bytes below are shard-sized)")
         print(flight.format_report(rep))
         # the critical path: the dominant attributed phase and edge
         dom_phase = max(rep["phases"], key=lambda p: rep["phases"][p])

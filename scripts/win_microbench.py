@@ -20,6 +20,7 @@ bytes timed as (a) the socket take alone and (b) the numpy fold alone, so
 the drain pipeline's overlap headroom is a measured number, not a guess.
 
 Usage:  python scripts/win_microbench.py [--quick] [--codec LIST]
+                                         [--sharded LIST]
   --quick: tiny windows, 2 rounds, 1 warmup — seconds instead of minutes;
            exercised by the CI smoke test (tests/test_benchmark_smoke.py),
            numbers are NOT meaningful for PERF.md.
@@ -29,6 +30,11 @@ Usage:  python scripts/win_microbench.py [--quick] [--codec LIST]
            EFFECTIVE rate — app-level payload bytes over wall time — so
            the compressed-vs-raw comparison reads off directly (the int8
            ``>= 2x win_update`` acceptance bar, PERF.md r15).
+  --sharded: comma-separated shard factors (e.g. ``2,4``): replays
+           win_put on shard-row-sized windows and counter-delta-verifies
+           (``win.deposit_bytes``) that per-op wire bytes drop by
+           ``>= 0.9*S`` — the sharded-window acceptance bar
+           (docs/sharded_windows.md); the child ASSERTS it.
 """
 
 import argparse
@@ -54,12 +60,19 @@ def main() -> int:
     ap.add_argument("--codec", type=str, default=None,
                     help="comma-separated wire codecs to sweep "
                          "(int8,fp8,topk:<frac>) on the headline config")
+    ap.add_argument("--sharded", type=str, default=None,
+                    help="comma-separated shard factors (e.g. 2,4) to "
+                         "sweep: shard-row windows replay win_put and the "
+                         "per-op wire bytes are counter-delta verified to "
+                         "drop ≥ 0.9*S (docs/sharded_windows.md)")
     args = ap.parse_args()
     env = os.environ.copy()
     if args.quick:
         env["BLUEFOG_WB_QUICK"] = "1"
     if args.codec:
         env["BLUEFOG_WB_CODECS"] = args.codec
+    if args.sharded:
+        env["BLUEFOG_WB_SHARD"] = args.sharded
     for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE",
               "BLUEFOG_CP_HOST", "BLUEFOG_CP_PORT", "BLUEFOG_WIN_CODEC"):
         env.pop(k, None)

@@ -1,0 +1,131 @@
+"""Child for the sharded-OOM acceptance demo (ISSUE r17).
+
+Builds a param tree whose REPLICATED hosted window plane (full-row window
+rows + mailbox slots + published copies + packed buffer, ~20x the single
+row) cannot fit under an RSS rlimit, then asserts:
+
+* ``--shard 4``: the sharded plane (every window-plane object 1/4-sized)
+  creates its window and completes 20 gossip steps with a decreasing
+  loss → prints ``SHARDED_TRAIN_OK``.
+* ``--shard 1``: replicated packing blows the same limit during window
+  creation / the first gossip step → prints ``REPLICATED_OOM``.
+
+The limit is RLIMIT_DATA anchored at the process's usage right before
+optimizer init plus a fixed budget sized BETWEEN the two planes' needs,
+so the verdict is a property of the window plane, not of the interpreter
+baseline. Hosted world-1 plane: window rows and mailboxes are host numpy
+(allocation failure is a catchable MemoryError, not an XLA abort).
+"""
+
+import argparse
+import os
+import resource
+import sys
+
+# Calibrated on the CI box: anchor-relative peak VmData over 20 gossip
+# steps is ~450 MB sharded (S=8) vs ~1450 MB replicated — the window
+# plane's rows/mailboxes/publishes/pack transients all scale with the
+# row, so the budget sits between the two with ~250 MB margin each way.
+BUDGET_MB = 700
+ELEMS = 6_000_000  # 24 MB f32 per rank row
+N = 4
+
+
+def vm_data_bytes() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmData:"):
+                return int(line.split()[1]) * 1024
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shard", type=int, required=True)
+    args = ap.parse_args()
+
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    # deterministic baseline: exactly N host devices regardless of what
+    # the spawning test harness forced (thread pools and per-device
+    # buffers all count toward the data limit)
+    os.environ["XLA_FLAGS"] = \
+        f"--xla_force_host_platform_device_count={N}"
+    os.environ.update({
+        "JAX_PLATFORMS": "cpu",
+        "BLUEFOG_FUSION_THRESHOLD": str(1 << 30),
+        "BLUEFOG_CP_HOST": "127.0.0.1",
+        "BLUEFOG_CP_PORT": str(port),
+        "BLUEFOG_CP_WORLD": "1",
+        "BLUEFOG_CP_RANK": "0",
+        "BLUEFOG_WIN_PLANE": "hosted",
+    })
+    if args.shard > 1:
+        os.environ["BLUEFOG_WIN_SHARD"] = str(args.shard)
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import bluefog_tpu as bf
+
+    bf.init(devices=jax.devices("cpu")[:N])
+    rng = np.random.RandomState(0)
+    single = {"w": jnp.asarray(rng.randn(ELEMS).astype(np.float32) * 0.1),
+              "b": jnp.asarray(rng.randn(64).astype(np.float32))}
+    target = 0.5
+
+    def loss(p, b):
+        return jnp.mean((p["w"] - target) ** 2) + jnp.mean(p["b"] ** 2)
+
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.2), loss)
+    # warm the WHOLE gossip path on a throwaway tiny window BEFORE the
+    # limit: thread stacks (XLA dispatch pools, control-plane prefetch
+    # threads) are private anonymous mmaps and count toward RLIMIT_DATA —
+    # without this the run dies in pthread_create (an uncatchable C++
+    # terminate) instead of a clean allocation failure at the plane
+    # under test
+    warm = bf.DistributedWinPutOptimizer(optax.sgd(0.2), loss,
+                                         window_prefix="rlimit.warm")
+    wstate = warm.init({"w": jnp.ones(2048), "b": jnp.ones(64)})
+    for _ in range(2):
+        wstate, _ = warm.step(wstate, jnp.zeros((N, 1), jnp.float32))
+    warm.free()
+    # anchor the limit NOW: everything allocated from here on is the
+    # window plane under test (plus the step's compile, inside BUDGET)
+    cur = vm_data_bytes()
+    limit = cur + BUDGET_MB * (1 << 20)
+    resource.setrlimit(resource.RLIMIT_DATA, (limit, limit))
+    print(f"rlimit: VmData {cur >> 20} MB + {BUDGET_MB} MB budget "
+          f"(shard={args.shard}, row {ELEMS * 4 >> 20} MB, world {N})",
+          flush=True)
+    try:
+        state = opt.init(single)
+        batch = jnp.zeros((N, 1), jnp.float32)
+        losses = []
+        for _ in range(20):
+            state, m = opt.step(state, batch)
+            losses.append(float(np.asarray(m["loss"]).mean()))
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+        print(f"losses: {losses[0]:.4f} -> {losses[-1]:.4f}", flush=True)
+        print("SHARDED_TRAIN_OK" if args.shard > 1 else "REPLICATED_FIT",
+              flush=True)
+        opt.free()
+    except (MemoryError, RuntimeError, OSError) as exc:
+        # jax CPU raises RuntimeError on allocation failure; numpy raises
+        # MemoryError; a torn control-plane publish surfaces as OSError
+        print(f"allocation failed: {type(exc).__name__}: "
+              f"{str(exc)[:200]}", flush=True)
+        print("REPLICATED_OOM" if args.shard == 1 else "SHARDED_OOM",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1754,6 +1754,40 @@ def test_repl_newline_key_survives_kill(repl_pair):
     sv.close()
 
 
+def test_repl_published_row_survives_kill_mid_publish(repl_pair):
+    """ISSUE r17 satellite: published window rows (raw byte values,
+    ``kPutBytes``) ride the WAL now — SIGKILL the shard right after a
+    publish acks, and the promoted ring successor serves the row BYTE
+    FOR BYTE. Before this record class a shard death lost the exposed
+    window until the owner's next publish (ROADMAP "replicating
+    published window rows"); win_get pulls and rejoin donor reads hit
+    that gap. Both publish shapes are pinned: the single-message
+    kPutBytes and the striped kPutBytesPart assembly (which replicates
+    as ONE record at the stripe that completed the value)."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    r = ShardRouter(_endpoints(repl_pair), 0, streams=4)
+    rng = np.random.default_rng(_seed(47))
+    small_key = next(f"w.pub.self.{j}" for j in range(64)
+                     if r.shard_of(f"w.pub.self.{j}") == 1)
+    big_key = next(f"w.pub.big.{j}" for j in range(64)
+                   if r.shard_of(f"w.pub.big.{j}") == 1)
+    small = bytes(rng.integers(0, 256, size=200_000, dtype=np.uint8))
+    # above the stripe threshold: fans out as kPutBytesPart stripes
+    big = bytes(rng.integers(0, 256, size=5 << 20, dtype=np.uint8))
+    r.put_bytes(small_key, small)
+    r.put_bytes(big_key, big)
+    proc, _ = repl_pair[1]
+    proc.send_signal(signal.SIGKILL)  # dies holding both published rows
+    proc.wait()
+    assert bytes(r.get_bytes(small_key)) == small, \
+        "published row lost across the kill (kPutBytes not replicated)"
+    assert bytes(r.get_bytes(big_key)) == big, \
+        "striped published row lost across the kill"
+    assert r.dead_shards() == {1}
+    r.close()
+
+
 def test_repl_failover_primary_sweeps_adopted_keyspace_on_attach():
     """Incarnation-GC scope under failover: a direct kAttach on a
     replicating shard must also sweep mailboxes of a keyspace it serves

@@ -406,10 +406,14 @@ def test_step_attribution_script_over_dump(bf_hosted_flight, tmp_path):
                           "step_attribution.py"), path, "--json"],
             capture_output=True, text=True, timeout=120)
         assert out.returncode == 0, out.stderr
-        rep = json.loads(out.stdout)["ranks"]["0"]
+        doc = json.loads(out.stdout)
+        rep = doc["ranks"]["0"]
         assert rep["step"] == 2
         total = sum(rep["phases"].values()) + rep["other_sec"]
         assert abs(total - rep["step_sec"]) <= 0.10 * rep["step_sec"]
+        # r17 schema-stable additive field: the sharded-window rotation
+        # factor rides every dump (1 = unsharded, as here)
+        assert doc["shard_factor"]["0"] == 1
     finally:
         opt.free()
 

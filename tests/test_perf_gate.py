@@ -82,6 +82,11 @@ def test_gating_filter_keeps_stable_series_only():
         # prefix exclusion must fire BEFORE the op-name match
         "codec.int8.f32.win_put.mbps": 1.0,
         "codec.topk:0.01.f32.win_update.mbps": 1.0,
+        # r17 sharded-window series: info-only under the same rule (the
+        # `sharded_sN.win_put` op names would otherwise match the op
+        # filter)
+        "sharded.f32.sharded_s2.win_put.mbps": 1.0,
+        "sharded.f32.s4.wire_reduction_x": 4.0,
     }
     kept = pg.gating(metrics)
     assert set(kept) == {"win.f32.win_put.mbps", "win.f32.win_update.mbps",

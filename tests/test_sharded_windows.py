@@ -1,0 +1,434 @@
+"""Sharded-model gossip (ISSUE r17): FSDP-style window rows.
+
+Pins the tentpole's contracts: the partition-rule layer (regex rules →
+per-leaf shard cuts, the auto largest-axis rule, the size floor), the
+sharded fusion layer (pack_row/assemble_rows roundtrips across shard
+factors × codecs × dtype mixes, S=1 byte-identity with the legacy wire),
+the compiled pack/scatter rotation inside the window optimizers
+(consensus + exact S=1 parity), the deposit wire's shard guard (a
+drifted rotation's coordinates are dropped, its exact p mass folds), and
+the acceptance demo: a window plane that fails replicated packing under
+an RSS rlimit trains sharded (slow, subprocess).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import bluefog_tpu as bf
+from bluefog_tpu.ops import codec as cd
+from bluefog_tpu.ops import fusion as _fusion
+from bluefog_tpu.ops import partition as _partition
+from bluefog_tpu.ops import windows as win_ops
+from bluefog_tpu.runtime import control_plane as cp
+from bluefog_tpu.runtime import metrics as bf_metrics
+from bluefog_tpu.runtime import native
+from bluefog_tpu.runtime.state import _global_state
+
+from conftest import cpu_devices
+
+N = 8
+
+
+# ---------------------------------------------------------------------------
+# partition rules (no mesh needed)
+# ---------------------------------------------------------------------------
+
+def lm_tree(n=N, vocab=50, d=12):
+    """LM-shaped param tree: embedding + attention-block + norm leaves —
+    the realistic shapes the partition rules must handle."""
+    rng = np.random.RandomState(3)
+    return {
+        "embedding": jnp.asarray(rng.randn(n, vocab, d).astype(np.float32)),
+        "block0": {
+            "qkv": jnp.asarray(rng.randn(n, d, 3 * d).astype(np.float32)),
+            "proj": jnp.asarray(rng.randn(n, d, d).astype(np.float32)),
+            "mlp_up": jnp.asarray(rng.randn(n, d, 4 * d).astype(np.float32)),
+            "ln_scale": jnp.asarray(rng.randn(n, d).astype(np.float32)),
+        },
+        "head_bias": jnp.asarray(rng.randn(n, vocab).astype(np.float32)),
+    }
+
+
+def test_parse_rules_grammar_and_fallback():
+    rules = _partition.parse_rules("embedding=0, qkv=1, norm=none, .*=largest")
+    # 4 parsed terms + the auto backstop
+    assert len(rules) == 5
+    assert rules[0][1] == 0 and rules[1][1] == 1 and rules[2][1] == "none"
+    # malformed terms degrade (skipped with a warning), never raise
+    rules = _partition.parse_rules("oops, [=bad, x=seven")
+    assert rules[-1][1] == "largest"
+    # unset → the auto rule alone
+    assert [r[1] for r in _partition.parse_rules(None)] == ["largest"]
+
+
+def test_match_partition_rules_first_match_and_scalars():
+    names = ["embedding", "block0/qkv", "block0/ln_scale", "scalar"]
+    shapes = [(50, 12), (12, 36), (12,), ()]
+    axes = _partition.match_partition_rules(
+        _partition.parse_rules("qkv=1,ln=none,.*=0"), names, shapes)
+    assert axes == [0, 1, None, None]  # scalar never partitions
+    # auto rule: largest axis
+    axes = _partition.match_partition_rules(
+        _partition.parse_rules(None), names, shapes)
+    assert axes == [0, 1, 0, None]
+
+
+def test_build_shard_spec_floor_and_balance():
+    tree = lm_tree()
+    leaves = jax.tree_util.tree_leaves(tree)
+    shapes = [tuple(x.shape[1:]) for x in leaves]
+    dtypes = [x.dtype for x in leaves]
+    names = _partition.leaf_names(tree)
+    sh = _partition.build_shard_spec(shapes, dtypes, 4, names=names,
+                                     floor_bytes=256)
+    assert sh.factor == 4 and len(sh.pieces) == 4
+    # every element lands in exactly one piece
+    assert sum(sh.totals) == sum(int(np.prod(s)) if s else 1 for s in shapes)
+    # balance: shards within ~2x of each other for this tree
+    assert max(sh.totals) < 2 * min(sh.totals)
+    # the floor keeps the small ln_scale leaf whole (one piece, axis -1)
+    ln_i = names.index("block0/ln_scale")
+    ln_pieces = [p for ps in sh.pieces for p in ps if p[0] == ln_i]
+    assert len(ln_pieces) == 1 and ln_pieces[0][1] == -1
+
+
+# ---------------------------------------------------------------------------
+# sharded fusion: property roundtrips (satellite 3)
+# ---------------------------------------------------------------------------
+
+def mixed_dtype_leaves(rng, n=N):
+    import ml_dtypes
+
+    return [
+        rng.randn(n, 7, 5).astype(np.float32),
+        (rng.randn(n, 33) * 3).astype(ml_dtypes.bfloat16),
+        rng.randn(n, 4, 3, 2).astype(np.float32),
+        rng.randn(n).astype(np.float32),
+    ]
+
+
+@pytest.mark.parametrize("factor", [1, 2, 4])
+@pytest.mark.parametrize("codec_spec", [None, "int8", "topk:0.1"])
+def test_pack_row_roundtrip_shard_x_codec_x_dtypes(factor, codec_spec):
+    """Property: for every (shard factor, codec, dtype mix), per-shard
+    pack_row → assemble_rows reproduces exactly what the codec pipeline
+    itself would — and with no codec, reassembly is bit-exact."""
+    rng = np.random.RandomState(10 + factor)
+    leaves = mixed_dtype_leaves(rng)
+    shapes = [tuple(x.shape[1:]) for x in leaves]
+    dtypes = [x.dtype for x in leaves]
+    sh = _partition.build_shard_spec(shapes, dtypes, factor)
+    spec = _fusion.make_spec([jnp.asarray(x) for x in leaves], shard=sh)
+    codec = cd.resolve(codec_spec)
+    for r in range(0, N, 3):
+        rows = [_fusion.pack_row([x[r] for x in leaves], spec,
+                                 codec=codec, shard=s)
+                for s in range(factor)]
+        back = _fusion.assemble_rows(rows, spec, codec=codec)
+        if codec is None:
+            for a, b in zip(leaves, back):
+                np.testing.assert_array_equal(np.asarray(a[r]), b)
+        else:
+            # wiring property: assembling the DECODED shard rows equals
+            # decoding each shard row and assembling raw — the codec's
+            # own error is not under test here
+            raw_rows = [codec.decode(
+                rows[s].reshape(-1).view(np.uint8),
+                np.dtype(spec.buffer_dtype), sh.row_len)
+                for s in range(factor)]
+            expect = _fusion.assemble_rows(raw_rows, spec)
+            for a, b in zip(expect, back):
+                np.testing.assert_array_equal(a, b)
+
+
+def test_shard_factor_1_wire_byte_identity():
+    """Legacy byte-identity: a factor-1 sharded spec packs the EXACT
+    bytes the r15 wire packs — sharding off is not approximately off."""
+    rng = np.random.RandomState(5)
+    leaves = mixed_dtype_leaves(rng)
+    sh = _partition.build_shard_spec(
+        [tuple(x.shape[1:]) for x in leaves], [x.dtype for x in leaves], 1)
+    spec = _fusion.make_spec([jnp.asarray(x) for x in leaves], shard=sh)
+    assert sh.totals == (spec.total,) and sh.row_len == spec.total
+    for r in range(N):
+        legacy = _fusion.pack_row([x[r] for x in leaves], spec)
+        sharded = _fusion.pack_row([x[r] for x in leaves], spec, shard=0)
+        assert legacy.tobytes() == sharded.tobytes()
+        # and under a codec the encoded payloads match byte for byte
+        c = cd.Int8Codec()
+        assert _fusion.pack_row([x[r] for x in leaves], spec,
+                                codec=c).tobytes() == \
+            _fusion.pack_row([x[r] for x in leaves], spec, codec=c,
+                             shard=0).tobytes()
+
+
+@pytest.mark.parametrize("factor", [2, 4])
+def test_compiled_pack_scatter_roundtrip(factor):
+    """The jitted rotation: pack every shard, scatter into zeroed leaves,
+    recover the tree bit for bit (pad tail ignored)."""
+    tree = lm_tree()
+    sh = _partition.spec_for_tree(tree, factor, floor_bytes=64)
+    spec = _fusion.make_spec(tree, shard=sh)
+    leaves = jax.tree_util.tree_leaves(tree)
+    out = [jnp.zeros_like(x) for x in leaves]
+    for s in range(factor):
+        buf = _fusion.pack_shard_jit(tree, spec, s)
+        assert buf.shape == (N, sh.row_len)
+        out = list(_fusion.scatter_shard_jit(out, buf, spec, s))
+    for a, b in zip(leaves, out):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# optimizer rotation (collective plane, single controller)
+# ---------------------------------------------------------------------------
+
+def zero_loss(p, b):
+    return 0.0 * sum(jnp.sum(x) for x in jax.tree_util.tree_leaves(p))
+
+
+def _run_winput(shard_env, steps=10, seed=2, monkeypatch=None):
+    if shard_env is not None:
+        monkeypatch.setenv("BLUEFOG_WIN_SHARD", str(shard_env))
+    monkeypatch.setenv("BLUEFOG_FUSION_THRESHOLD", str(8 << 20))
+    bf.init(devices=cpu_devices(N))
+    try:
+        rng = np.random.RandomState(seed)
+        params0 = {
+            f"l{i}": {"w": jnp.asarray(rng.randn(N, 6, 4).astype(np.float32)),
+                      "b": jnp.asarray(rng.randn(N, 4).astype(np.float32))}
+            for i in range(4)
+        }
+        opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1), zero_loss)
+        single = jax.tree_util.tree_map(lambda x: x[0], params0)
+        st0 = opt.init(single)
+        state = bf.TrainState(
+            params=jax.device_put(params0, bf.rank_sharding(bf.mesh())),
+            opt_state=st0.opt_state, model_state=None)
+        batch = jnp.zeros((N, 1), jnp.float32)
+        for _ in range(steps):
+            state, _ = opt.step(state, batch)
+        out = jax.tree_util.tree_map(np.asarray, state.params)
+        factor = opt._shard_factor
+        opt.free()
+        return out, factor
+    finally:
+        bf.shutdown()
+
+
+def test_sharded_winput_reaches_consensus_and_s1_is_exact(monkeypatch):
+    """S=1 must be the legacy path bit for bit; S∈{2,4} rotations must
+    still drive every rank to consensus (each shard mixes every S-th
+    step — block-coordinate gossip)."""
+    base, f0 = _run_winput(None, monkeypatch=monkeypatch)
+    assert f0 == 1
+    s1, f1 = _run_winput(1, monkeypatch=monkeypatch)
+    assert f1 == 1
+    for a, b in zip(jax.tree_util.tree_leaves(base),
+                    jax.tree_util.tree_leaves(s1)):
+        np.testing.assert_array_equal(a, b)  # bit-exact at factor 1
+    for S in (2, 4):
+        got, f = _run_winput(S, steps=6 * S, monkeypatch=monkeypatch)
+        assert f == S
+        for leaf in jax.tree_util.tree_leaves(got):
+            spread = np.abs(leaf - leaf.mean(axis=0, keepdims=True)).max()
+            assert spread < 5e-2, f"S={S}: no consensus, spread {spread}"
+
+
+def test_sharded_push_sum_exact_mean(monkeypatch):
+    """Push-sum under rotation: each block's gossip is a valid push-sum
+    step with the CURRENT p (numerator rebuilt from params every step),
+    so consensus still lands on the exact initial mean."""
+    monkeypatch.setenv("BLUEFOG_FUSION_THRESHOLD", str(8 << 20))
+    monkeypatch.setenv("BLUEFOG_WIN_SHARD", "2")
+    bf.init(devices=cpu_devices(N))
+    try:
+        rng = np.random.RandomState(7)
+        params0 = {"w": jnp.asarray(rng.randn(N, 40).astype(np.float32)),
+                   "v": jnp.asarray(rng.randn(N, 9, 3).astype(np.float32))}
+        opt = bf.DistributedPushSumOptimizer(optax.sgd(0.1), zero_loss)
+        single = jax.tree_util.tree_map(lambda x: x[0], params0)
+        st0 = opt.init(single)
+        assert opt._shard_factor == 2
+        leaves = jax.tree_util.tree_leaves(
+            jax.device_put(params0, bf.rank_sharding(bf.mesh())))
+        # install true per-rank values into the packed window numerator
+        # (shard 0 is the window's bound rotation at creation)
+        win = _global_state().windows[opt._win_names[0]]
+        assert win.shard_factor == 2
+        state = bf.TrainState(
+            params=jax.device_put(params0, bf.rank_sharding(bf.mesh())),
+            opt_state=st0.opt_state, model_state=None)
+        batch = jnp.zeros((N, 1), jnp.float32)
+        for _ in range(80):
+            state, _ = opt.step(state, batch)
+        got = jax.tree_util.tree_map(np.asarray, state.params)
+        for leaf0, leafN in zip(jax.tree_util.tree_leaves(params0),
+                                jax.tree_util.tree_leaves(got)):
+            expect = np.mean(np.asarray(leaf0, dtype=np.float64), axis=0)
+            for r in range(N):
+                np.testing.assert_allclose(leafN[r], expect, atol=2e-2)
+        opt.free()
+        bf.turn_off_win_ops_with_associated_p()
+    finally:
+        bf.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hosted wire: the shard guard + sidx publish (world-1 control plane)
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def bf_hosted(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_CP_HOST", "127.0.0.1")
+    monkeypatch.setenv("BLUEFOG_CP_PORT", str(_free_port()))
+    monkeypatch.setenv("BLUEFOG_CP_WORLD", "1")
+    monkeypatch.setenv("BLUEFOG_CP_RANK", "0")
+    monkeypatch.setenv("BLUEFOG_WIN_PLANE", "hosted")
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(N))
+    assert cp.active()
+    yield bf
+    bf.shutdown()
+    cp.reset_for_test()
+
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native runtime unavailable")
+
+
+def test_deposit_shard_guard_drops_drifted_value_keeps_p(bf_hosted):
+    """The wire's rotation guard: a deposit carrying shard index s ≠ the
+    owner's active shard folds its exact p mass but NOT its value (wrong
+    subspace's coordinates), and win.shard_stale_drops counts it. A
+    matching shard folds normally."""
+    elems = 64
+    x = jnp.zeros((N, elems), jnp.float32)
+    assert bf.win_create(x, "sx.guard", zero_init=True)
+    win = win_ops._get_window("sx.guard")
+    win.bind_shard(2)
+    win.set_active_shard(0)
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        dst, src = 0, sorted(win.in_neighbors[0])[0]
+        k = win.layout.slot_of[dst][src]
+        payload = np.arange(elems, dtype=np.float32)
+        cl = cp.client()
+
+        def deposit(shard, seq, pc):
+            recs = win_ops._pack_deposit(win_ops._DEP_ACC, 1, pc, payload,
+                                         shard=shard)
+            cl.append_bytes_tagged_many(
+                [win._dep_key(dst, k)] * len(recs), recs,
+                win_ops._deposit_tags(seq, len(recs)))
+
+        drops0 = bf_metrics.snapshot()["counters"].get(
+            "win.shard_stale_drops", 0)
+        deposit(shard=1, seq=1, pc=0.25)   # drifted: value dropped
+        deposit(shard=0, seq=2, pc=0.5)    # aligned: value folds
+        win._drain_deposits()
+        drops1 = bf_metrics.snapshot()["counters"].get(
+            "win.shard_stale_drops", 0)
+        assert drops1 - drops0 == 1
+        # only the aligned deposit's value landed...
+        np.testing.assert_array_equal(win._mail_rows[dst][k], payload)
+        # ...but BOTH deposits' p mass folded (conservation under drift)
+        assert win.host.read_p_mail()[dst, k] == pytest.approx(0.75)
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+        bf.win_free("sx.guard")
+
+
+def test_published_shard_index_rides_publish(bf_hosted):
+    """Sharded publishes carry the rotation index next to the row:
+    read_published_shard returns (row, sidx) a rejoiner can collect
+    shard-by-shard across the donor's steps."""
+    elems = 32
+    x = jnp.asarray(np.arange(N * elems, dtype=np.float32).reshape(N, elems))
+    assert bf.win_create(x, "sx.sidx")
+    win = win_ops._get_window("sx.sidx")
+    win.bind_shard(3)
+    win.set_active_shard(2)
+    win._publish_selves(win.owned)
+    row, sidx = win.read_published_shard(1)
+    assert sidx == 2
+    np.testing.assert_array_equal(row, np.asarray(x)[1])
+    # unsharded windows report no index
+    assert bf.win_create(jnp.zeros((N, 4)), "sx.plain")
+    assert win_ops._get_window("sx.plain").read_published_shard(1)[1] is None
+    bf.win_free("sx.sidx")
+    bf.win_free("sx.plain")
+
+
+def test_sharded_rows_reassemble_from_published_shards(bf_hosted,
+                                                       monkeypatch):
+    """The rejoin reassembly contract end-to-end on the hosted plane:
+    with IDENTICAL params (gossip = identity), polling a rank's
+    published (row, sidx) across S steps collects every shard, and
+    assemble_rows rebuilds the exact parameter leaves — what
+    _transfer_rank_sharded + _adopt_window_rows do for a quarantined
+    rejoiner."""
+    monkeypatch.setenv("BLUEFOG_FUSION_THRESHOLD", str(8 << 20))
+    monkeypatch.setenv("BLUEFOG_WIN_SHARD", "2")
+    rng = np.random.RandomState(11)
+    single = {"w": jnp.asarray(rng.randn(10, 6).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(6).astype(np.float32))}
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1), zero_loss)
+    state = opt.init(single)
+    batch = jnp.zeros((N, 1), jnp.float32)
+    win = _global_state().windows[opt._win_names[0]]
+    spec = opt._specs[0]
+    got = {}
+    for _ in range(2):
+        state, _ = opt.step(state, batch)
+        row, sidx = win.read_published_shard(3)
+        assert sidx is not None
+        got.setdefault(sidx, row)
+    assert sorted(got) == [0, 1]
+    back = _fusion.assemble_rows([got[0], got[1]], spec)
+    for leaf, b in zip(jax.tree_util.tree_leaves(single), back):
+        np.testing.assert_allclose(np.asarray(leaf), b, atol=1e-6)
+    opt.free()
+
+
+# ---------------------------------------------------------------------------
+# acceptance demo: replicated packing OOMs under rlimit, sharded trains
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_rlimit_sharded_trains_where_replicated_ooms():
+    """ISSUE r17 acceptance: under an RSS rlimit sized to the SHARDED
+    window plane, the replicated (S=1) plane fails to even create its
+    full-row window, while S=8 completes 20 gossip steps with a finite
+    decreasing loss. Subprocess child so the rlimit (and any allocator
+    fallout) cannot poison the test process."""
+    child = os.path.join(os.path.dirname(__file__),
+                         "_sharded_rlimit_child.py")
+
+    def run(shard):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("BLUEFOG_WIN_SHARD", None)
+        r = subprocess.run(
+            [sys.executable, child, "--shard", str(shard)],
+            capture_output=True, text=True, timeout=600, env=env)
+        return r
+
+    r8 = run(8)
+    assert "SHARDED_TRAIN_OK" in r8.stdout, (r8.stdout + r8.stderr)[-2000:]
+    r1 = run(1)
+    assert "REPLICATED_OOM" in r1.stdout, (r1.stdout + r1.stderr)[-2000:]
