@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from . import partition as _partition
+from ..runtime.config import knob_env
 
 
 class PackSpec(NamedTuple):
@@ -256,15 +257,22 @@ def _pack_shard_compiled(spec: PackSpec, shard: int):
 
 
 @functools.lru_cache(maxsize=512)
-def _scatter_shard_compiled(spec: PackSpec, shard: int):
-    # Donate the leaves: they are replaced by the outputs, so XLA updates
-    # the touched pieces in place instead of double-buffering the full
-    # model — the whole point of shard-sized gossip memory (the rlimit
-    # acceptance demo fails without it). The shard buffer is NOT donated:
-    # its shape aliases no output, so donation would only warn.
+def _scatter_shard_compiled(spec: PackSpec, shard: int, donate: bool):
+    # Donating the leaves lets XLA update the touched pieces in place
+    # instead of double-buffering the full model — the whole point of
+    # shard-sized gossip memory (the rlimit acceptance demo fails
+    # without it). The donated leaves are the live param buffers, so the
+    # default-on donation is an ALIASING CONTRACT on the optimizer step
+    # (docs/sharded_windows.md): after a sharded gossip step, arrays
+    # reached through any retained pre-step TrainState are invalidated.
+    # Callers that keep such aliases (an eval/checkpoint copy of the
+    # previous state) opt out via BLUEFOG_WIN_SHARD_DONATE=0, paying the
+    # transient double-buffer the unsharded unpack path always pays. The
+    # shard buffer is NOT donated: its shape aliases no output, so
+    # donation would only warn.
     return jax.jit(
         lambda leaves, buf: tuple(scatter_shard(leaves, buf, spec, shard)),
-        donate_argnums=(0,))
+        donate_argnums=(0,) if donate else ())
 
 
 def pack_shard_jit(tree, spec: PackSpec, shard: int):
@@ -273,7 +281,8 @@ def pack_shard_jit(tree, spec: PackSpec, shard: int):
 
 
 def scatter_shard_jit(leaves, buf, spec: PackSpec, shard: int):
-    return _scatter_shard_compiled(spec, shard)(tuple(leaves), buf)
+    donate = bool(knob_env("BLUEFOG_WIN_SHARD_DONATE"))
+    return _scatter_shard_compiled(spec, shard, donate)(tuple(leaves), buf)
 
 
 @functools.lru_cache(maxsize=512)

@@ -1033,10 +1033,12 @@ class Window:
         # coordinates — folding it would mix misaligned coordinates. The
         # value is discarded (the slot keeps its last same-shard content,
         # i.e. one-rotation-stale — the per-shard analog of the hosted
-        # plane's usual staleness); the exact p mass still folds so
-        # push-sum conservation survives drift. win.shard_stale_drops
-        # counts it: persistent growth means a controller's comm-round
-        # counter drifted (see straggler detection, docs/metrics.md).
+        # plane's usual staleness). Accumulate-mode p mass still folds so
+        # push-sum conservation survives drift; put-mode p is dropped
+        # with the value so the slot's (value, p) pair stays coherent
+        # (see _finish_deposit). win.shard_stale_drops counts it:
+        # persistent growth means a controller's comm-round counter
+        # drifted (see straggler detection, docs/metrics.md).
         discard = shard >= 0 and shard != self.active_shard
         if codec_id or discard:
             staging = np.empty(expect, np.uint8)
@@ -1102,14 +1104,17 @@ class Window:
                fl.intern(f"drain.{(pend.seq >> 32) & 0x7F}"),
                pend.got, pend.seq)
         if pend.discard:
-            # rotation drift: value dropped (wrong shard's coordinates),
-            # exact p mass kept — see _start_deposit
+            # rotation drift (see _start_deposit): accumulate-mode still
+            # folds the exact p mass — push-sum conservation must survive
+            # drift even when the value cannot. Put-mode drops the WHOLE
+            # (value, p) pair: set_p_mail against the slot's retained
+            # previous-rotation value would leave a torn pair (stale
+            # value, fresh weight) that biases the combine, whereas
+            # keeping both halves from the last same-shard deposit is
+            # merely one rotation stale and self-consistent.
             _metrics.counter("win.shard_stale_drops").inc()
-            if pend.has_p:
-                if pend.mode == _DEP_ACC:
-                    self.host.add_p_mail(pair[0], pair[1], pend.pc)
-                else:
-                    self.host.set_p_mail(pair[0], pair[1], pend.pc)
+            if pend.has_p and pend.mode == _DEP_ACC:
+                self.host.add_p_mail(pair[0], pair[1], pend.pc)
             return
         if pend.codec_id:
             # compressed deposit: decode the self-describing payload back

@@ -910,7 +910,12 @@ class _WindowOptimizer(_FusedOptimizer):
         ok = True
         for nm in self._win_names:
             win = _windows._get_window(nm)
-            got = self._rejoin_shards.setdefault((nm, rank), {})
+            # fresh accumulator PER DONOR ATTEMPT: assemble_rows must
+            # stitch a rank's tree from a single donor's rotation — a
+            # partial collection left by a failed previous donor must not
+            # be topped up with another donor's shards
+            got = {}
+            self._rejoin_shards[(nm, rank)] = got
             while len(got) < self._shard_factor and \
                     time.monotonic() < deadline:
                 try:
@@ -935,6 +940,17 @@ class _WindowOptimizer(_FusedOptimizer):
                 if cur is not None and rank in win.owned:
                     win.install_row(rank, cur)
         return ok
+
+    def _realign_rotation(self) -> None:
+        """Re-derive the shard-rotation counter from the (just adopted)
+        step counter. ``_comm_rounds == _counter // k`` is the
+        steady-state invariant on every controller (a comm round fires
+        exactly when the counter crosses a multiple of k), so deriving it
+        after a rejoin realigns this controller's active shard with its
+        peers. Leaving it at the init-time 0 would phase-shift the
+        rotation permanently — the wire's shard guard would then discard
+        every deposit to/from this rank forever."""
+        self._comm_rounds = self._counter // self.num_steps_per_communication
 
     def _rejoin_state_transfer(self, state: TrainState) -> TrainState:
         st = _global_state()
@@ -964,6 +980,7 @@ class _WindowOptimizer(_FusedOptimizer):
                     self._counter = max(self._counter, max(steps))
             except (OSError, RuntimeError):
                 pass
+            self._realign_rotation()
             logger.warning(
                 "rejoin: window state transferred from live in-neighbors "
                 "%s (step counter -> %d)", donors, self._counter)
@@ -972,6 +989,7 @@ class _WindowOptimizer(_FusedOptimizer):
         if restored is not None:
             state, step = restored
             self._counter = int(step)
+            self._realign_rotation()
             logger.warning(
                 "rejoin: no live in-neighbor served state transfer; "
                 "restored the newest local checkpoint (step %d)", step)
@@ -1198,7 +1216,12 @@ class _WindowOptimizer(_FusedOptimizer):
                                            mixed):
                     if shard >= 0:
                         # scatter the combined shard back into the full
-                        # leaves: only this shard's pieces change
+                        # leaves: only this shard's pieces change. The
+                        # leaves are DONATED by default (in-place update,
+                        # no full-model double-buffer) — a TrainState
+                        # retained from before this step must not be read
+                        # after it unless BLUEFOG_WIN_SHARD_DONATE=0
+                        # (docs/sharded_windows.md, donation contract)
                         group = [out[i] for i in idxs]
                         for i, v in zip(idxs, _fusion.scatter_shard_jit(
                                 group, buf, spec, shard)):

@@ -11,10 +11,12 @@ the acceptance demo: a window plane that fails replicated packing under
 an RSS rlimit trains sharded (slow, subprocess).
 """
 
+import contextlib
 import os
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -188,6 +190,27 @@ def test_compiled_pack_scatter_roundtrip(factor):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_scatter_shard_donate_knob(monkeypatch):
+    """BLUEFOG_WIN_SHARD_DONATE=0 opts out of leaf donation (the caller
+    keeps pre-step aliases readable) and produces the same result as the
+    donating default (docs/sharded_windows.md, donation contract)."""
+    rng = np.random.RandomState(4)
+    tree = [jnp.asarray(rng.randn(N, 6, 4).astype(np.float32))]
+    sh = _partition.build_shard_spec([(6, 4)], [np.dtype(np.float32)], 2)
+    spec = _fusion.make_spec(tree, shard=sh)
+    buf = _fusion.pack_shard_jit(tree, spec, 0)
+    monkeypatch.setenv("BLUEFOG_WIN_SHARD_DONATE", "0")
+    leaves = [jnp.zeros_like(tree[0])]
+    out_nd = _fusion.scatter_shard_jit(leaves, buf, spec, 0)
+    # non-donating path: the input leaves stay valid and untouched
+    np.testing.assert_array_equal(np.asarray(leaves[0]), 0.0)
+    monkeypatch.delenv("BLUEFOG_WIN_SHARD_DONATE")
+    out_d = _fusion.scatter_shard_jit([jnp.zeros_like(tree[0])], buf,
+                                      spec, 0)
+    for a, b in zip(out_nd, out_d):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 # ---------------------------------------------------------------------------
 # optimizer rotation (collective plane, single controller)
 # ---------------------------------------------------------------------------
@@ -354,6 +377,48 @@ def test_deposit_shard_guard_drops_drifted_value_keeps_p(bf_hosted):
         bf.win_free("sx.guard")
 
 
+def test_deposit_shard_guard_put_mode_drops_whole_pair(bf_hosted):
+    """Put-mode drift discards the WHOLE (value, p) pair: overwriting
+    only p against the slot's retained previous-rotation value would
+    leave a torn pair (stale value, fresh weight) that biases the
+    combine. The slot keeps the last same-shard pair instead."""
+    elems = 64
+    x = jnp.zeros((N, elems), jnp.float32)
+    assert bf.win_create(x, "sx.putguard", zero_init=True)
+    win = win_ops._get_window("sx.putguard")
+    win.bind_shard(2)
+    win.set_active_shard(0)
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        dst, src = 0, sorted(win.in_neighbors[0])[0]
+        k = win.layout.slot_of[dst][src]
+        cl = cp.client()
+
+        def deposit(shard, seq, pc, payload):
+            recs = win_ops._pack_deposit(win_ops._DEP_PUT, 1, pc, payload,
+                                         shard=shard)
+            cl.append_bytes_tagged_many(
+                [win._dep_key(dst, k)] * len(recs), recs,
+                win_ops._deposit_tags(seq, len(recs)))
+
+        aligned = np.arange(elems, dtype=np.float32)
+        drifted = np.full(elems, 7.0, np.float32)
+        drops0 = bf_metrics.snapshot()["counters"].get(
+            "win.shard_stale_drops", 0)
+        deposit(shard=0, seq=1, pc=0.25, payload=aligned)
+        deposit(shard=1, seq=2, pc=0.9, payload=drifted)
+        win._drain_deposits()
+        drops1 = bf_metrics.snapshot()["counters"].get(
+            "win.shard_stale_drops", 0)
+        assert drops1 - drops0 == 1
+        # the drifted put changed NEITHER half of the pair
+        np.testing.assert_array_equal(win._mail_rows[dst][k], aligned)
+        assert win.host.read_p_mail()[dst, k] == pytest.approx(0.25)
+    finally:
+        bf.turn_off_win_ops_with_associated_p()
+        bf.win_free("sx.putguard")
+
+
 def test_published_shard_index_rides_publish(bf_hosted):
     """Sharded publishes carry the rotation index next to the row:
     read_published_shard returns (row, sidx) a rejoiner can collect
@@ -404,6 +469,56 @@ def test_sharded_rows_reassemble_from_published_shards(bf_hosted,
     for leaf, b in zip(jax.tree_util.tree_leaves(single), back):
         np.testing.assert_allclose(np.asarray(leaf), b, atol=1e-6)
     opt.free()
+
+
+def test_rejoin_realigns_rotation_with_stepping_peers(bf_hosted):
+    """A rejoiner that adopts a donor's step counter must ALSO re-derive
+    its comm-round count, or its active shard stays phase-shifted from
+    every peer forever (the wire guard would then discard all its
+    deposits). _realign_rotation restores the stepping invariant
+    _comm_rounds == _counter // num_steps_per_communication."""
+    peer = bf.DistributedWinPutOptimizer(
+        optax.sgd(0.1), zero_loss, num_steps_per_communication=3)
+    # a peer that stepped normally: the invariant holds at any counter
+    peer._shard_factor = 4
+    for c in (1, 2, 3, 7, 21, 22):
+        peer._counter = c
+        peer._comm_rounds = c // 3  # what stepping maintains
+        rejoiner = bf.DistributedWinPutOptimizer(
+            optax.sgd(0.1), zero_loss, num_steps_per_communication=3)
+        rejoiner._shard_factor = 4
+        rejoiner._counter = c       # adopted from the donor's publish
+        assert rejoiner._comm_rounds == 0  # init-time value: misaligned
+        rejoiner._realign_rotation()
+        assert rejoiner._comm_rounds == peer._comm_rounds
+        assert rejoiner._active_shard() == peer._active_shard()
+
+
+def test_sharded_transfer_does_not_mix_donors(bf_hosted, monkeypatch):
+    """A retry with a NEW donor must not top up a partial shard
+    collection left by a failed previous donor: assemble_rows may only
+    stitch a rank's tree from a single donor's rotation."""
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1), zero_loss)
+    opt._shard_factor = 2
+    opt._win_names = ["sx.fake"]
+    # donor A died after contributing shard 0
+    opt._rejoin_shards[("sx.fake", 0)] = {0: np.zeros(3, np.float32)}
+
+    class _FakeWin:
+        # donor B is stalled on shard 1 and never rotates
+        def read_published_shard(self, donor):
+            return np.ones(3, np.float32), 1
+
+    monkeypatch.setattr(win_ops, "_get_window", lambda nm: _FakeWin())
+    monkeypatch.setattr(
+        win_ops, "win_mutex",
+        lambda nm, ranks=None: contextlib.nullcontext())
+    ok = opt._transfer_rank_sharded(0, 1, deadline=time.monotonic() + 0.3)
+    # donor B never published shard 0 before the deadline: the transfer
+    # must FAIL rather than silently stitch donor A's shard 0 to donor
+    # B's shard 1
+    assert not ok
+    assert 0 not in opt._rejoin_shards[("sx.fake", 0)]
 
 
 # ---------------------------------------------------------------------------
