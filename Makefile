@@ -7,8 +7,8 @@ MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=
 
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
         test_launcher test_models bench chaos dryrun native scaling \
-        lm_bench metrics-smoke flight-smoke soak-smoke perf-gate lint \
-        bfcheck check tsan asan
+        lm_bench metrics-smoke flight-smoke soak-smoke obs-smoke \
+        perf-gate lint bfcheck check tsan asan
 
 # Test files replayed under the sanitizers: the chaos suite (reconnect /
 # dedup / fencing churn) plus the striped-transport + hosted-window stress
@@ -56,6 +56,15 @@ flight-smoke:    ## flight-recorder acceptance: < 1500 ns ring-record
                  ## job, parseable dumps, and bfrun --dump retrieving a
                  ## merged clock-synced trace from a separate process
 	JAX_PLATFORMS=cpu python scripts/flight_smoke.py
+
+obs-smoke:       ## live-telemetry-plane acceptance: < 2 µs/record ring
+                 ## sampling microbench, a 2-rank job streaming non-empty
+                 ## bf.ts.* deltas (consensus gauge + per-edge
+                 ## estimators), bfrun --top one-shot render from a
+                 ## separate process naming a SIGKILLed publisher SILENT,
+                 ## ts_export JSON-lines + OpenMetrics lint, and
+                 ## step_attribution --live without a dump
+	JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 soak-smoke:      ## durable sharded-control-plane churn soak, quick mode
                  ## (<= 2 min): 2 WAL-replicated shard server processes,
@@ -112,7 +121,7 @@ asan:            ## AddressSanitizer build of csrc + the same replay.
 	    ASAN_OPTIONS="detect_leaks=0 exitcode=66" \
 	    JAX_PLATFORMS=cpu $(PYTEST) $(SANITIZE_TESTS) -q -m "not slow"
 
-chaos: check metrics-smoke flight-smoke soak-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
+chaos: check metrics-smoke flight-smoke obs-smoke soak-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
                  ## seed offsets (BLUEFOG_CHAOS_SEED shifts every armed drop
                  ## point, so reconnect/dedup/fencing — and the telemetry
                  ## counters asserted against them — face different drop sites)

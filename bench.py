@@ -21,6 +21,7 @@ import argparse
 import itertools
 import json
 import os
+import tempfile
 import time
 
 import numpy as np
@@ -28,6 +29,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import optax
+
+
+# Flight dumps from a bench run land in a tempdir instead of littering
+# the CWD (conftest's default for the test suite); an explicit
+# BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 import bluefog_tpu as bf
 from bluefog_tpu.models import ResNet50

@@ -158,6 +158,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "stragglers, or push-sum mass drift — so CI and "
                         "operator scripts can gate on cluster health; the "
                         "default stays exit 0 regardless of findings")
+    p.add_argument("--top", action="store_true",
+                   help="live cluster dashboard over the streamed "
+                        "time-series plane (`bf.ts.<rank>`, "
+                        "docs/observability.md): per-rank step cadence, "
+                        "consensus distance + mixing rate, mass, EF "
+                        "residual, shard drift, sparklines, active "
+                        "alerts, and a per-edge bytes/s + transit-latency "
+                        "matrix — refreshed in place every --interval "
+                        "seconds from OUTSIDE the job (raw control-plane "
+                        "client, no mesh join). Silent ranks (SIGKILLed/"
+                        "wedged — no publication within 3 intervals) are "
+                        "named")
+    p.add_argument("--interval", type=float, default=2.0, metavar="SEC",
+                   help="with --top: refresh cadence (default 2 s)")
+    p.add_argument("--once", action="store_true",
+                   help="with --top: render one frame to stdout and exit "
+                        "(no screen clearing — scripts/CI friendly)")
+    p.add_argument("--world", type=int, default=0, metavar="N",
+                   help="with --top: expected rank count (default: the "
+                        "bf.metrics.world hint, then BLUEFOG_CP_WORLD, "
+                        "then a heartbeat-key scan) — ranks missing from "
+                        "it are reported SILENT")
     p.add_argument("--dump", action="store_true",
                    help="trigger a cluster-wide flight-recorder dump: bump "
                         "the KV flag every rank's heartbeat/watchdog tick "
@@ -659,6 +681,37 @@ def _strict_findings(health: dict) -> List[str]:
         findings.append(
             f"push-sum mass drift {m['drift']:.3g} exceeds tolerance "
             f"{m['tolerance']:.3g}")
+    repl = health.get("repl")
+    if repl is not None and repl["under_replicated"]:
+        findings.append(
+            f"{repl['under_replicated']} control-plane shard(s) "
+            "under-replicated (heartbeat-published cp.under_replicated "
+            "gauge)")
+    return findings
+
+
+def _shard_drift_findings(cl, world: int) -> List[str]:
+    """SUSTAINED shard-rotation drift per rank, from the streamed
+    ``win.shard_stale_drops.rate`` series (a lone historical drop is not
+    a finding; three consecutive positive rate samples are — a
+    controller's comm-round counter desynced and every one of its
+    deposits is being discarded; docs/sharded_windows.md)."""
+    from .runtime import timeseries as _ts
+
+    findings: List[str] = []
+    acc = _ts.HistoryAccumulator()
+    for r in range(world):
+        doc = _ts.read_rank(cl, r)
+        if doc:
+            acc.update(r, doc)
+    for r in range(world):
+        vals = acc.values(r, "win.shard_stale_drops.rate", last=8)
+        tail = [v for v in vals[-3:]]
+        if len(tail) >= 3 and all(v > 0 for v in tail):
+            findings.append(
+                f"rank {r}: sustained shard-rotation drift "
+                f"({tail[-1]:.2f} stale drops/s across the last "
+                f"{len(tail)} samples)")
     return findings
 
 
@@ -714,6 +767,8 @@ def _status(args) -> int:
                         under_replicated.append(name)
         if getattr(args, "strict", False):
             findings = _strict_findings(health)
+            findings.extend(
+                _shard_drift_findings(cl, health["world"]))
             if dead_shards:
                 findings.append(
                     f"dead control-plane shard(s): {dead_shards}")
@@ -729,6 +784,77 @@ def _status(args) -> int:
     finally:
         cl.close()
     return 0
+
+
+def _discover_world(cl) -> int:
+    """World size for the external consumers: the published hint, the
+    env, then a heartbeat-key scan (the --dump convention)."""
+    world = 0
+    try:
+        world = int(cl.get("bf.metrics.world"))
+    except (OSError, RuntimeError):
+        pass
+    if world <= 0:
+        try:
+            world = int(os.environ.get("BLUEFOG_CP_WORLD") or 0)
+        except ValueError:
+            world = 0
+    if world <= 0:
+        world = 1
+        for r in range(256):
+            try:
+                if int(cl.get(f"bf.hb.{r}")) == 0 and r > 0:
+                    break
+            except (OSError, RuntimeError):
+                break
+            world = r + 1
+    return world
+
+
+def _top(args) -> int:
+    """``bfrun --top``: the live cluster dashboard.
+
+    Polls every rank's ``bf.ts.<rank>`` delta stream over a raw
+    control-plane client (the ``--status`` pattern: no jax, no mesh
+    join, scalar/bytes gets only) and renders the merged view — per-rank
+    convergence table with sparklines, active alerts, silent-rank
+    detection, and the per-edge bytes/s + transit matrix assembled from
+    cross-rank flow matching. ``--once`` renders a single plain frame;
+    otherwise the screen refreshes in place every ``--interval``
+    seconds until Ctrl-C."""
+    import time as _time
+
+    addr = _cp_address(args, "--top")
+    if addr is None:
+        return 1
+    from .runtime import timeseries as _ts
+
+    cl = _raw_client(addr, what="--top")
+    if cl is None:
+        return 1
+    acc = _ts.HistoryAccumulator()
+    try:
+        while True:
+            world = args.world or _discover_world(cl)
+            for r in range(world):
+                doc = _ts.read_rank(cl, r)
+                if doc is not None:
+                    acc.update(r, doc)
+            frame = _ts.format_top(acc, world)
+            dead = _report_dead_shards(cl, "--top") \
+                if hasattr(cl, "dead_shard_endpoints") else []
+            if dead:
+                frame += f"\n  DEAD control-plane shard(s): {dead}"
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            _time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        cl.close()
 
 
 def _dump(args) -> int:
@@ -818,6 +944,8 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.status:
         return _status(args)
+    if args.top:
+        return _top(args)
     if args.dump:
         return _dump(args)
     if not args.command:

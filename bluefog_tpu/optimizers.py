@@ -79,12 +79,18 @@ from .runtime import control_plane as _cp
 from .runtime import flight as _flight
 from .runtime import heartbeat as _hb
 from .runtime import metrics as _metrics
+from .runtime import timeseries as _timeseries
 from .runtime.config import knob_env
 from .runtime.logging import logger
 from .runtime.native import PeerLostError
 from .runtime.state import _global_state
 from .runtime.timeline import timeline_context
 from .utils.compat import shard_map
+
+
+# Consensus-gauge cadence (seconds): matches the time-series sampler's
+# ~1 Hz gate — the gauge is only consumed once per sample tick.
+_CONSENSUS_MIN_GAP = 0.9
 
 
 def _perf_gate_delay() -> None:
@@ -614,6 +620,10 @@ class _WindowOptimizer(_FusedOptimizer):
 
     _comm_kind = "none"
     _zero_init = False  # push-sum mailboxes must start empty (no stale mass)
+    # Convergence gauge (docs/observability.md): put/get gossip records
+    # the neighborhood consensus distance each comm step; push-sum opts
+    # out (its numerator is biased by p — debias_drift is its signal).
+    _consensus_gauge = True
 
     _instance_counter = [0]  # id() can recycle after GC; a counter cannot
 
@@ -652,6 +662,8 @@ class _WindowOptimizer(_FusedOptimizer):
         self._shard_factor = 1
         self._comm_rounds = 0
         self._rejoin_shards: Dict[Tuple[str, int], Dict[int, Any]] = {}
+        self._consensus_fn = None  # cached jit for the consensus gauge
+        self._consensus_t = 0.0    # last gauge computation (monotonic)
 
     def _resolve_shard_factor(self) -> int:
         S = int(knob_env("BLUEFOG_WIN_SHARD") or 1)
@@ -818,6 +830,74 @@ class _WindowOptimizer(_FusedOptimizer):
 
     def _restore_flags(self) -> None:
         pass  # push-sum restores the global associated-p toggle
+
+    # -- convergence gauge (live telemetry plane, docs/observability.md) ---
+    # (gap shared with the sampler's cadence; tests zero _consensus_t to
+    # force a per-step reading against the numpy oracle)
+    #
+    # For combine weights that sum to 1 (the default and every healed
+    # table), mixed_r - x_r = (1 - sw_r) * (x̄_nbr - x_r) where x̄_nbr is
+    # the combine-weighted neighbor mean — so the neighborhood consensus
+    # distance ||x̄_nbr - x_r|| falls out of ONE elementwise pass over the
+    # already-available pre/post-gossip leaves, no extra combine. With
+    # custom non-normalized weights the gauge is the same ratio and stays
+    # a faithful decay signal (the oracle tests pin the normalized case).
+
+    def _consensus_self_weights(self, dead) -> Dict[int, float]:
+        """Effective self-weight per owned rank (the user's scalar when
+        set, else the live-in-degree default the healed tables use)."""
+        win = _windows._get_window(self._win_names[0])
+        sw = getattr(self, "self_weight", None)
+        out: Dict[int, float] = {}
+        for r in win.owned:
+            live_in = [s for s in win.in_neighbors[r] if s not in dead]
+            if not live_in:
+                continue
+            out[r] = float(sw) if sw is not None \
+                else 1.0 / (len(live_in) + 1)
+        return out
+
+    def _record_consensus(self, old_leaves, new_leaves) -> None:
+        """Set ``opt.consensus_dist`` from the pre/post-gossip leaves
+        (RMS over owned ranks). Time-gated to the telemetry sampler's
+        ~1 Hz cadence: the pass is one elementwise program over the
+        model plus a device sync, which at compiled-plane step rates
+        would cost real throughput if it ran every comm step — and the
+        series only consumes one value per second anyway. Never raises —
+        a telemetry gauge must not take a training step down."""
+        if not self._consensus_gauge or not self._win_names:
+            return
+        now = time.monotonic()
+        if now - self._consensus_t < _CONSENSUS_MIN_GAP:
+            return
+        self._consensus_t = now
+        try:
+            fn = self._consensus_fn
+            if fn is None:
+                def _sq(olds, news):
+                    acc = None
+                    for a, b in zip(olds, news):
+                        d = b.astype(jnp.float32) - a.astype(jnp.float32)
+                        s = jnp.sum(jnp.square(d).reshape(d.shape[0], -1),
+                                    axis=1)
+                        acc = s if acc is None else acc + s
+                    return acc
+                fn = self._consensus_fn = jax.jit(_sq)
+            sq = np.asarray(fn(old_leaves, new_leaves))
+            sw = self._consensus_self_weights(self._dead_ranks())
+            total = 0.0
+            cnt = 0
+            for r, w in sw.items():
+                denom = 1.0 - w
+                if denom <= 1e-9 or r >= len(sq):
+                    continue
+                total += float(sq[r]) / (denom * denom)
+                cnt += 1
+            if cnt:
+                _metrics.gauge("opt.consensus_dist").set(
+                    float(np.sqrt(total / cnt)))
+        except Exception as exc:  # noqa: BLE001 — gauge only
+            logger.debug("consensus gauge skipped (%s)", exc)
 
     def _local_step(self, state, batch):
         key = (False, "none")
@@ -1232,8 +1312,16 @@ class _WindowOptimizer(_FusedOptimizer):
                             out[i] = v
                 if shard >= 0:
                     self._comm_rounds += 1
+            if shard < 0:
+                # sharded steps donate the old leaves to the scatter (in-
+                # place piece writes) — their convergence signal is the
+                # shard-drift rate instead (docs/observability.md)
+                self._record_consensus(leaves, out)
             params = jax.tree_util.tree_unflatten(self._treedef, out)
             state = TrainState(params, state.opt_state, state.model_state)
+        # live telemetry plane: ~1 Hz self-gated sample so single-
+        # controller jobs (no heartbeat tick) still stream bf.ts.<rank>
+        _timeseries.maybe_sample()
         return state, metrics
 
 
@@ -1486,6 +1574,9 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
     """
 
     _zero_init = True  # reference creates push-sum windows with zero_init
+    # the raw numerator is p-biased — pushsum.debias_drift and the mass
+    # gauges are this strategy's convergence signals, not consensus_dist
+    _consensus_gauge = False
 
     def __init__(self, *args, **kw) -> None:
         super().__init__(*args, **kw)

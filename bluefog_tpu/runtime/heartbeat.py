@@ -39,6 +39,7 @@ from typing import Dict, Optional
 from . import control_plane as _cp
 from . import flight as _flight
 from . import metrics as _metrics
+from . import timeseries as _timeseries
 from .logging import logger
 from .timeline import timeline_instant
 
@@ -283,6 +284,11 @@ class PeerMonitor:
         _metrics.gauge("hb.dead_peers").set(len(self._dead))
         _metrics.gauge("hb.suspect_peers").set(len(self._suspect))
         _metrics.maybe_publish(cl)
+        # Live time-series plane (docs/observability.md): sample the ring
+        # history + per-edge estimators and publish the bf.ts.<rank>
+        # delta on its own cadence — same zero-extra-threads discipline
+        # as the metrics piggyback above.
+        _timeseries.maybe_sample(cl)
         # cluster-wide postmortem trigger (`bfrun --dump`): one KV read per
         # tick; on a bump this rank dumps locally and publishes its packed
         # tail under bf.flight.<rank> (docs/flight_recorder.md)

@@ -441,6 +441,26 @@ _HELP_EXACT: Dict[str, str] = {
                            "changes",
     "opt.gossip_retries": "gossip steps retried once on a self-healed "
                           "topology after PeerLostError",
+    "opt.consensus_dist": "neighborhood consensus distance: L2 from this "
+                          "rank's params to the combine-weighted neighbor "
+                          "mean (RMS over owned ranks; decays toward 0 as "
+                          "the gossip converges — docs/observability.md)",
+    "opt.mixing_rate": "effective per-second mixing rate fit from the "
+                       "consensus-distance decay (< 1 = converging; ~1 = "
+                       "stalled)",
+    "alert.fired": "rank-local alert rules fired (sustained threshold "
+                   "breaches; docs/observability.md)",
+    "cp.shards": "control-plane shards this process routes over",
+    "cp.dead_shards": "control-plane shards currently failed over",
+    "cp.shard_failovers": "shard keyspace failovers this client observed",
+    "cp.shard_promotions": "times this server was promoted failover "
+                           "primary for a dead shard's keyspace",
+    "cp.shard_rejoins": "shard rejoin (snapshot catch-up) completions "
+                        "observed",
+    "cp.repl_lag": "max WAL records enqueued-but-unacked across live "
+                   "shards (replication lag)",
+    "cp.under_replicated": "shards serving DEGRADED (successor lagging "
+                           "or absent — acked writes live nowhere else)",
     "pushsum.mass": "this rank's share of global push-sum de-bias mass",
     "pushsum.minted": "push-sum mass minted (created, not transferred) by "
                       "this rank",
@@ -489,6 +509,13 @@ _HELP_PREFIX = (
     ("cp.server.", "control-plane server state/event counter"),
     ("win.", "hosted window data-plane op latency (seconds)"),
 )
+
+# Instrument-name prefix families the tree may create (first dotted
+# segment). The bfcheck [metrics] analyzer enforces this plus HELP
+# resolution for every creation site in the package — a new family must
+# be added here (with curated HELP coverage) before it can ship.
+_PREFIX_FAMILIES = ("alert", "cp", "hb", "membership", "opt", "pushsum",
+                    "watchdog", "win")
 
 
 def help_for(name: str) -> str:
@@ -685,6 +712,11 @@ class _Publisher:
         while not self._stop.wait(max(0.2, publish_interval() / 2.0)):
             try:
                 maybe_publish()
+                # the live time-series plane samples on the same cadence
+                # (heartbeat jobs piggyback the monitor tick instead)
+                from . import timeseries as _ts
+
+                _ts.maybe_sample()
             except Exception as exc:  # noqa: BLE001 — observability thread
                 logger.debug("metrics publisher tick failed (%s)", exc)
 
@@ -742,6 +774,8 @@ def health_from_snapshots(snaps: Dict[int, dict], world: int,
     ranks: Dict[int, dict] = {}
     steps: Dict[int, float] = {}
     epoch = 0
+    repl_lag = under_repl = 0.0
+    have_repl = False
     for pid, s in sorted(snaps.items()):
         staleness = max(0.0, now - s["meta"]["ts"])
         step = s["gauges"].get("opt.step")
@@ -750,10 +784,22 @@ def health_from_snapshots(snaps: Dict[int, dict], world: int,
             "alive": staleness < stale_after,
             "incarnation": s["meta"].get("inc", 0),
             "step": None if step is None else int(step),
+            # r17 rotation-drift signal: deposits dropped because the
+            # origin's shard rotation disagreed with this owner's
+            "shard_drops": int(s["counters"].get(
+                "win.shard_stale_drops", 0)),
         }
         if step is not None:
             steps[pid] = step
         epoch = max(epoch, int(s["gauges"].get("membership.epoch", 0)))
+        # r16 durability gauges (published by the heartbeat tick): the
+        # single-endpoint probe's view of the sharded plane's health
+        if "cp.repl_lag" in s["gauges"] or \
+                "cp.under_replicated" in s["gauges"]:
+            have_repl = True
+            repl_lag = max(repl_lag, s["gauges"].get("cp.repl_lag", 0.0))
+            under_repl = max(under_repl,
+                             s["gauges"].get("cp.under_replicated", 0.0))
     missing = sorted(set(range(world)) - set(snaps))
     stragglers: List[int] = []
     if steps:
@@ -778,7 +824,9 @@ def health_from_snapshots(snaps: Dict[int, dict], world: int,
                 "tolerance": tol, "conserved": abs(drift) <= tol}
     return {"world": world, "ranks": ranks, "missing": missing,
             "stragglers": stragglers, "mass": mass,
-            "membership_epoch": epoch}
+            "membership_epoch": epoch,
+            "repl": ({"lag": repl_lag, "under_replicated": int(under_repl)}
+                     if have_repl else None)}
 
 
 def read_cluster_health(cl, world: Optional[int] = None) -> dict:
@@ -847,9 +895,11 @@ def format_health(health: dict) -> str:
             flags.append("STALE")
         if pid in health["stragglers"]:
             flags.append("STRAGGLER")
+        drops = r.get("shard_drops", 0)
         lines.append(
             f"  rank {pid}: step {step}, inc {r['incarnation']}, "
             f"published {r['staleness_sec']:.1f}s ago"
+            + (f", shard_drops {drops}" if drops else "")
             + (f"  [{' '.join(flags)}]" if flags else ""))
     for pid in health["missing"]:
         lines.append(f"  rank {pid}: no snapshot published")
@@ -859,6 +909,12 @@ def format_health(health: dict) -> str:
         lines.append(
             f"  push-sum mass: total {m['total']:.12g} vs minted "
             f"{m['minted']:.12g} (drift {m['drift']:.3g}) — {verdict}")
+    repl = health.get("repl")
+    if repl is not None:
+        state = (f"{repl['under_replicated']} shard(s) UNDER-REPLICATED"
+                 if repl["under_replicated"] else "replicating")
+        lines.append(f"  control-plane replication: max WAL lag "
+                     f"{repl['lag']:.0f} — {state}")
     if health["stragglers"]:
         lines.append(f"  stragglers: {health['stragglers']}")
     return "\n".join(lines)

@@ -160,6 +160,10 @@ def init(
     # counter block re-baselines, so snapshots report this job's deltas.
     from . import metrics as _metrics
     _metrics.reset_for_job()
+    # Fresh live time-series plane (ring history, per-edge estimators,
+    # alert-rule state; re-reads BLUEFOG_ALERT_RULES/TS_* knobs).
+    from . import timeseries as _timeseries
+    _timeseries.reset_for_job()
     # Fresh flight-recorder ring + wall-clock anchor (a postmortem dump
     # belongs to THIS job), and the abnormal-exit hook so an uncaught
     # exception leaves a dump behind (docs/flight_recorder.md).
@@ -318,6 +322,12 @@ def shutdown(_announce: bool = True) -> None:
             _metrics.publish_now()
         except Exception:  # noqa: BLE001 — teardown must not raise
             pass
+    from . import timeseries as _timeseries
+    try:
+        # same final flush for the live series: one last sample + delta
+        _timeseries.maybe_sample(force=True, publish=True)
+    except Exception:  # noqa: BLE001 — teardown must not raise
+        pass
     _metrics.stop_publisher()
     if st.peer_monitor is not None:
         st.peer_monitor.stop()

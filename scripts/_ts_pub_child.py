@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Raw time-series publisher child (obs-smoke's SIGKILL victim).
+
+Attaches a plain control-plane client (no jax, no mesh join) and
+publishes a minimal ``bf.ts.<rank>`` delta stream on a short cadence —
+a stand-in for a remote controller's heartbeat-tick publication. The
+harness SIGKILLs it and asserts ``bfrun --top`` names the rank SILENT
+once the stream goes stale.
+
+Usage: _ts_pub_child.py HOST PORT RANK INTERVAL_SEC
+"""
+
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bluefog_tpu.runtime import timeseries as ts  # noqa: E402
+from bluefog_tpu.runtime.native import ControlPlaneClient  # noqa: E402
+
+
+def main() -> int:
+    host, port, rank, interval = (sys.argv[1], int(sys.argv[2]),
+                                  int(sys.argv[3]), float(sys.argv[4]))
+    import os
+
+    cl = ControlPlaneClient(host, port, 0,
+                            secret=os.environ.get("BLUEFOG_CP_SECRET", ""),
+                            streams=1)
+    store = ts.TimeSeriesStore()
+    step = 0
+    print("TS_CHILD_READY", flush=True)
+    while True:
+        now = time.time()
+        step += 1
+        store.series("opt.step", "gauge", "last").add(now, step)
+        store._record_rate("opt.step", now, float(step))
+        doc = store.build_doc(rank, 0, now, interval)
+        cl.put_bytes(ts.TS_KEY_FMT.format(rank=rank), ts.pack_doc(doc))
+        time.sleep(interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

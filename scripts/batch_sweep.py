@@ -12,9 +12,17 @@ from __future__ import annotations
 
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# Flight dumps from a bench run land in a tempdir instead of littering
+# the CWD (conftest's default for the test suite); an explicit
+# BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 import bluefog_tpu as bf  # noqa: E402
 import bench  # noqa: E402

@@ -22,6 +22,12 @@ Four analyzers over the repository (run all via ``python scripts/bfcheck``,
     control-plane calls made while holding a local mutex, and daemon
     threads without stop/join wiring.
 
+``metrics``
+    Telemetry vocabulary: every registry instrument created in the
+    package must use a declared prefix family and resolve to HELP text,
+    and every live time-series binding / alert rule must reference a
+    declared instrument or derived series (docs/observability.md).
+
 ``lint``
     Minimal pyflakes-style fallback (unused imports, duplicate
     definitions) used by ``make lint`` when ``ruff`` is not installed.
@@ -71,17 +77,19 @@ def repo_root(start: str = __file__) -> str:
 def _analyzers() -> Dict[str, Callable[[str], List[Diagnostic]]]:
     # imported lazily so ``import bfcheck`` stays cheap and fixture tests
     # can import individual analyzers directly
-    from . import knob_check, lint_check, lock_check, protocol_check
+    from . import (knob_check, lint_check, lock_check, metrics_check,
+                   protocol_check)
 
     return {
         "protocol": protocol_check.check,
         "knobs": knob_check.check,
         "locks": lock_check.check,
+        "metrics": metrics_check.check,
         "lint": lint_check.check,
     }
 
 
-ANALYZERS = ("protocol", "knobs", "locks", "lint")
+ANALYZERS = ("protocol", "knobs", "locks", "metrics", "lint")
 
 
 def run(name: str, root: str) -> List[Diagnostic]:

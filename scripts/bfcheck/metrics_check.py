@@ -1,0 +1,236 @@
+"""Metrics-vocabulary analyzer (`[metrics]`).
+
+The telemetry plane's contract is that every published sample is
+self-describing (``# HELP`` per family, r12) and that the live
+time-series layer's declarative tables (``TS_BINDINGS``, the alert
+rules) reference real instruments — a typo'd binding silently samples
+nothing, and an instrument outside the curated vocabulary scrapes with a
+generic HELP line. This analyzer closes both holes statically:
+
+1. **Creation sites** — every ``_metrics.counter/gauge/histogram/timed(
+   "name")`` call in ``bluefog_tpu/`` must
+     * use a name whose first dotted segment is a declared prefix family
+       (``metrics._PREFIX_FAMILIES``), and
+     * resolve to HELP text: a ``doc=`` argument at the site, an entry in
+       the curated ``_HELP_EXACT`` table, or a ``_HELP_PREFIX`` rule.
+2. **Bindings & rules** — every instrument named by
+   ``timeseries.TS_BINDINGS`` and every series named by an alert rule in
+   ``timeseries.DEFAULT_RULES`` must resolve to a known instrument
+   (a creation-site literal, a curated-table entry, or a prefix rule), a
+   declared derived series (``DERIVED_SERIES``), or a ``.rate`` of a
+   ``RATE_SERIES`` member.
+
+Waive a finding with ``# bfcheck: ok-metrics (reason)`` on its line.
+Everything is AST-parsed — fixture trees (tests/test_bfcheck.py) supply
+their own miniature ``metrics.py``/``timeseries.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set, Tuple
+
+from . import Diagnostic
+
+METRICS_PATH = os.path.join("bluefog_tpu", "runtime", "metrics.py")
+TS_PATH = os.path.join("bluefog_tpu", "runtime", "timeseries.py")
+PKG_ROOT = "bluefog_tpu"
+
+WAIVER = "bfcheck: ok-metrics"
+
+_CREATORS = {"counter", "gauge", "histogram", "timed"}
+
+
+def _literal_assign(tree: ast.AST, name: str):
+    """The literal value assigned to module-level ``name`` (plain or
+    annotated assignment); None when absent or not a literal."""
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            target = node.target.id
+            value = node.value
+        if target == name and value is not None:
+            try:
+                return ast.literal_eval(value)
+            except (ValueError, SyntaxError):
+                return None
+    return None
+
+
+def load_vocabulary(root: str):
+    """(exact HELP names, HELP prefixes, prefix families) from the
+    metrics module — parsed, never imported."""
+    path = os.path.join(root, METRICS_PATH)
+    with open(path) as f:
+        tree = ast.parse(f.read(), path)
+    exact = _literal_assign(tree, "_HELP_EXACT") or {}
+    prefix_rows = _literal_assign(tree, "_HELP_PREFIX") or ()
+    families = _literal_assign(tree, "_PREFIX_FAMILIES") or ()
+    prefixes = tuple(p for p, _ in prefix_rows)
+    return set(exact), prefixes, tuple(families)
+
+
+def load_ts_tables(root: str):
+    """(bindings, rule series, rate series, derived series) from the
+    timeseries module; all empty when the module does not exist (fixture
+    trees without a live plane)."""
+    path = os.path.join(root, TS_PATH)
+    if not os.path.isfile(path):
+        return (), (), (), ()
+    with open(path) as f:
+        tree = ast.parse(f.read(), path)
+    bindings = _literal_assign(tree, "TS_BINDINGS") or ()
+    rate = _literal_assign(tree, "RATE_SERIES") or ()
+    derived = _literal_assign(tree, "DERIVED_SERIES") or ()
+    rules: List[Tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "Rule" and len(node.args) >= 2 and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[1], ast.Constant):
+            rules.append((str(node.args[0].value),
+                          str(node.args[1].value), node.lineno))
+    bound = []
+    for row in bindings:
+        if isinstance(row, (tuple, list)) and row and \
+                isinstance(row[0], str):
+            bound.append(row[0])
+    return tuple(bound), tuple(rules), tuple(rate), tuple(derived)
+
+
+def _iter_package_files(root: str):
+    pkg = os.path.join(root, PKG_ROOT)
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "build")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _is_metrics_receiver(func: ast.AST) -> bool:
+    """True for ``<something named *metrics*>.counter(...)`` shapes —
+    the package-wide convention is ``_metrics.counter("name")``."""
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr not in _CREATORS:
+        return False
+    value = func.value
+    while isinstance(value, ast.Attribute):
+        value = value.value
+    return isinstance(value, ast.Name) and "metrics" in value.id.lower()
+
+
+def collect_instruments(root: str):
+    """{name: [(path, line, has_doc)]} for every creation site in the
+    package (the metrics module itself is registry plumbing, skipped)."""
+    out: Dict[str, List[Tuple[str, int, bool]]] = {}
+    skip = os.path.join(root, METRICS_PATH)
+    for path in _iter_package_files(root):
+        if os.path.abspath(path) == os.path.abspath(skip):
+            continue
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read(), path)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    not _is_metrics_receiver(node.func):
+                continue
+            if not node.args or \
+                    not isinstance(node.args[0], ast.Constant) or \
+                    not isinstance(node.args[0].value, str):
+                continue
+            has_doc = any(kw.arg == "doc" for kw in node.keywords)
+            out.setdefault(node.args[0].value, []).append(
+                (path, node.lineno, has_doc))
+    return out
+
+
+def _waived(lines: List[str], lineno: int) -> bool:
+    for ln in (lineno - 1, lineno - 2):
+        if 0 <= ln < len(lines) and WAIVER in lines[ln]:
+            return True
+    return False
+
+
+def _resolves_help(name: str, exact: Set[str],
+                   prefixes: Tuple[str, ...]) -> bool:
+    return name in exact or any(name.startswith(p) for p in prefixes)
+
+
+def check(root: str) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    try:
+        exact, prefixes, families = load_vocabulary(root)
+    except (OSError, SyntaxError) as exc:
+        return [Diagnostic("metrics", METRICS_PATH, 1,
+                           f"cannot parse the metrics module: {exc}")]
+    instruments = collect_instruments(root)
+    file_lines: Dict[str, List[str]] = {}
+
+    def lines_of(path: str) -> List[str]:
+        if path not in file_lines:
+            try:
+                with open(path) as f:
+                    file_lines[path] = f.read().splitlines()
+            except OSError:
+                file_lines[path] = []
+        return file_lines[path]
+
+    rel = os.path.relpath
+    for name, sites in sorted(instruments.items()):
+        family = name.split(".", 1)[0]
+        for path, line, has_doc in sites:
+            if _waived(lines_of(path), line):
+                continue
+            if families and family not in families:
+                out.append(Diagnostic(
+                    "metrics", rel(path, root), line,
+                    f"instrument '{name}' uses undeclared prefix family "
+                    f"'{family}' (declare it in metrics._PREFIX_FAMILIES "
+                    "with curated HELP coverage, or rename)"))
+            if not has_doc and not _resolves_help(name, exact, prefixes):
+                out.append(Diagnostic(
+                    "metrics", rel(path, root), line,
+                    f"instrument '{name}' has no HELP text: pass doc= at "
+                    "the creation site or add it to metrics._HELP_EXACT "
+                    "(every scraped sample must be self-describing)"))
+    # live-plane tables: bindings + alert rules name real series
+    bindings, rules, rate_series, derived = load_ts_tables(root)
+    known: Set[str] = set(instruments) | set(exact) | set(derived)
+
+    def known_series(name: str) -> bool:
+        if name in known or _resolves_help(name, set(), prefixes):
+            return True
+        if name.endswith(".rate"):
+            stem = name[:-len(".rate")]
+            return stem in rate_series and (
+                known_series(stem) or stem in bindings)
+        return False
+
+    ts_rel = TS_PATH
+    for name in bindings:
+        if not known_series(name):
+            out.append(Diagnostic(
+                "metrics", ts_rel, 1,
+                f"TS_BINDINGS names '{name}', which no creation site, "
+                "curated HELP entry, or prefix rule declares — the "
+                "sampler would silently record nothing"))
+    for rule_name, series, line in rules:
+        if not known_series(series):
+            out.append(Diagnostic(
+                "metrics", ts_rel, line,
+                f"alert rule '{rule_name}' references series "
+                f"'{series}', which is neither a declared instrument, a "
+                "derived series, nor a RATE_SERIES '.rate' — the rule "
+                "can never fire"))
+    return out

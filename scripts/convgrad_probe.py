@@ -29,6 +29,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+
+# Flight dumps from a bench run land in a tempdir instead of littering
+# the CWD (conftest's default for the test suite); an explicit
+# BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
+
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from resnet_profile import device_op_seconds  # noqa: E402
 

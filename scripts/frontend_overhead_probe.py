@@ -21,6 +21,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -37,6 +38,13 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 import numpy as np  # noqa: E402
 
 import jax  # noqa: E402
+
+
+# Flight dumps from a bench run land in a tempdir instead of littering
+# the CWD (conftest's default for the test suite); an explicit
+# BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 import bluefog_tpu as bf  # noqa: E402
 

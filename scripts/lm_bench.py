@@ -30,7 +30,15 @@ import jax.numpy as jnp
 import optax
 
 import sys, os
+import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# Flight dumps from a bench run land in a tempdir instead of littering
+# the CWD (conftest's default for the test suite); an explicit
+# BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 from bluefog_tpu.models import TransformerLM  # noqa: E402
 from bluefog_tpu.parallel.flash import flash_attention  # noqa: E402

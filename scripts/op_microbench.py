@@ -10,11 +10,20 @@ default devices, or an 8-device CPU mesh via
 from __future__ import annotations
 
 import argparse
+import os
+import tempfile
 import time
 
 import numpy as np
 
 import jax
+
+
+# Flight dumps from a bench run land in a tempdir instead of littering
+# the CWD (conftest's default for the test suite); an explicit
+# BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 import bluefog_tpu as bf
 

@@ -49,9 +49,16 @@ import re
 import socket
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+
+# Flight dumps from a bench run (deliberate fault probes included) land in
+# a tempdir instead of littering the CWD, the same default the test
+# suite's conftest applies; an explicit BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 MODES = [
     "neighbor_allreduce", "allreduce", "gradient_allreduce",

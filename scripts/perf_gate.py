@@ -14,12 +14,12 @@ copy, a lost overlap) costs 2-10x.
 
 Only the *stable* quick-mode series gate: the hosted window ops
 (win_put / win_accumulate / win_update / win_get MB/s), the optimizer
-step rates, and — since r15, after two stable rounds per the
-stable-series rule — the ``hybrid.*`` plane-sweep rates. Sub-millisecond
-raw-socket probes, the ``codec.*`` compressed-wire series, and the
+step rates, the ``hybrid.*`` plane-sweep rates (gating since r15), and —
+since r18, two stable rounds after r15 introduced them — the ``codec.*``
+compressed-wire window-op rates. Sub-millisecond raw-socket probes, the
+codec wire-leg probes (``drain_stream``: 2x run-to-run jitter), and the
 ``sharded.*`` sharded-window series are reported in the JSON but never
-gate (each graduates the same way hybrid.* did once it shows two stable
-rounds).
+gate (sharded.* graduates the same way once it shows two stable rounds).
 
 Exit codes: 0 pass, 1 regression (or a bench failed), 2 usage/baseline
 problems.
@@ -70,14 +70,12 @@ def _run(cmd, timeout) -> str:
 def collect_once() -> dict:
     """One pass over both harnesses -> {metric: value} (higher = better)."""
     out: dict = {}
-    # the --codec sweep rides the SAME 4-process run (extra rows after the
-    # plain series, which stay untouched): `codec.*` series are info-only
-    # per the stable-series rule (see gating())
     # the --codec and --sharded sweeps ride the SAME 4-process run (extra
-    # rows after the plain series, which stay untouched): `codec.*` and
-    # `sharded.*` series are info-only per the stable-series rule (see
-    # gating()); the sharded run also counter-delta ASSERTS the ≥0.9·S
-    # wire-byte reduction inside the child — a broken claim fails the run
+    # rows after the plain series, which stay untouched): codec.* GATES
+    # since r18 (window-op rates only — see gating()); `sharded.*` stays
+    # info-only per the stable-series rule; the sharded run also
+    # counter-delta ASSERTS the ≥0.9·S wire-byte reduction inside the
+    # child — a broken claim fails the run
     text = _run([sys.executable, "scripts/win_microbench.py", "--quick",
                  "--codec", "int8,topk:0.01", "--sharded", "2,4"],
                 timeout=900)
@@ -158,13 +156,21 @@ def collect(repeats: int) -> dict:
 def gating(metrics: dict) -> dict:
     keep = {}
     for name, v in metrics.items():
-        if name.startswith("codec.") or name.startswith("sharded."):
-            # r15 compressed-wire and r17 sharded-window series:
-            # info-only until two stable rounds (the gate's stable-series
-            # rule) — then delete this branch and refresh the baseline,
-            # exactly as the hybrid.* series graduated in r15
+        if name.startswith("sharded."):
+            # r17 sharded-window series: info-only until two stable
+            # rounds (the gate's stable-series rule) — then delete this
+            # branch and refresh the baseline, exactly as hybrid.* (r15)
+            # and codec.* (r18) graduated
+            continue
+        if name.startswith("codec.") and \
+                not any(name.endswith(f"{op}.mbps")
+                        for op in _GATING_OPS):
+            # codec.* GATES since r18 (two stable rounds elapsed since
+            # r15), but only its stable window-op series — the wire-leg
+            # probes (drain_stream) jitter 2x run to run and stay info
             continue
         if name.startswith("opt.") or name.startswith("hybrid.") or \
+                name.startswith("codec.") or \
                 any(name.endswith(f"{op}.mbps") or f".{op}." in name
                     for op in _GATING_OPS):
             keep[name] = v
@@ -206,7 +212,8 @@ def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
             "repeats": repeats,
             "band": band,
             "harnesses": ["win_microbench --quick --codec int8,topk:0.01 "
-                          "--sharded 2,4 (codec.*/sharded.* info-only)",
+                          "--sharded 2,4 (codec.* window-op rates gating "
+                          "since r18; sharded.* info-only)",
                           "opt_matrix_bench --quick --modes "
                           + " ".join(_OPT_MODES),
                           "opt_matrix_bench --quick --hybrid"],

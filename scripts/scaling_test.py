@@ -17,10 +17,18 @@ import os
 import re
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
+
+
+# Flight dumps from a bench run land in a tempdir instead of littering
+# the CWD (conftest's default for the test suite); an explicit
+# BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 def run_one(ranks: int, model: str, dist_opt: str, batch: int) -> float:
     env = os.environ.copy()

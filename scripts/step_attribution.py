@@ -14,9 +14,17 @@ Cross-rank (multiple dumps / a merged trace): deposit→drain flow pairs are
 matched by id, reporting per-edge transit latency — the one number a
 single rank cannot measure about itself.
 
+``--live`` answers the per-edge half of the same questions WITHOUT a
+dump: it reads every rank's streamed ``bf.ts.<rank>`` series (the live
+telemetry plane, docs/observability.md) over a raw control-plane client
+and prints per-edge bytes / bytes/s and deposit→drain transit latency
+(p50/p99) from the live estimators plus cross-rank flow matching — the
+numbers this script previously only produced postmortem.
+
 Usage:
     python scripts/step_attribution.py bf_flight_0.json [bf_flight_1.json ...]
     python scripts/step_attribution.py bf_flight_dump/merged.json
+    python scripts/step_attribution.py --live --cp HOST:PORT [--json]
 """
 
 from __future__ import annotations
@@ -121,13 +129,107 @@ def flow_pairs(docs: dict) -> dict:
     return per_edge
 
 
+def live_report(cl, world: int) -> dict:
+    """Per-edge live attribution from the streamed series: bytes,
+    bytes/s, deposits, transit p50/p99 (rank-local estimators merged
+    with cross-rank flow matching) plus each rank's step cadence and
+    consensus gauges — the dump-free answer."""
+    from bluefog_tpu.runtime import timeseries as ts
+
+    acc = ts.HistoryAccumulator()
+    for r in range(world):
+        doc = ts.read_rank(cl, r)
+        if doc is not None:
+            acc.update(r, doc)
+    edges: dict = {}
+    for r, per in sorted(acc.edges.items()):
+        for edge, st in per.items():
+            cur = edges.setdefault(edge, {"bytes": 0.0, "deposits": 0,
+                                          "bps": 0.0})
+            cur["bytes"] += st.get("bytes") or 0.0
+            cur["deposits"] += st.get("deposits") or 0
+            cur["bps"] += st.get("bps") or 0.0
+    for edge, cur in edges.items():
+        p50, p99 = acc.edge_transit(edge)
+        cur["transit_p50_us"] = p50
+        cur["transit_p99_us"] = p99
+    ranks = {}
+    for r in sorted(acc.meta):
+        ranks[str(r)] = {
+            "step": acc.latest(r, "opt.step"),
+            "step_rate": acc.latest(r, "opt.step.rate"),
+            "consensus_dist": acc.latest(r, "opt.consensus_dist"),
+            "mixing_rate": acc.latest(r, "opt.mixing_rate"),
+            "alerts": acc.alerts.get(r, []),
+        }
+    return {"schema_version": 1, "live": True, "world": world,
+            "ranks": ranks, "edges": edges,
+            "silent": acc.silent_ranks(world)}
+
+
+def _live(args) -> int:
+    from bluefog_tpu.launcher import _cp_address, _discover_world, \
+        _raw_client
+
+    addr = _cp_address(args, "--live")
+    if addr is None:
+        return 1
+    cl = _raw_client(addr, what="--live")
+    if cl is None:
+        return 1
+    try:
+        rep = live_report(cl, _discover_world(cl))
+    finally:
+        cl.close()
+    if args.json:
+        print(json.dumps(rep))
+        return 0
+    print(f"== live attribution ({rep['world']} rank(s)) ==")
+    for r, st in rep["ranks"].items():
+        line = f"  rank {r}: step {st['step'] or 0:.0f}"
+        if st["step_rate"] is not None:
+            line += f", {st['step_rate']:.2f} step/s"
+        if st["consensus_dist"] is not None:
+            line += f", consensus {st['consensus_dist']:.3g}"
+        if st["mixing_rate"] is not None:
+            line += f", mixing {st['mixing_rate']:.3f}"
+        for a in st["alerts"]:
+            line += f"  [ALERT:{a['name']}]"
+        print(line)
+    if rep["silent"]:
+        print(f"  silent rank(s): {rep['silent']}")
+    if rep["edges"]:
+        print("  edges (live estimators + cross-rank flow matching):")
+        for edge in sorted(rep["edges"]):
+            e = rep["edges"][edge]
+            p50 = e.get("transit_p50_us")
+            print(f"    {edge:<8} {e['deposits']:5d} deposits, "
+                  f"{e['bytes'] / 1e6:8.2f} MB, {e['bps'] / 1e6:7.2f} "
+                  "MB/s, median transit "
+                  + (f"{p50 / 1e3:.2f} ms" if p50 is not None else "-"))
+    else:
+        print("  no per-edge flow data streamed yet (hosted window "
+              "deposits feed the estimators)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("files", nargs="+",
+    ap.add_argument("files", nargs="*",
                     help="flight dumps and/or merged chrome traces")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (one JSON object)")
+    ap.add_argument("--live", action="store_true",
+                    help="read the streamed bf.ts.<rank> series instead "
+                         "of dumps (needs --cp or BLUEFOG_CP_* env)")
+    ap.add_argument("--cp", type=str, default=None,
+                    metavar="HOST:PORT[,HOST:PORT...]",
+                    help="control-plane address(es) for --live")
     args = ap.parse_args(argv)
+    if args.live:
+        return _live(args)
+    if not args.files:
+        ap.error("files are required unless --live is given")
     docs = load(args.files)
     reports = {}
     for rank in sorted(docs):

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Export the live time-series plane (OpenMetrics / JSON lines).
+
+Reads every rank's ``bf.ts.<rank>`` delta stream over a raw control-plane
+client (the ``bfrun --status`` pattern — no jax, no mesh join) and writes
+the accumulated history in one of two machine formats:
+
+* ``--format jsonl`` (default): one sample per line —
+  ``{"ts": <epoch sec>, "rank": r, "series": name, "value": v}`` —
+  ready for jq / a columnar loader / pandas.
+* ``--format openmetrics``: the OpenMetrics text format with explicit
+  millisecond timestamps per sample (``# TYPE``/``# HELP`` per family,
+  terminated by ``# EOF``), ready for a backfill-capable scraper.
+
+Per-edge estimator summaries export as ``bf_edge_*`` samples labeled with
+the edge. ``--watch N`` keeps polling every N seconds and appending
+(jsonl only); the default is one pass over whatever history the ranks
+currently publish (late joiners still get the downsampled tiers — the
+publication carries them periodically).
+
+Usage:
+    python scripts/ts_export.py --cp HOST:PORT[,HOST:PORT...] [--out F]
+        [--format jsonl|openmetrics] [--watch SEC] [--world N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bluefog_tpu.runtime import timeseries as ts  # noqa: E402
+
+
+def _client(spec: str):
+    from bluefog_tpu.launcher import _raw_client
+    from bluefog_tpu.runtime.router import parse_endpoints
+
+    return _raw_client(parse_endpoints(spec), what="ts_export")
+
+
+def _poll(cl, acc: ts.HistoryAccumulator, world: int) -> None:
+    for r in range(world):
+        doc = ts.read_rank(cl, r)
+        if doc is not None:
+            acc.update(r, doc)
+
+
+def _metric_name(series: str) -> str:
+    out = []
+    for ch in series:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    return "bf_" + "".join(out)
+
+
+def emit_jsonl(acc: ts.HistoryAccumulator, out, seen: set) -> int:
+    """Append every not-yet-written sample; returns the count written."""
+    n = 0
+    for (rank, name), hist in sorted(acc.series.items()):
+        for t, v in hist:
+            key = (rank, name, t)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.write(json.dumps({"ts": t, "rank": rank, "series": name,
+                                  "value": v}) + "\n")
+            n += 1
+    for rank, edges in sorted(acc.edges.items()):
+        for edge, st in sorted(edges.items()):
+            key = (rank, f"edge:{edge}", st.get("bytes", 0.0))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.write(json.dumps({"ts": acc.meta[rank]["ts"], "rank": rank,
+                                  "series": "edge", "edge": edge, **st})
+                      + "\n")
+            n += 1
+    return n
+
+
+def emit_openmetrics(acc: ts.HistoryAccumulator, out) -> int:
+    """Full-history OpenMetrics dump (one family per series name)."""
+    n = 0
+    by_name: dict = {}
+    for (rank, name), hist in acc.series.items():
+        by_name.setdefault(name, []).append((rank, hist))
+    for name in sorted(by_name):
+        m = _metric_name(name)
+        out.write(f"# TYPE {m} gauge\n")
+        out.write(f"# HELP {m} bluefog live series {name}\n")
+        for rank, hist in sorted(by_name[name]):
+            for t, v in hist:
+                out.write(f'{m}{{rank="{rank}"}} {v:g} {int(t * 1000)}\n')
+                n += 1
+    for rank, edges in sorted(acc.edges.items()):
+        for edge, st in sorted(edges.items()):
+            for field in ("bps", "bytes", "deposits", "p50_us", "p99_us"):
+                v = st.get(field)
+                if v is None:
+                    continue
+                m = f"bf_edge_{field}"
+                out.write(f'{m}{{rank="{rank}",edge="{edge}"}} {v:g}\n')
+                n += 1
+    out.write("# EOF\n")
+    return n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--cp", type=str,
+                    default=os.environ.get("BLUEFOG_CP_HOSTS")
+                    or (f"{os.environ.get('BLUEFOG_CP_HOST')}:"
+                        f"{os.environ.get('BLUEFOG_CP_PORT')}"
+                        if os.environ.get("BLUEFOG_CP_HOST")
+                        and os.environ.get("BLUEFOG_CP_PORT") else None),
+                    help="control-plane endpoint(s) "
+                         "(default: BLUEFOG_CP_HOSTS / _CP_HOST+_CP_PORT)")
+    ap.add_argument("--out", type=str, default="-",
+                    help="output file (default stdout)")
+    ap.add_argument("--format", choices=("jsonl", "openmetrics"),
+                    default="jsonl")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                    help="keep polling every SEC seconds and appending "
+                         "new samples (jsonl only; 0 = one pass)")
+    ap.add_argument("--world", type=int, default=0,
+                    help="rank count (default: discovered from the KV)")
+    args = ap.parse_args(argv)
+    if not args.cp:
+        print("ts_export: control-plane address unknown; pass --cp or set "
+              "BLUEFOG_CP_HOST/BLUEFOG_CP_PORT", file=sys.stderr)
+        return 1
+    cl = _client(args.cp)
+    if cl is None:
+        return 1
+    out = sys.stdout if args.out == "-" else open(args.out, "w")
+    acc = ts.HistoryAccumulator()
+    seen: set = set()
+    try:
+        from bluefog_tpu.launcher import _discover_world
+
+        world = args.world or _discover_world(cl)
+        _poll(cl, acc, world)
+        if args.format == "openmetrics":
+            n = emit_openmetrics(acc, out)
+            print(f"ts_export: {n} samples ({world} rank(s))",
+                  file=sys.stderr)
+            return 0 if n else 1
+        n = emit_jsonl(acc, out, seen)
+        while args.watch > 0:
+            out.flush()
+            time.sleep(args.watch)
+            _poll(cl, acc, world)
+            n += emit_jsonl(acc, out, seen)
+        print(f"ts_export: {n} samples ({world} rank(s))", file=sys.stderr)
+        return 0 if n else 1
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if out is not sys.stdout:
+            out.close()
+        cl.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

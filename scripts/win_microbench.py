@@ -43,9 +43,16 @@ import secrets
 import socket
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+
+# Flight dumps from a bench run (deliberate fault probes included) land in
+# a tempdir instead of littering the CWD, the same default the test
+# suite's conftest applies; an explicit BLUEFOG_FLIGHT_DIR still wins.
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
 
 
 def free_port() -> int:

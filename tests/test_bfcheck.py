@@ -19,7 +19,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
 
 import bfcheck  # noqa: E402
 from bfcheck import (knob_check, lint_check, lock_check,  # noqa: E402
-                     protocol_check)
+                     metrics_check, protocol_check)
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -479,3 +479,135 @@ def test_native_op_names_derive_from_protocol():
     assert native._OP_NAMES is protocol.OP_NAMES
     assert native.ControlPlaneClient._OP_APPEND_BYTES == \
         protocol.OP_CODES["append_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# metrics analyzer fixtures
+# ---------------------------------------------------------------------------
+
+MINI_METRICS = textwrap.dedent('''
+    _HELP_EXACT = {
+        "opt.step": "optimizer step counter",
+    }
+    _HELP_PREFIX = (
+        ("win.", "window op latency"),
+    )
+    _PREFIX_FAMILIES = ("opt", "win")
+''')
+
+MINI_TS = textwrap.dedent('''
+    TS_BINDINGS = (
+        ("opt.step", "gauge", "last"),
+    )
+    DERIVED_SERIES = ("opt.mixing_rate",)
+    RATE_SERIES = ("opt.step",)
+
+
+    class Rule:
+        def __init__(self, name, series, op, threshold, for_sec, doc=""):
+            pass
+
+
+    DEFAULT_RULES = (
+        Rule("straggler", "opt.step.rate", "<=", 0.0, 30.0),
+    )
+''')
+
+
+def make_metrics_tree(tmp_path, user_src="", metrics=MINI_METRICS,
+                      ts=MINI_TS):
+    rt = tmp_path / "bluefog_tpu" / "runtime"
+    rt.mkdir(parents=True)
+    (rt / "metrics.py").write_text(metrics)
+    (rt / "timeseries.py").write_text(ts)
+    if user_src:
+        (tmp_path / "bluefog_tpu" / "user.py").write_text(user_src)
+    return str(tmp_path)
+
+
+def test_metrics_clean_fixture(tmp_path):
+    root = make_metrics_tree(tmp_path, textwrap.dedent('''
+        from .runtime import metrics as _metrics
+
+        _metrics.counter("opt.step").inc()
+        _metrics.gauge("win.depth").set(1)
+        _metrics.histogram("cp.lag", doc="per-site doc wins")
+    '''), metrics=MINI_METRICS.replace(
+        '_PREFIX_FAMILIES = ("opt", "win")',
+        '_PREFIX_FAMILIES = ("opt", "win", "cp")'))
+    assert metrics_check.check(root) == []
+
+
+def test_metrics_undeclared_prefix_family(tmp_path):
+    root = make_metrics_tree(tmp_path, textwrap.dedent('''
+        from .runtime import metrics as _metrics
+
+        _metrics.counter("rogue.hits", doc="has help, wrong family")
+    '''))
+    diags = metrics_check.check(root)
+    assert len(diags) == 1
+    assert "undeclared prefix family 'rogue'" in diags[0].message
+    assert diags[0].path.endswith("user.py") and diags[0].line > 0
+
+
+def test_metrics_missing_help(tmp_path):
+    root = make_metrics_tree(tmp_path, textwrap.dedent('''
+        from .runtime import metrics as _metrics
+
+        _metrics.gauge("opt.mystery")
+    '''))
+    diags = metrics_check.check(root)
+    assert len(diags) == 1
+    assert "no HELP text" in diags[0].message
+
+
+def test_metrics_doc_kwarg_and_prefix_rule_satisfy_help(tmp_path):
+    root = make_metrics_tree(tmp_path, textwrap.dedent('''
+        from .runtime import metrics as _metrics
+
+        _metrics.gauge("opt.novel", doc="documented at the site")
+        _metrics.histogram("win.put_sec")  # prefix rule covers win.*
+    '''))
+    assert metrics_check.check(root) == []
+
+
+def test_metrics_waiver_suppresses(tmp_path):
+    root = make_metrics_tree(tmp_path, textwrap.dedent('''
+        from .runtime import metrics as _metrics
+
+        # bfcheck: ok-metrics (fixture justification)
+        _metrics.gauge("opt.mystery")
+    '''))
+    assert metrics_check.check(root) == []
+
+
+def test_metrics_binding_names_unknown_instrument(tmp_path):
+    root = make_metrics_tree(tmp_path, ts=MINI_TS.replace(
+        '("opt.step", "gauge", "last"),',
+        '("opt.step", "gauge", "last"),\n'
+        '    ("opt.typo_gauge", "gauge", "last"),'))
+    diags = metrics_check.check(root)
+    assert len(diags) == 1
+    assert "TS_BINDINGS names 'opt.typo_gauge'" in diags[0].message
+
+
+def test_metrics_rule_names_unknown_series(tmp_path):
+    root = make_metrics_tree(tmp_path, ts=MINI_TS.replace(
+        'Rule("straggler", "opt.step.rate", "<=", 0.0, 30.0),',
+        'Rule("straggler", "opt.step.rate", "<=", 0.0, 30.0),\n'
+        '    Rule("bogus", "opt.nonexistent", ">", 1.0, 5.0),'))
+    diags = metrics_check.check(root)
+    assert len(diags) == 1
+    assert "alert rule 'bogus'" in diags[0].message
+    assert "opt.nonexistent" in diags[0].message
+
+
+def test_metrics_rate_suffix_resolves_only_rate_series(tmp_path):
+    # .rate of a non-RATE_SERIES member is a finding
+    root = make_metrics_tree(tmp_path, ts=MINI_TS.replace(
+        'Rule("straggler", "opt.step.rate", "<=", 0.0, 30.0),',
+        'Rule("straggler", "opt.step.rate", "<=", 0.0, 30.0),\n'
+        '    Rule("gone", "opt.mixing_rate.rate", ">", 1.0, 5.0),'))
+    diags = metrics_check.check(root)
+    assert len(diags) == 1
+    assert "alert rule 'gone'" in diags[0].message

@@ -418,6 +418,40 @@ def test_step_attribution_script_over_dump(bf_hosted_flight, tmp_path):
         opt.free()
 
 
+def test_step_attribution_text_mode_annotates_sharded_rank(tmp_path):
+    """r17 pinned the --json ``shard_factor`` field; this pins the TEXT
+    mode's sharded-rank annotation — a dump whose metrics snapshot
+    carries ``win.shard_factor`` > 1 must render the rotation-factor
+    line (per-edge bytes are shard-sized), and an unsharded dump must
+    not."""
+    import subprocess
+
+    B, E = flight_mod.SPAN_B, flight_mod.SPAN_E
+    doc = _synth_doc([
+        (B, "opt.step", 0, 0, 7),
+        (flight_mod.FLOW_S, "edge.0.1", 100, 2048, 11),
+        (E, "opt.step", 1000, 0, 7),
+    ])
+    doc["meta"] = {"rank": 0}
+    doc["metrics"] = {"gauges": {"win.shard_factor": 4.0}}
+    path = tmp_path / "sharded_dump.json"
+    path.write_text(json.dumps(doc))
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "step_attribution.py")
+    out = subprocess.run([sys.executable, script, str(path)],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "sharded window rotation: factor 4" in out.stdout
+    assert "per-edge bytes below are shard-sized" in out.stdout
+    # unsharded dump: no annotation line
+    doc["metrics"] = {"gauges": {"win.shard_factor": 1.0}}
+    path.write_text(json.dumps(doc))
+    out = subprocess.run([sys.executable, script, str(path)],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "sharded window rotation" not in out.stdout
+
+
 def test_fatal_step_dump_and_merged_flow_pair(bf_hosted_flight, tmp_path,
                                               monkeypatch):
     """The in-process analog of the kill-a-peer acceptance: controller A
@@ -552,3 +586,19 @@ def test_launcher_parser_accepts_new_flags():
     args = build_parser().parse_args(
         ["--dump", "--cp", "h:1", "--out", "d", "--dump-timeout", "5"])
     assert args.dump and args.out == "d" and args.dump_timeout == 5.0
+    args = build_parser().parse_args(
+        ["--top", "--once", "--interval", "0.5", "--world", "4"])
+    assert args.top and args.once
+    assert args.interval == 0.5 and args.world == 4
+
+
+def test_strict_findings_flag_under_replication_gauge():
+    from bluefog_tpu.launcher import _strict_findings
+
+    base = {"ranks": {}, "stragglers": [], "mass": None}
+    assert _strict_findings({**base, "repl": None}) == []
+    assert _strict_findings(
+        {**base, "repl": {"lag": 10.0, "under_replicated": 0}}) == []
+    findings = _strict_findings(
+        {**base, "repl": {"lag": 10.0, "under_replicated": 2}})
+    assert any("under-replicated" in f for f in findings)

@@ -77,11 +77,14 @@ def test_gating_filter_keeps_stable_series_only():
         # elapsed per the stable-series rule)
         "hybrid.win_put.auto.ov0.img_per_sec": 1.0,
         "hybrid.win_put.hosted.ov0.img_per_sec": 1.0,
-        # r15 compressed-wire series: info-only until two stable rounds —
-        # note `codec.*` names embed `.win_put.` / `.win_update.`, so the
-        # prefix exclusion must fire BEFORE the op-name match
+        # r15 compressed-wire series: the stable window-op rates GATE
+        # since r18 (two stable rounds elapsed, the same graduation
+        # hybrid.* took in r15)...
         "codec.int8.f32.win_put.mbps": 1.0,
         "codec.topk:0.01.f32.win_update.mbps": 1.0,
+        # ...but the codec wire-leg probes stay info-only (2x run-to-run
+        # jitter measured at graduation time)
+        "codec.int8.f32.drain_stream.mbps": 1.0,
         # r17 sharded-window series: info-only under the same rule (the
         # `sharded_sN.win_put` op names would otherwise match the op
         # filter)
@@ -92,7 +95,9 @@ def test_gating_filter_keeps_stable_series_only():
     assert set(kept) == {"win.f32.win_put.mbps", "win.f32.win_update.mbps",
                          "opt.win_put.img_per_sec",
                          "hybrid.win_put.auto.ov0.img_per_sec",
-                         "hybrid.win_put.hosted.ov0.img_per_sec"}
+                         "hybrid.win_put.hosted.ov0.img_per_sec",
+                         "codec.int8.f32.win_put.mbps",
+                         "codec.topk:0.01.f32.win_update.mbps"}
 
 
 # ---------------------------------------------------------------------------
@@ -114,6 +119,11 @@ def test_committed_baseline_is_sound():
     assert any(k.startswith("opt.") for k in metrics)
     assert any(".win_put.mbps" in k for k in metrics)
     assert any(".win_update.mbps" in k for k in metrics)
+    # codec.* graduated to gating in r18: measured rows committed
+    assert any(k.startswith("codec.") and k.endswith(".win_put.mbps")
+               for k in metrics)
+    assert any(k.startswith("codec.") and k.endswith(".win_update.mbps")
+               for k in metrics)
 
 
 # ---------------------------------------------------------------------------

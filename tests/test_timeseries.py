@@ -1,0 +1,574 @@
+"""Live telemetry plane: ring history, estimators, rules, consumers.
+
+Covers the r18 acceptance surface:
+
+  * multi-resolution ring history (wrap, downsampling, window/rate/trend)
+    and the delta-encoded ``bf.ts.<rank>`` wire format;
+  * the alert-rule grammar + engine (fire after a sustained breach, flight
+    instant + counter, clear);
+  * per-edge estimators fed from REAL flight-ring flow events, and the
+    consumer-side cross-rank flow matching;
+  * the convergence gauges end to end on a 4-rank consensus workload: the
+    streamed consensus distance matches a numpy oracle per step and decays
+    toward 0, with a sub-1 mixing-rate estimate;
+  * live per-edge transit vs the postmortem ``step_attribution`` flow
+    pairing on the same run (within 20%);
+  * ``bfrun --top`` rendering every rank from outside the mesh and naming
+    a silent (stale-stream) rank.
+"""
+
+import contextlib
+import io
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu.runtime import control_plane as cp
+from bluefog_tpu.runtime import flight as flight_mod
+from bluefog_tpu.runtime import metrics as metrics_mod
+from bluefog_tpu.runtime import native
+from bluefog_tpu.runtime import timeseries as ts
+from bluefog_tpu.runtime.state import _global_state
+
+from conftest import cpu_devices
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# ring history
+# ---------------------------------------------------------------------------
+
+def test_tier_wraps_and_aggregates():
+    t = ts._Tier(1.0, 8, "last")
+    for i in range(20):
+        t.add(1000.0 + i, float(i))
+    times, vals = t.samples()
+    # 8 flushed ring slots + the in-progress slot
+    assert len(times) == 9
+    assert vals[-1] == 19.0
+    assert times[0] == 1011.0  # oldest surviving slot after the wrap
+
+    m = ts._Tier(10.0, 4, "mean")
+    for i in range(10):
+        m.add(2000.0 + i, float(i))  # one 10 s slot
+    _, vals = m.samples()
+    assert vals[-1] == pytest.approx(4.5)  # mean of 0..9
+
+    mx = ts._Tier(1.0, 4, "max")
+    mx.add(1.0, 3.0)
+    mx.add(1.2, 7.0)
+    mx.add(1.4, 5.0)
+    _, vals = mx.samples()
+    assert vals[-1] == 7.0
+
+
+def test_series_window_rate_trend():
+    s = ts.Series("t.x", "counter", "last")
+    for i in range(600):  # 10 min at 1 Hz: outruns the 1 s tier's ring
+        s.add(5000.0 + i, float(10 * i))
+    t, v = s.window(30)
+    assert t[0] >= s.last_t - 30
+    assert s.rate(60) == pytest.approx(10.0, rel=0.05)
+    assert s.trend(120) == pytest.approx(10.0, rel=0.1)
+    # a span longer than the 1 s tier falls back to a coarser tier
+    t, v = s.window(500)
+    assert t[-1] - t[0] >= 300
+
+
+def test_mixing_rate_fit_from_decay():
+    store = ts.TimeSeriesStore()
+    d = store.series("opt.consensus_dist")
+    for i in range(12):
+        d.add(7000.0 + i, 100.0 * (0.7 ** i))
+    store._derive(7012.0)
+    assert store._series["opt.mixing_rate"].last_v == \
+        pytest.approx(0.7, rel=0.05)
+    # positive distance + decaying => not stalled
+    assert store._series["opt.consensus_stalled"].last_v == 0.0
+
+
+# ---------------------------------------------------------------------------
+# publication wire format
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_roundtrip_and_bad_magic():
+    doc = {"schema": 1, "rank": 3, "series": {"a": {"v": [1.5]}}}
+    blob = ts.pack_doc(doc)
+    assert blob[:4] == b"BFT1"
+    assert ts.unpack_doc(blob) == doc
+    with pytest.raises(ValueError):
+        ts.unpack_doc(b"NOPE" + blob[4:])
+
+
+def test_build_doc_delta_and_latest_row():
+    store = ts.TimeSeriesStore()
+    s = store.series("opt.step")
+    for i in range(5):
+        s.add(9000.0 + i, float(i))
+    doc1 = store.build_doc(0, 0, 9005.0, 1.0)
+    assert "opt.step" in doc1["series"]
+    n1 = len(doc1["series"]["opt.step"]["v"])
+    assert n1 >= 4
+    # no new samples: the delta is empty but the constant-size `latest`
+    # row still carries the current value (late-joining readers)
+    doc2 = store.build_doc(0, 0, 9006.0, 1.0)
+    assert "opt.step" not in doc2["series"]
+    assert doc2["latest"]["opt.step"][1] == 4.0
+    acc = ts.HistoryAccumulator()
+    acc.update(0, ts.unpack_doc(ts.pack_doc(doc2)))
+    assert acc.latest(0, "opt.step") == 4.0
+    # the delta arrays reconstruct the timestamps
+    acc2 = ts.HistoryAccumulator()
+    acc2.update(0, doc1)
+    hist = acc2.series[(0, "opt.step")]
+    assert [round(t) for t, _ in hist][-2:] == [9003, 9004]
+
+
+def test_full_publication_carries_tier_history():
+    store = ts.TimeSeriesStore()
+    s = store.series("opt.step")
+    for i in range(120):
+        s.add(10000.0 + i, float(i))
+    doc = store.build_doc(0, 0, 10120.0, 1.0)  # seq 0 => full
+    assert "hist" in doc and "opt.step" in doc["hist"]
+    assert "10" in doc["hist"]["opt.step"]  # the 10 s downsampled tier
+
+
+# ---------------------------------------------------------------------------
+# alert rules
+# ---------------------------------------------------------------------------
+
+def test_parse_rules_grammar_override_off_malformed():
+    rules = {r.name: r for r in ts.parse_rules(
+        "wal_lag:cp.repl_lag>100:for=5,mass_drift:off,garbage,"
+        "custom:opt.step.rate<0.5:for=2")}
+    assert rules["wal_lag"].threshold == 100.0
+    assert rules["wal_lag"].for_sec == 5.0
+    assert "mass_drift" not in rules
+    assert rules["custom"].series == "opt.step.rate"
+    assert rules["custom"].op == "<"
+    # defaults survive untouched
+    assert "straggler" in rules
+    assert ts.parse_rules(None) == ts.DEFAULT_RULES
+
+
+def test_rule_engine_fires_after_sustain_and_clears():
+    store = ts.TimeSeriesStore()
+    store._rules = ts.parse_rules("wal_lag:cp.repl_lag>100:for=5")
+    store._rule_state = {r.name: ts._RuleState() for r in store._rules}
+    lag = store.series("cp.repl_lag", "gauge", "max")
+    fired0 = metrics_mod.counter("alert.fired").value
+    # breach below the sustain window: no alert
+    lag.add(1000.0, 500.0)
+    store._evaluate_rules(1000.0)
+    lag.add(1003.0, 500.0)
+    store._evaluate_rules(1003.0)
+    assert store.active_alerts() == []
+    # sustained past for=5: fires once (counter + flight instant)
+    lag.add(1006.0, 500.0)
+    store._evaluate_rules(1006.0)
+    active = store.active_alerts()
+    assert [a["name"] for a in active] == ["wal_lag"]
+    assert metrics_mod.counter("alert.fired").value == fired0 + 1
+    store._evaluate_rules(1007.0)  # still active: no double fire
+    assert metrics_mod.counter("alert.fired").value == fired0 + 1
+    # condition clears
+    lag.add(1008.0, 0.0)
+    store._evaluate_rules(1008.0)
+    assert store.active_alerts() == []
+    # the fire left a flight instant behind
+    snap = flight_mod.recorder().snapshot()
+    names = snap["names"]
+    assert any(names[n] == "alert.wal_lag"
+               for n in snap["events"]["name"]
+               if 0 <= n < len(names))
+
+
+def test_sampler_records_bindings_and_rates():
+    metrics_mod.gauge("opt.step").set(40.0)
+    metrics_mod.counter("win.drain_bytes").inc(1000)
+    store = ts.TimeSeriesStore()
+    store.sample(now=2000.0)
+    metrics_mod.gauge("opt.step").set(50.0)
+    metrics_mod.counter("win.drain_bytes").inc(3000)
+    store.sample(now=2002.0)
+    assert store._series["opt.step"].last_v == 50.0
+    assert store._series["opt.step.rate"].last_v == pytest.approx(5.0)
+    assert store._series["win.drain_bytes.rate"].last_v == \
+        pytest.approx(1500.0)
+
+
+# ---------------------------------------------------------------------------
+# per-edge estimators + consumer-side matching
+# ---------------------------------------------------------------------------
+
+def test_edge_estimator_from_real_flight_ring():
+    rec = flight_mod.recorder()
+    store = ts.TimeSeriesStore()
+    store._scan_cursor = getattr(rec, "_n", 0)  # only our events
+    nid = rec.intern("edge.0.1")
+    did = rec.intern("drain.0")
+    for fid in (901, 902, 903):
+        rec.rec(flight_mod.FLOW_S, nid, 4096.0, fid)
+        rec.rec(flight_mod.FLOW_F, did, 4096.0, fid)
+    store.sample(now=3000.0)
+    est = store.edges()["0->1"]
+    assert est.deposits == 3
+    assert est.bytes == pytest.approx(3 * 4096.0)
+    p50, p99 = est.percentiles()
+    assert p50 is not None and p50 >= 0.0 and p99 >= p50
+
+
+def test_accumulator_matches_flows_across_ranks():
+    acc = ts.HistoryAccumulator()
+    acc.update(0, {"seq": 1, "ts": 100.0, "series": {}, "edges": {},
+                   "flows": {"starts": [[7, 1_000_000, 512, 0, 2]],
+                             "finishes": []}})
+    acc.update(2, {"seq": 1, "ts": 100.0, "series": {}, "edges": {},
+                   "flows": {"starts": [],
+                             "finishes": [[7, 1_002_500]]}})
+    p50, p99 = acc.edge_transit("0->2")
+    assert p50 == pytest.approx(2500.0)
+    assert p99 == pytest.approx(2500.0)
+
+
+def test_silent_rank_detection_and_top_rendering():
+    acc = ts.HistoryAccumulator()
+    now = time.time()
+    for r in (0, 1, 3):
+        store = ts.TimeSeriesStore()
+        store.series("opt.step").add(now, 10.0 + r)
+        acc.update(r, store.build_doc(r, 0, now, 1.0))
+    # rank 2 published long ago: stale stream
+    old = ts.TimeSeriesStore()
+    old.series("opt.step").add(now - 120, 3.0)
+    doc = old.build_doc(2, 0, now - 120, 1.0)
+    acc.update(2, doc)
+    assert acc.silent_ranks(4, now) == [2]
+    frame = ts.format_top(acc, 4, now=now)
+    assert "SILENT rank(s): [2]" in frame
+    for r in (0, 1, 3):
+        assert f"\n  {r:>4} " in frame or f" {10.0 + r:.0f}" in frame
+    assert ts.sparkline([1, 2, 3]) != ""
+    assert ts.sparkline([]) == ""
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the 4-rank consensus workload
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def bf_hosted_ts(monkeypatch, tmp_path):
+    if native.load() is None:
+        pytest.skip("native runtime unavailable")
+    port = _free_port()
+    for k, v in {
+        "BLUEFOG_CP_HOST": "127.0.0.1",
+        "BLUEFOG_CP_PORT": str(port),
+        "BLUEFOG_CP_WORLD": "1",
+        "BLUEFOG_CP_RANK": "0",
+        "BLUEFOG_WIN_HOST_PLANE": "1",
+        "BLUEFOG_METRICS_INTERVAL": "1",
+        "BLUEFOG_TS_INTERVAL": "1",
+        "BLUEFOG_FLIGHT_DIR": str(tmp_path),
+    }.items():
+        monkeypatch.setenv(k, v)
+    cp.reset_for_test()
+    bf.init(devices=cpu_devices(4))
+    assert cp.active()
+    yield bf
+    bf.shutdown()
+    cp.reset_for_test()
+
+
+def _consensus_job(bf_, steps=6, dim=16, seed=0):
+    """A 4-rank win-put consensus workload: per-rank perturbed params,
+    zero loss — gossip alone drives them together. Returns (opt, gauge
+    readings per step, numpy oracle distances per step)."""
+    import jax.numpy as jnp
+    import optax
+
+    from bluefog_tpu import optimizers as opt_mod
+    from bluefog_tpu.ops import windows as win_mod
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf_.DistributedWinPutOptimizer(optax.sgd(0.1), zloss,
+                                         window_prefix="ts.cons")
+    state = opt.init({"w": jnp.ones((dim,), jnp.float32)})
+    rng = np.random.default_rng(seed)
+    noise = rng.normal(size=(4, dim)).astype(np.float32)
+    pert = state.params["w"] + bf_.shard_rank_stacked(
+        bf_.mesh(), jnp.asarray(noise))
+    state = opt_mod.TrainState(
+        {"w": pert}, state.opt_state, state.model_state)
+
+    win = win_mod._get_window(opt._win_names[0])
+    n = win.size
+    W = np.zeros((n, n))
+    for r in range(n):
+        u = 1.0 / (len(win.in_neighbors[r]) + 1)
+        W[r, r] = u
+        for s in win.in_neighbors[r]:
+            W[r, s] = u
+    X = np.asarray(pert, np.float64)
+    gauges, oracle = [], []
+    for _ in range(steps):
+        # defeat the gauge's ~1 Hz cadence gate: the oracle wants a
+        # reading at EVERY step
+        opt._consensus_t = 0.0
+        # oracle BEFORE the step: distance to the combine-weighted
+        # neighbor mean from the pre-gossip rows
+        d2 = []
+        for r in range(n):
+            nbrs = win.in_neighbors[r]
+            mean = np.mean([X[s] for s in nbrs], axis=0)
+            d2.append(np.sum((X[r] - mean) ** 2))
+        oracle.append(float(np.sqrt(np.mean(d2))))
+        state, _ = opt.step(state, jnp.zeros((4, 1), jnp.float32))
+        gauges.append(metrics_mod.gauge("opt.consensus_dist").value)
+        ts.maybe_sample(force=True, publish=True)
+        X = W @ X
+    return opt, gauges, oracle
+
+
+def test_consensus_gauge_matches_oracle_and_decays(bf_hosted_ts):
+    """Acceptance: the streamed consensus-distance gauge equals the numpy
+    oracle (combine-weighted neighbor-mean distance) within tolerance at
+    every step and decays toward 0; the fitted mixing rate lands in
+    (0, 1)."""
+    opt, gauges, oracle = _consensus_job(bf_hosted_ts, steps=6)
+    try:
+        for got, want in zip(gauges, oracle):
+            assert got == pytest.approx(want, rel=1e-3, abs=1e-9)
+        assert gauges[-1] < 0.2 * gauges[0]  # decays toward 0
+        assert gauges[-1] == min(gauges)
+        # the STREAMED series agrees with the gauge trail
+        acc = ts.HistoryAccumulator()
+        doc = ts.read_rank(cp.client(), 0)
+        assert doc is not None
+        acc.update(0, doc)
+        vals = acc.values(0, "opt.consensus_dist", last=16)
+        assert vals, "no consensus series streamed"
+        assert vals[-1] == pytest.approx(gauges[-1], rel=1e-4)
+        # effective mixing rate: fitted from the decay, strictly < 1
+        mix = acc.latest(0, "opt.mixing_rate")
+        assert mix is not None and 0.0 < mix < 1.0
+    finally:
+        opt.free()
+
+
+def test_push_sum_skips_consensus_gauge(bf_hosted_ts):
+    import jax.numpy as jnp
+    import optax
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    metrics_mod.gauge("opt.consensus_dist").set(-1.0)  # sentinel
+    opt = bf_hosted_ts.DistributedPushSumOptimizer(
+        optax.sgd(0.1), zloss, window_prefix="ts.ps")
+    state = opt.init({"w": jnp.ones((8,), jnp.float32)})
+    try:
+        state, _ = opt.step(state, jnp.zeros((4, 1), jnp.float32))
+        assert metrics_mod.gauge("opt.consensus_dist").value == -1.0
+    finally:
+        opt.free()
+
+
+def test_live_transit_agrees_with_postmortem(bf_hosted_ts, monkeypatch,
+                                             tmp_path):
+    """Acceptance: per-edge deposit→drain transit from the LIVE series
+    agrees with the postmortem step_attribution flow pairing over the
+    same run within 20% (they observe the same ring; the live side keeps
+    a bounded percentile window)."""
+    import sys
+
+    import jax.numpy as jnp
+
+    from bluefog_tpu.ops import windows as win_mod
+
+    bf_ = bf_hosted_ts
+    st = _global_state()
+    x = bf_.shard_rank_stacked(bf_.mesh(), jnp.ones((4, 512)))
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [0, 1])
+    assert bf_.win_create(x, "ts.flow", zero_init=True)
+    monkeypatch.setattr(cp, "owned_ranks", lambda devs, pid: [2, 3])
+    win_b = win_mod.Window("ts.flow", np.ones((4, 512), np.float32),
+                           zero_init=True)
+    store = ts.store()
+    for _ in range(6):
+        bf_.win_put(x, "ts.flow")
+        with win_b.state_mu:
+            win_b._drain_deposits()
+    ts.maybe_sample(force=True, publish=True)
+
+    # live side: estimator percentiles from the published stream
+    acc = ts.HistoryAccumulator()
+    doc = ts.read_rank(cp.client(), 0)
+    assert doc is not None
+    acc.update(0, doc)
+    live_edges = {e for e in acc.edges[0]}
+    assert live_edges, "no live edges"
+
+    # postmortem side: flow pairs over the SAME ring, via the script's
+    # loader (the machine interface the planner consumes)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    try:
+        import step_attribution
+    finally:
+        sys.path.pop(0)
+    dump = flight_mod.build_dump("test")
+    pairs = step_attribution.flow_pairs({0: dump})
+    for edge in live_edges:
+        p50, _ = acc.edge_transit(edge)
+        post = pairs.get(edge)
+        assert post is not None, f"postmortem lost edge {edge}"
+        med = sorted(post["transit_us"])[len(post["transit_us"]) // 2]
+        assert p50 == pytest.approx(med, rel=0.2), \
+            f"edge {edge}: live p50 {p50} vs postmortem median {med}"
+        est = acc.edges[0][edge]
+        assert est["bytes"] == pytest.approx(post["bytes"], rel=0.2)
+    st.windows.pop("ts.flow", None)
+
+
+def test_top_renders_all_ranks_and_names_silent(bf_hosted_ts):
+    """Acceptance: ``bfrun --top`` renders all 4 ranks from OUTSIDE the
+    mesh (raw client) and names a rank whose stream went stale — the
+    SIGKILL detector (obs-smoke kills a real publisher process; here the
+    stale stream is synthesized for tier-1 speed)."""
+    import jax.numpy as jnp
+    import optax
+
+    bf_ = bf_hosted_ts
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf_.DistributedWinPutOptimizer(optax.sgd(0.1), zloss,
+                                         window_prefix="ts.top")
+    state = opt.init({"w": jnp.ones((8,), jnp.float32)})
+    state, _ = opt.step(state, jnp.zeros((4, 1), jnp.float32))
+    ts.maybe_sample(force=True, publish=True)
+    cl = cp.client()
+    now = time.time()
+    # ranks 1..3 publish via raw stores (the external-controller shape);
+    # rank 2's stream is STALE — its "process" died
+    for r, age in ((1, 0.0), (2, 300.0), (3, 0.0)):
+        store = ts.TimeSeriesStore()
+        store.series("opt.step").add(now - age, 5.0)
+        cl.put_bytes(ts.TS_KEY_FMT.format(rank=r), ts.pack_doc(
+            store.build_doc(r, 0, now - age, 1.0)))
+
+    from bluefog_tpu import launcher
+
+    class _Args:
+        cp = f"127.0.0.1:{os.environ['BLUEFOG_CP_PORT']}"
+        top = True
+        once = True
+        world = 4
+        interval = 2.0
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = launcher._top(_Args())
+    assert rc == 0
+    text = out.getvalue()
+    assert "4 rank(s)" in text
+    for r in range(4):
+        assert f"\n  {r:>4} " in text, f"rank {r} missing:\n{text}"
+    assert "SILENT rank(s): [2]" in text
+    opt.free()
+
+
+def test_status_strict_flags_sustained_shard_drift(bf_hosted_ts):
+    """--status --strict exits 2 when the streamed
+    win.shard_stale_drops.rate series shows ≥3 consecutive positive
+    samples (sustained rotation drift), and stays 0 on a healthy job."""
+    import jax.numpy as jnp
+    import optax
+
+    bf_ = bf_hosted_ts
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf_.DistributedPushSumOptimizer(optax.sgd(0.1), zloss,
+                                          window_prefix="ts.drift")
+    state = opt.init({"w": jnp.ones((8,), jnp.float32)})
+    for _ in range(2):
+        state, _ = opt.step(state, jnp.zeros((4, 1), jnp.float32))
+    metrics_mod.publish_now()
+    ts.maybe_sample(force=True, publish=True)
+
+    from bluefog_tpu import launcher
+
+    class _Args:
+        cp = f"127.0.0.1:{os.environ['BLUEFOG_CP_PORT']}"
+        status = True
+        strict = True
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = launcher._status(_Args())
+    assert rc == 0, out.getvalue()
+
+    # synthesize a sustained-drift stream for rank 0 (the wire guard
+    # discarding every deposit — win.shard_stale_drops velocity > 0)
+    store = ts.TimeSeriesStore()
+    now = time.time()
+    s = store.series("win.shard_stale_drops.rate", "gauge", "mean")
+    for i in range(5):
+        s.add(now - 5 + i, 2.0)
+    cp.client().put_bytes(ts.TS_KEY_FMT.format(rank=0), ts.pack_doc(
+        store.build_doc(0, 0, now, 1.0)))
+    err = io.StringIO()
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out), \
+            contextlib.redirect_stderr(err):
+        rc = launcher._status(_Args())
+    assert rc == 2, err.getvalue()
+    assert "shard-rotation drift" in err.getvalue()
+    opt.free()
+
+
+def test_alerts_key_published_when_rule_fires(bf_hosted_ts):
+    """A firing rule publishes under bf.alerts.<rank> (zlib JSON) and
+    rides the next bf.ts delta's alerts field."""
+    import zlib
+
+    store = ts.store()
+    store._rules = ts.parse_rules("wal_lag:cp.repl_lag>100:for=0")
+    store._rule_state = {r.name: ts._RuleState() for r in store._rules}
+    metrics_mod.gauge("cp.repl_lag").set(5000.0)
+    ts.maybe_sample(force=True, publish=True)
+    ts.maybe_sample(force=True, publish=True)  # sustain >= for=0, fire
+    doc = ts.read_rank(cp.client(), 0)
+    assert doc is not None
+    assert any(a["name"] == "wal_lag" for a in doc.get("alerts", []))
+    blob = cp.client().get_bytes(ts.ALERTS_KEY_FMT.format(rank=0))
+    alerts = json.loads(zlib.decompress(bytes(blob)).decode())
+    assert alerts and alerts[0]["name"] == "wal_lag"
+    metrics_mod.gauge("cp.repl_lag").set(0.0)
+
+
+def test_knob_disable_turns_plane_off(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TS_DISABLE", "1")
+    assert not ts.enabled()
+    ts.maybe_sample(force=True, publish=True)  # no-op, no raise
+    monkeypatch.delenv("BLUEFOG_TS_DISABLE")
+    assert ts.enabled()
