@@ -811,6 +811,56 @@ def _discover_world(cl) -> int:
     return world
 
 
+def _format_tune_section(cl, world: int) -> str:
+    """Render the self-tuner decision trail (``bf.tune.<rank>``) for the
+    ``--top`` frame: active per-edge codec levels, demoted ranks, and
+    the most recent decisions across the fleet. Empty string when no
+    rank has published (BLUEFOG_TUNE off — the common case)."""
+    import json as _json
+
+    from .runtime import tuner as _tuner
+
+    levels: dict = {}
+    demoted: dict = {}
+    recent: list = []
+    for r in range(world):
+        try:
+            blob = cl.get_bytes(_tuner.TUNE_KEY_FMT.format(rank=r))
+        except (OSError, RuntimeError):
+            continue
+        if not blob:
+            continue
+        try:
+            doc = _json.loads(bytes(blob).decode())
+        except (ValueError, UnicodeDecodeError):
+            continue
+        levels.update(doc.get("levels") or {})
+        demoted.update(doc.get("demoted") or {})
+        for d in doc.get("decisions") or []:
+            recent.append((d.get("t", 0.0), r, d))
+    if not levels and not demoted and not recent:
+        return ""
+    lines = ["  SELF-TUNER (docs/self_tuning.md)"]
+    if levels:
+        terms = ", ".join(f"{e}={c}" for e, c in sorted(levels.items()))
+        lines.append(f"    edge codecs: {terms}")
+    if demoted:
+        terms = ", ".join(
+            f"rank {p} (-{len(v)} in-edges)"
+            for p, v in sorted(demoted.items(), key=lambda kv: int(kv[0])))
+        lines.append(f"    demoted: {terms}")
+    for t, r, d in sorted(recent, key=lambda x: (x[0], x[1]),
+                          reverse=True)[:5]:
+        tgt = d.get("target")
+        if isinstance(tgt, list):
+            tgt = f"{tgt[0]}>{tgt[1]}"
+        lines.append(
+            f"    [{d.get('status', '?'):>8}] r{r} {d.get('lever')} "
+            f"{d.get('action')} {tgt} {d.get('arg') or ''} "
+            f"— {d.get('reason', '')}")
+    return "\n".join(lines)
+
+
 def _top(args) -> int:
     """``bfrun --top``: the live cluster dashboard.
 
@@ -841,6 +891,9 @@ def _top(args) -> int:
                 if doc is not None:
                     acc.update(r, doc)
             frame = _ts.format_top(acc, world)
+            tune = _format_tune_section(cl, world)
+            if tune:
+                frame += "\n" + tune
             dead = _report_dead_shards(cl, "--top") \
                 if hasattr(cl, "dead_shard_endpoints") else []
             if dead:

@@ -42,7 +42,7 @@ there (a dense exchange has no index records).
 from __future__ import annotations
 
 import struct
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -325,6 +325,52 @@ def resolve(spec) -> Optional[WireCodec]:
     return None
 
 
+def resolve_edge_spec(spec) -> Tuple[Optional[WireCodec],
+                                     Dict[Tuple[int, int],
+                                          Optional[WireCodec]]]:
+    """Per-edge ``BLUEFOG_WIN_CODEC`` grammar -> (base codec, overrides).
+
+    Grammar: ``<spec>(;<src>><dst>=<spec>)*`` where ``<spec>`` is the
+    single-codec grammar :func:`resolve` accepts. The first term is the
+    window-wide base codec; each following term pins ONE directed edge to
+    its own codec (``=`` separates the edge from the spec because
+    ``topk:<frac>`` already uses ``:``). Example::
+
+        BLUEFOG_WIN_CODEC='none;0>1=int8;2>3=topk:0.01'
+
+    A malformed edge term warns once and is skipped — same degrade-to-
+    legacy contract as :func:`resolve`. A bare single-codec spec returns
+    ``(codec, {})``, so every existing config parses unchanged.
+    """
+    if not spec:
+        return None, {}
+    parts = str(spec).split(";")
+    base = resolve(parts[0])
+    overrides: Dict[Tuple[int, int], Optional[WireCodec]] = {}
+    for term in parts[1:]:
+        term = term.strip()
+        if not term:
+            continue
+        head, sep, sub = term.partition("=")
+        ok = bool(sep)
+        if ok:
+            try:
+                src_s, dst_s = head.split(">", 1)
+                edge = (int(src_s), int(dst_s))
+            except ValueError:
+                ok = False
+        if not ok:
+            key = f"edge:{term}"
+            if key not in _warned_bad_spec:
+                _warned_bad_spec.add(key)
+                logger.warning(
+                    "BLUEFOG_WIN_CODEC: skipping malformed per-edge term "
+                    "%r (grammar: <spec>;<src>><dst>=<spec>;...)", term)
+            continue
+        overrides[edge] = resolve(sub)
+    return base, overrides
+
+
 def state_codec_for(codec: Optional[WireCodec]) -> Optional[WireCodec]:
     """The codec a window publishes its ABSOLUTE state rows under.
 
@@ -383,5 +429,6 @@ def quantize_blend(x, cid: int):
 __all__: List[str] = [
     "CODEC_NONE", "CODEC_INT8", "CODEC_FP8", "CODEC_TOPK",
     "WireCodec", "Int8Codec", "Fp8Codec", "TopKCodec",
-    "resolve", "by_id", "state_codec_for", "quantize_blend",
+    "resolve", "resolve_edge_spec", "by_id", "state_codec_for",
+    "quantize_blend",
 ]

@@ -255,15 +255,23 @@ class PlanePlanner:
         # Wire codec discount (docs/compression.md): with a codec on the
         # hosted wire, a deposit ships ~codec.nominal_ratio of the row, so
         # the static size estimate must shrink with it or the min-bytes
-        # floor would mis-plan every edge. Measured attribution hints
+        # floor would mis-plan every edge. Codecs are per-EDGE since the
+        # self-tuning wire (docs/self_tuning.md): ``edge_scale`` carries
+        # each overridden edge's own nominal ratio and the scalar stays
+        # the fallback for every other edge. Measured attribution hints
         # (ingest_attribution) already carry POST-codec bytes — the
         # edge.<src>.<dst> flow events record the encoded payload size —
         # so they are never rescaled here.
         self.wire_scale = float(wire_scale)
+        self.edge_scale: Dict[Edge, float] = {}
         self.min_bytes = int(min_bytes)
         self.policy = policy
         self.hosted_override = frozenset(hosted_override)
         self.hints: Optional[Dict[Edge, dict]] = None
+        # Online per-edge measured bytes (the r19 tuner's live feed):
+        # highest-precedence cost source, replacing the offline --json
+        # attribution dump with the streaming telemetry plane's numbers.
+        self.live: Dict[Edge, float] = {}
         self.rebuilds = 0  # cache misses — asserted by the re-plan tests
         self._cache: Dict[Tuple, PlanePartition] = {}
 
@@ -276,14 +284,55 @@ class PlanePlanner:
         self._cache.clear()
         return len(self.hints)
 
+    def _floor_verdicts(self) -> Tuple[bool, ...]:
+        """Each edge's size-floor verdict, in sorted edge order — the only
+        part of eligibility that cost inputs can move."""
+        return tuple(self.edge_cost(e) >= self.min_bytes
+                     for e in sorted(self.edges))
+
+    def ingest_live(self, edge_bytes: Dict[Edge, float]) -> bool:
+        """Online measured per-edge wire bytes (per gossip step), fed by
+        the runtime tuner from the streaming telemetry plane's per-edge
+        estimators. Replaces both the static estimate and any offline
+        attribution hints for the named edges.
+
+        Re-plans ONLY on decision change: the partition cache is dropped
+        when some edge's size-floor verdict actually flips, so a stream
+        of measurements that all land on the same side of the floor
+        costs a dict update and nothing else. Returns True when the next
+        :meth:`partition` call will re-derive."""
+        before = self._floor_verdicts()
+        for edge, nbytes in edge_bytes.items():
+            self.live[(int(edge[0]), int(edge[1]))] = float(nbytes)
+        if self._floor_verdicts() == before:
+            return False
+        self._cache.clear()
+        return True
+
+    def set_edge_scale(self, edge: Edge, scale: float) -> bool:
+        """Pin one edge's wire-scale (its codec's nominal ratio after a
+        per-edge codec switch). Same decision-change gating as
+        :meth:`ingest_live`; returns True when the partition will
+        re-derive."""
+        before = self._floor_verdicts()
+        self.edge_scale[(int(edge[0]), int(edge[1]))] = float(scale)
+        if self._floor_verdicts() == before:
+            return False
+        self._cache.clear()
+        return True
+
     def edge_cost(self, edge: Edge) -> float:
         """Wire bytes one gossip step moves over this edge if it stays
-        hosted: the measured per-step attribution bytes when ingested
-        (already on-wire, i.e. post-codec), else the window row size
-        scaled by the configured codec's nominal compression ratio."""
+        hosted. Precedence: live measured bytes (tuner feed, post-codec)
+        > offline attribution hints (post-codec) > the window row size
+        scaled by the edge's codec nominal ratio (``edge_scale``, falling
+        back to the window-wide scalar)."""
+        if edge in self.live:
+            return self.live[edge]
         if self.hints is not None and edge in self.hints:
             return float(self.hints[edge]["bytes"])
-        return float(self.row_bytes) * self.wire_scale
+        return float(self.row_bytes) * self.edge_scale.get(
+            edge, self.wire_scale)
 
     def _eligible(self, edge: Edge, dead: FrozenSet[int]) -> bool:
         src, dst = edge

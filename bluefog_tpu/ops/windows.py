@@ -63,6 +63,7 @@ from ..runtime import control_plane as _cp
 from ..runtime import flight as _flight
 from ..runtime import handles as _handles
 from ..runtime import metrics as _metrics
+from ..runtime import native as _native
 from ..runtime.config import knob_env
 from ..runtime.logging import logger
 from ..runtime.state import _global_state
@@ -601,7 +602,15 @@ class Window:
         # codecs apply through the mail-dtype blend (codec.quantize_blend)
         # while top-k — index records over a dense exchange — does not.
         # None keeps the legacy wire byte-identical (test-pinned).
-        self.codec = _wire_codec.resolve(knob_env("BLUEFOG_WIN_CODEC"))
+        # Per-edge overrides (ISSUE r16, docs/self_tuning.md): the grammar
+        # extends to ``<spec>(;<src>><dst>=<spec>)*`` and the tuner mutates
+        # the override map at runtime via set_edge_codec; an empty map
+        # keeps every path byte-identical to the window-level codec.
+        self.codec, _edge_over = _wire_codec.resolve_edge_spec(
+            knob_env("BLUEFOG_WIN_CODEC"))
+        self._edge_codec: Dict[Tuple[int, int],
+                               Optional[_wire_codec.WireCodec]] = \
+            dict(_edge_over)
         # Sharded window plane (ISSUE r17, docs/sharded_windows.md): when
         # a window carries rotating shard rows, the optimizer binds the
         # shard factor and advances the active shard index every gossip
@@ -620,6 +629,14 @@ class Window:
         # mailbox slots' initial copies (zero_init windows start at 0).
         self._ef_rows: Dict[int, np.ndarray] = {}
         self._ef_ref: Dict[int, np.ndarray] = {}
+        # Per-edge estimator state (ISSUE r16): edges carrying a codec
+        # override keep their OWN residual/reference rows keyed (src, dst)
+        # — the shared per-src state above stays byte-identical for every
+        # edge still on the window codec. A missing ref for an EF-put edge
+        # means "needs rebase": the next send ships the full row through
+        # the codec's state fallback as a PUT (see _encode_edge).
+        self._ef_edge_rows: Dict[Tuple[int, int], np.ndarray] = {}
+        self._ef_edge_ref: Dict[Tuple[int, int], np.ndarray] = {}
         # Scalar protocols (versions / push-sum p / mutexes): controller-local
         # host memory, or the job-wide control plane when one is attached
         # (multi-controller; reference mpi_controller.cc:1281-1393, 1532-1602).
@@ -657,6 +674,11 @@ class Window:
                 # attribution (already on-wire) overrides this estimate
                 wire_scale=(self.codec.nominal_ratio
                             if self.codec is not None else 1.0))
+            # per-edge overrides shrink (or restore) individual edges: the
+            # planner's floor must judge each edge's own on-wire bytes
+            for _e, _c in self._edge_codec.items():
+                self._planner.set_edge_scale(
+                    _e, _c.nominal_ratio if _c is not None else 1.0)
 
         if self.hosted:
             # defensive: discard any deposit records a crashed predecessor
@@ -674,6 +696,16 @@ class Window:
                     r: (np.zeros(self.row_shape, acc_t) if zero_init
                         else self._rows[r].astype(acc_t))
                     for r in self.owned}
+            # grammar-configured EF edges seed their reference exactly like
+            # the window-level codec (the mailbox slots start as the same
+            # creation-time copies); runtime switches instead start with no
+            # ref and rebase on first send
+            acc_t = np.dtype(_win_acc_dtype(mail_dtype))
+            for (_s, _d), _c in self._edge_codec.items():
+                if _c is not None and _c.error_feedback and _s in owned:
+                    self._ef_edge_ref[(_s, _d)] = (
+                        np.zeros(self.row_shape, acc_t) if zero_init
+                        else self._rows[_s].astype(acc_t))
             if zero_init:
                 self._mail_rows = {
                     r: np.zeros((d,) + self.row_shape, mail_dtype)
@@ -938,11 +970,151 @@ class Window:
         """L2 norm over every owned rank's residual (0.0 when EF is off
         or nothing compressed yet) — the ``win.codec.residual_norm``
         gauge's source."""
-        if not self._ef_rows:
+        if not self._ef_rows and not self._ef_edge_rows:
             return 0.0
-        return float(np.sqrt(sum(
-            float(np.sum(np.square(r, dtype=np.float64)))
-            for r in self._ef_rows.values())))
+        return float(np.sqrt(
+            sum(float(np.sum(np.square(r, dtype=np.float64)))
+                for r in self._ef_rows.values())
+            + sum(float(np.sum(np.square(r, dtype=np.float64)))
+                  for r in self._ef_edge_rows.values())))
+
+    def ef_edge_residual_norm(self, src: int, dst: int) -> float:
+        """L2 norm of one overridden edge's own residual (0.0 when the
+        edge rides the window codec or nothing compressed yet) — the
+        tuner's per-edge de-escalation sensor."""
+        r = self._ef_edge_rows.get((int(src), int(dst)))
+        if r is None:
+            return 0.0
+        return float(np.sqrt(np.sum(np.square(r, dtype=np.float64))))
+
+    def codec_for(self, src: int, dst: int):
+        """Effective wire codec for edge ``src -> dst``: the per-edge
+        override when one is set, else the window codec."""
+        try:
+            return self._edge_codec[(int(src), int(dst))]
+        except KeyError:
+            return self.codec
+
+    def set_edge_codec(self, src: int, dst: int, spec) -> bool:
+        """Switch one edge's wire codec at runtime (the tuner's codec
+        lever, ISSUE r16). ``spec`` is the single-codec grammar (``none``
+        / ``int8`` / ``fp8`` / ``topk:<frac>``), a WireCodec, or None.
+
+        Switch protocol (docs/self_tuning.md):
+
+        * TO an error-feedback codec in put mode: the per-edge CHOCO
+          reference starts absent, so the first post-switch send REBASES —
+          it ships the full row through the codec's state fallback (int8)
+          as a plain PUT, then both ends agree on x̂ and deltas resume
+          (mailbox FIFO ordering makes this race-free).
+        * AWAY from error feedback: the put-mode reference is dropped (the
+          next full PUT supersedes the unsent gap); any accumulate-mode
+          residual is KEPT and folded into the next send's base whatever
+          the new codec, so push-sum numerator mass is never lost across
+          a switch — the associated-p channel ships exact in the header
+          either way.
+
+        Returns True when the effective codec actually changed."""
+        edge = (int(src), int(dst))
+        new = _wire_codec.resolve(spec) if isinstance(spec, str) or \
+            spec is None else spec
+        cur = self.codec_for(*edge)
+
+        def _key(c):
+            return None if c is None else (c.cid, getattr(c, "frac", None))
+
+        if _key(new) == _key(cur):
+            return False
+        if _key(new) == _key(self.codec):
+            self._edge_codec.pop(edge, None)
+        else:
+            self._edge_codec[edge] = new
+        if new is None or not new.error_feedback:
+            self._ef_edge_ref.pop(edge, None)
+        if self._planner is not None:
+            self._planner.set_edge_scale(
+                edge, new.nominal_ratio if new is not None else 1.0)
+        _metrics.counter("win.codec.edge_switches").inc()
+        return True
+
+    def _edge_residual(self, edge: Tuple[int, int]) -> np.ndarray:
+        r = self._ef_edge_rows.get(edge)
+        if r is None:
+            acc_t = np.dtype(_win_acc_dtype(self.mail_dtype))
+            r = self._ef_edge_rows[edge] = np.zeros(self.row_shape, acc_t)
+        return r
+
+    def _edge_raw_base(self, edge: Tuple[int, int], x: np.ndarray,
+                       mode: int) -> np.ndarray:
+        """Send base for a raw (codec None) override edge: an accumulate
+        folds any residual mass a previous EF codec left behind (exact —
+        the uncompressed wire ships it all), a put supersedes it."""
+        e = self._ef_edge_rows.pop(edge, None)
+        if mode == _DEP_ACC and e is not None:
+            return x + e
+        return x
+
+    def _encode_edge(self, edge: Tuple[int, int], x: np.ndarray, wire_t,
+                     mode: int):
+        """Per-edge variant of ``_encode_row`` for an overridden edge:
+        ``(payload, estimate, fold_mode, wire_codec)`` against the edge's
+        own estimator state. ``wire_codec`` is what actually rides the
+        deposit header — normally the override itself, but a rebase send
+        (see set_edge_codec) ships through the codec's state fallback."""
+        codec = self._edge_codec[edge]
+        acc_t = np.dtype(_win_acc_dtype(self.mail_dtype))
+        fold_mode = mode
+        ref = None
+        if codec.error_feedback and mode == _DEP_PUT:
+            ref = self._ef_edge_ref.get(edge)
+            if ref is None:
+                # REBASE: resync the receiver slot with a full overwrite
+                # through the non-EF state codec, then track its decode as
+                # the shared reference — the deltas that follow integrate
+                # from exactly what the receiver folded.
+                wire = _wire_codec.state_codec_for(codec)
+                raw = np.ascontiguousarray(
+                    x.astype(wire_t, copy=False)).reshape(-1)
+                payload = wire.encode(raw)
+                est = wire.decode(payload, wire_t, raw.size).astype(
+                    acc_t, copy=False).reshape(self.row_shape)
+                self._ef_edge_ref[edge] = est
+                self._ef_edge_rows[edge] = x - est
+                _metrics.counter("win.codec.edge_rebase").inc()
+                _metrics.counter("win.codec.raw_bytes").inc(raw.nbytes)
+                _metrics.counter("win.codec.wire_bytes").inc(payload.nbytes)
+                return payload, est, _DEP_PUT, wire
+            base = x - ref
+            fold_mode = _DEP_ACC
+        elif codec.error_feedback:
+            base = x + self._edge_residual(edge)
+        else:
+            # non-EF codec: a leftover residual from a pre-switch EF codec
+            # still folds into the next accumulate's base (mass carries);
+            # its own quantization error keeps being tracked from then on
+            # so numerator mass stays exact across the switch
+            e = self._ef_edge_rows.get(edge) if mode == _DEP_ACC else None
+            base = x if e is None else x + e
+        raw = np.ascontiguousarray(
+            base.astype(wire_t, copy=False)).reshape(-1)
+        payload = codec.encode(raw)
+        est = codec.decode(payload, wire_t, raw.size).astype(
+            acc_t, copy=False).reshape(self.row_shape)
+        if codec.error_feedback:
+            if mode == _DEP_PUT:
+                self._ef_edge_ref[edge] = ref + est
+                self._ef_edge_rows[edge] = x - self._ef_edge_ref[edge]
+            else:
+                self._ef_edge_rows[edge] = base - est
+            _metrics.gauge("win.codec.residual_norm").set(
+                self.ef_residual_norm())
+        elif mode == _DEP_ACC and edge in self._ef_edge_rows:
+            self._ef_edge_rows[edge] = base - est
+        _metrics.counter("win.codec.raw_bytes").inc(raw.nbytes)
+        _metrics.counter("win.codec.wire_bytes").inc(payload.nbytes)
+        _metrics.gauge("win.codec.ratio").set(
+            raw.nbytes / payload.nbytes if payload.nbytes else 0.0)
+        return payload, est, fold_mode, codec
 
     def _encode_row(self, src: int, x: np.ndarray, wire_t, mode: int):
         """Encode one source row for the wire:
@@ -2250,6 +2422,32 @@ def win_free(name: Optional[str] = None) -> bool:
 # put / accumulate / get
 # ---------------------------------------------------------------------------
 
+def _send_deposits_delayed(names, blobs, tags, edge_of, delays):
+    """Chaos-only deposit send (BLUEFOG_CP_FAULT ``delay_edges``):
+    partition the batch by each record's injected edge delay and ship the
+    groups in ascending-delay order, sleeping up to each group's delay
+    first — deterministic bandwidth ASYMMETRY (slow edges land late,
+    undelayed edges ship immediately), the self-tuning controller's
+    slow-edge fixture. Never on the hot path: the caller only reaches
+    here when the fault clause is armed."""
+    groups: Dict[int, List[int]] = {}
+    for i, e in enumerate(edge_of):
+        groups.setdefault(int(delays.get((e[0], e[1]), 0)), []).append(i)
+    replies = [0] * len(names)
+    waited = 0
+    for dly in sorted(groups):
+        if dly > waited:
+            time.sleep((dly - waited) / 1e3)
+            waited = dly
+        idx = groups[dly]
+        sub = _cp.client().append_bytes_tagged_many(
+            [names[i] for i in idx], [blobs[i] for i in idx],
+            [tags[i] for i in idx])
+        for i, r in zip(idx, sub):
+            replies[i] = r
+    return replies
+
+
 def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                      require_mutex: bool, activity: str, from_get: bool):
     """One-sided put/accumulate/get over the host tensor transport.
@@ -2316,27 +2514,50 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                         x = rows[src].astype(acc_t, copy=False)
                         dsts = sorted(table.get(src, {}))
                         # Compressed wire: ONE encode per source row — the
-                        # payload feeds every out-edge (weights move
-                        # receiver-side) and its decoded estimate feeds the
-                        # local folds, so wire and local numerics agree.
+                        # payload feeds every out-edge still on the window
+                        # codec (weights move receiver-side) and its
+                        # decoded estimate feeds the local folds, so wire
+                        # and local numerics agree. Edges carrying a
+                        # per-edge override (ISSUE r16) encode separately
+                        # below against their own estimator state.
                         enc = est = None
                         fold_mode = mode
-                        if win.codec is not None and dsts:
+                        if win.codec is not None and dsts and (
+                                not win._edge_codec
+                                or any((src, d) not in win._edge_codec
+                                       for d in dsts)):
                             enc, est, fold_mode = win._encode_row(
                                 src, x, wire_t, mode)
                         for dst in dsts:
                             wt = float(table[src][dst])
                             k = win.layout.slot_of[dst][src]
                             pc = float(p_own[src] * wt) if use_p else 0.0
+                            d_enc, d_est, d_fold = enc, est, fold_mode
+                            d_cid = win.codec.cid if enc is not None else 0
+                            x_dst = x
+                            if win._edge_codec and \
+                                    (src, dst) in win._edge_codec:
+                                if win._edge_codec[(src, dst)] is None:
+                                    # raw override: exact wire; folds any
+                                    # pre-switch EF mass (accumulate)
+                                    d_enc = d_est = None
+                                    d_fold = mode
+                                    x_dst = win._edge_raw_base(
+                                        (src, dst), x, mode)
+                                else:
+                                    d_enc, d_est, d_fold, d_wire = \
+                                        win._encode_edge(
+                                            (src, dst), x, wire_t, mode)
+                                    d_cid = d_wire.cid
                             if dst in owned:
-                                base_row = x if est is None else est
+                                base_row = x_dst if d_est is None else d_est
                                 # unit weights (the optimizer default)
                                 # skip a full-row multiply; _fold_record
                                 # never mutates its contrib
                                 contrib = base_row if wt == 1.0 else \
                                     base_row * np.asarray(wt, acc_t)
                                 with fl.span("win.fold", a=contrib.nbytes):
-                                    win._fold_record(dst, k, fold_mode,
+                                    win._fold_record(dst, k, d_fold,
                                                      contrib)
                                 if use_p:
                                     if accumulate:
@@ -2344,17 +2565,17 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                     else:
                                         win.host.set_p_mail(dst, k, pc)
                                 deposited.add((src, dst, k))
-                            elif enc is not None:
+                            elif d_enc is not None:
                                 # codec deposit: the encoded payload (one
                                 # self-describing record) with the edge
                                 # weight + byte count in the extension
                                 # header; flow events below report the
                                 # POST-CODEC bytes, so step attribution
                                 # and the plane planner see real wire cost
-                                payload = enc
+                                payload = d_enc
                                 recs = _pack_deposit(
                                     mode, int(use_p), pc, payload,
-                                    codec_id=win.codec.cid, wt=wt,
+                                    codec_id=d_cid, wt=wt,
                                     shard=dep_shard)
                                 key = win._dep_key(dst, k)
                             else:
@@ -2362,7 +2583,7 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                                 # _pack_deposit slices it zero-copy and the
                                 # native scatter-gather write streams it
                                 payload = np.ascontiguousarray(
-                                    (x * np.asarray(wt, acc_t)).astype(
+                                    (x_dst * np.asarray(wt, acc_t)).astype(
                                         wire_t, copy=False))
                                 recs = _pack_deposit(
                                     mode, int(use_p), pc, payload,
@@ -2404,9 +2625,16 @@ def _hosted_exchange(win: Window, tensor, table, sw_list, accumulate: bool,
                         # verified by win_microbench's sharded probe (the
                         # shard factor's ≥0.9·S reduction claim)
                         _metrics.counter("win.deposit_bytes").inc(wire_out)
+                        _dl = _native.edge_delays()
                         with fl.span("win.wire", a=wire_out):
-                            replies = _cp.client().append_bytes_tagged_many(
-                                dep_names, dep_blobs, dep_tags)
+                            if _dl:
+                                replies = _send_deposits_delayed(
+                                    dep_names, dep_blobs, dep_tags,
+                                    dep_edge_of, _dl)
+                            else:
+                                replies = \
+                                    _cp.client().append_bytes_tagged_many(
+                                        dep_names, dep_blobs, dep_tags)
                         # backstop only: the pre-check above keeps the
                         # server cap from ever tearing a multi-record
                         # deposit; a -2 here means the client's

@@ -80,6 +80,7 @@ from .runtime import flight as _flight
 from .runtime import heartbeat as _hb
 from .runtime import metrics as _metrics
 from .runtime import timeseries as _timeseries
+from .runtime import tuner as _tuner
 from .runtime.config import knob_env
 from .runtime.logging import logger
 from .runtime.native import PeerLostError
@@ -530,16 +531,20 @@ class DistributedShardedAllreduceOptimizer(_FusedOptimizer):
 # Window (asynchronous gossip) optimizers
 # ---------------------------------------------------------------------------
 
-def _live_neighbor_sets(win, dead):
-    """(live_out, live_in) neighbor maps with dead ranks excluded."""
+def _live_neighbor_sets(win, dead, demoted=frozenset()):
+    """(live_out, live_in) neighbor maps with dead ranks — and tuner-
+    demoted directed edges (ISSUE r16) — excluded."""
     n = win.size
-    return ({r: [d for d in win.out_neighbors[r] if d not in dead]
+    return ({r: [d for d in win.out_neighbors[r] if d not in dead
+                 and (r, d) not in demoted]
              for r in range(n)},
-            {r: [s for s in win.in_neighbors[r] if s not in dead]
+            {r: [s for s in win.in_neighbors[r] if s not in dead
+                 and (s, r) not in demoted]
              for r in range(n)})
 
 
-def _healed_recv_weights(win, dead, self_weight, neighbor_weights):
+def _healed_recv_weights(win, dead, self_weight, neighbor_weights,
+                         demoted=frozenset()):
     """Combine weights over the LIVE in-neighbor sets (self-healing gossip).
 
     Defaults (both None) recompute the uniform ``1/(live_indegree + 1)``
@@ -548,11 +553,15 @@ def _healed_recv_weights(win, dead, self_weight, neighbor_weights):
     weights keep their shape: dead sources drop out and the remaining
     entries (self included) rescale by one factor so each rank's total
     weight is preserved (column renormalization, the same rule as
-    ``topology_util.prune_dead_ranks``)."""
+    ``topology_util.prune_dead_ranks``). ``demoted`` directed edges
+    (the self-tuning controller's in-degree lever,
+    ``topology_util.demote_in_edges``) drop out of the receiving rank's
+    column by the same rule — for that column only, the demoted source
+    is indistinguishable from a dead one."""
     from .ops.neighbors import _per_rank
 
     n = win.size
-    _, live_in = _live_neighbor_sets(win, dead)
+    _, live_in = _live_neighbor_sets(win, dead, demoted)
     if self_weight is None and neighbor_weights is None:
         u = {r: 1.0 / (len(live_in[r]) + 1) for r in range(n)}
         return u, {r: {s: u[r] for s in live_in[r]} for r in range(n)}
@@ -562,7 +571,8 @@ def _healed_recv_weights(win, dead, self_weight, neighbor_weights):
     out_sw, out_nw = {}, {}
     for r in range(n):
         total = float(sw[r]) + sum(nw_table[r].values())
-        live = {s: w for s, w in nw_table[r].items() if s not in dead}
+        live = {s: w for s, w in nw_table[r].items()
+                if s not in dead and (s, r) not in demoted}
         live_total = float(sw[r]) + sum(live.values())
         scale = total / live_total if live_total > 0 else 1.0
         out_sw[r] = float(sw[r]) * scale
@@ -570,16 +580,20 @@ def _healed_recv_weights(win, dead, self_weight, neighbor_weights):
     return out_sw, out_nw
 
 
-def _healed_send_table(win, dead, dst_weights):
-    """Send weights with dead destinations dropped (no rescale: put-style
-    send weights are per-edge multipliers, not a distributed mass)."""
+def _healed_send_table(win, dead, dst_weights, demoted=frozenset()):
+    """Send weights with dead destinations — and tuner-demoted edges —
+    dropped (no rescale: put-style send weights are per-edge multipliers,
+    not a distributed mass). Skipping the send is where a demotion
+    actually saves wire bytes; the receive-side renormalization keeps the
+    combine convex."""
     n = win.size
-    live_out, _ = _live_neighbor_sets(win, dead)
+    live_out, _ = _live_neighbor_sets(win, dead, demoted)
     if dst_weights is None:
         return {r: {d: 1.0 for d in live_out[r]} for r in range(n)}
     table = _windows._edge_weights(dst_weights, win.out_neighbors, 1.0,
                                    "dst_weights", n)
-    return {r: {d: w for d, w in table[r].items() if d not in dead}
+    return {r: {d: w for d, w in table[r].items()
+                if d not in dead and (r, d) not in demoted}
             for r in range(n)}
 
 class _WindowOptimizer(_FusedOptimizer):
@@ -1322,6 +1336,9 @@ class _WindowOptimizer(_FusedOptimizer):
         # live telemetry plane: ~1 Hz self-gated sample so single-
         # controller jobs (no heartbeat tick) still stream bf.ts.<rank>
         _timeseries.maybe_sample()
+        # self-tuning controller: same self-gated funnel for single-
+        # controller jobs; no-op unless BLUEFOG_TUNE=1
+        _tuner.maybe_tick()
         return state, metrics
 
 
@@ -1345,24 +1362,26 @@ class DistributedWinPutOptimizer(_WindowOptimizer):
         # bump on join/leave/re-admission is what moves it), not re-derived
         # every step.
         dead = self._dead_ranks()
+        demoted = _tuner.demoted_edges()
         hyb = self._hybrid_part(dead)
         dst_weights, self_weight = self.dst_weights, self.self_weight
         neighbor_weights = self.neighbor_weights
-        if dead or hyb is not None:
+        if dead or demoted or hyb is not None:
             # the hybrid path needs the tables materialized even with an
             # empty dead set (the fused program takes explicit weights);
             # same cache, same per-dead-set rebuild discipline
             win = _windows._get_window(self._win_names[0])
             custom = (dst_weights is not None or self_weight is not None
                       or neighbor_weights is not None)
-            key = ("put", frozenset(dead))
+            key = ("put", frozenset(dead), demoted)
             cached = None if custom else self._healed_cache.get(key)
             if cached is None:
                 if dead:
                     _metrics.counter("opt.healed_rebuilds").inc()
                 sw, nw = _healed_recv_weights(win, dead, self_weight,
-                                              neighbor_weights)
-                cached = (_healed_send_table(win, dead, dst_weights), sw, nw)
+                                              neighbor_weights, demoted)
+                cached = (_healed_send_table(win, dead, dst_weights,
+                                             demoted), sw, nw)
                 if not custom:
                     if len(self._healed_cache) > 16:
                         self._healed_cache.clear()
@@ -1455,14 +1474,15 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
     def _gossip(self, leaves):
         st = _global_state()
         dead = self._dead_ranks()
+        demoted = _tuner.demoted_edges()
         hyb = self._hybrid_part(dead)
         src_weights, self_weight = self.src_weights, self.self_weight
         neighbor_weights = self.neighbor_weights
-        if dead or hyb is not None:
+        if dead or demoted or hyb is not None:
             win = _windows._get_window(self._win_names[0])
             custom = (src_weights is not None or self_weight is not None
                       or neighbor_weights is not None)
-            key = ("get", frozenset(dead))
+            key = ("get", frozenset(dead), demoted)
             cached = None if custom else self._healed_cache.get(key)
             if cached is None:
                 if dead:
@@ -1470,7 +1490,7 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
                 # pull only from LIVE sources (a dead peer's published
                 # tensor goes stale, and at re-publish races it could tear
                 # mass) and renormalize the combine over the live in-sets
-                _, live_in = _live_neighbor_sets(win, dead)
+                _, live_in = _live_neighbor_sets(win, dead, demoted)
                 if src_weights is None:
                     srcw = {r: {s: 1.0 for s in live_in[r]}
                             for r in range(win.size)}
@@ -1479,10 +1499,10 @@ class DistributedPullGetOptimizer(_WindowOptimizer):
                         src_weights, win.in_neighbors, 1.0, "src_weights",
                         win.size)
                     srcw = {r: {s: w for s, w in table[r].items()
-                                if s not in dead}
+                                if s not in dead and (s, r) not in demoted}
                             for r in range(win.size)}
                 sw, nw = _healed_recv_weights(win, dead, self_weight,
-                                              neighbor_weights)
+                                              neighbor_weights, demoted)
                 cached = (srcw, sw, nw)
                 if not custom:
                     if len(self._healed_cache) > 16:
@@ -1622,7 +1642,11 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
         # tables are cached per dead set (rebuilt only on membership
         # change, not re-derived every step).
         dead = self._dead_ranks()
-        key = frozenset(dead)
+        # tuner-demoted edges (ISSUE r16) drop from the SEND side here:
+        # push-sum normalizes sender columns, so mass re-splits over the
+        # remaining out-edges and stays conserved by construction
+        demoted = _tuner.demoted_edges()
+        key = (frozenset(dead), demoted)
         cached = self._healed_cache.get(key)
         if cached is None:
             if dead:  # the empty-set entry is the initial build, not a heal
@@ -1630,7 +1654,7 @@ class DistributedPushSumOptimizer(_WindowOptimizer):
             out_nbrs = {
                 r: [d for d in
                     topology_util.out_neighbor_ranks(st.topology, r)
-                    if d not in dead]
+                    if d not in dead and (r, d) not in demoted]
                 for r in range(n)
             }
             sw = {r: 1.0 / (len(out_nbrs[r]) + 1) for r in range(n)}

@@ -40,6 +40,7 @@ from . import control_plane as _cp
 from . import flight as _flight
 from . import metrics as _metrics
 from . import timeseries as _timeseries
+from . import tuner as _tuner
 from .logging import logger
 from .timeline import timeline_instant
 
@@ -289,6 +290,12 @@ class PeerMonitor:
         # delta on its own cadence — same zero-extra-threads discipline
         # as the metrics piggyback above.
         _timeseries.maybe_sample(cl)
+        # Self-tuning controller (docs/self_tuning.md): interval-gated
+        # like the sampler above, a no-op import-and-return unless
+        # BLUEFOG_TUNE=1. Riding the heartbeat gives the controller a
+        # cadence even when the training step stalls — which is exactly
+        # when it has work to do.
+        _tuner.maybe_tick(cl)
         # cluster-wide postmortem trigger (`bfrun --dump`): one KV read per
         # tick; on a bump this rank dumps locally and publishes its packed
         # tail under bf.flight.<rank> (docs/flight_recorder.md)

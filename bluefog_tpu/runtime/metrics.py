@@ -450,6 +450,11 @@ _HELP_EXACT: Dict[str, str] = {
                        "stalled)",
     "alert.fired": "rank-local alert rules fired (sustained threshold "
                    "breaches; docs/observability.md)",
+    "tune.decisions": "self-tuner lever actuations applied (codec "
+                      "escalations, in-degree moves, plane re-plans; "
+                      "docs/self_tuning.md)",
+    "tune.deferred": "self-tuner decisions deferred by the membership-"
+                     "epoch fence (re-derived on the next tick)",
     "cp.shards": "control-plane shards this process routes over",
     "cp.dead_shards": "control-plane shards currently failed over",
     "cp.shard_failovers": "shard keyspace failovers this client observed",
@@ -515,7 +520,7 @@ _HELP_PREFIX = (
 # resolution for every creation site in the package — a new family must
 # be added here (with curated HELP coverage) before it can ship.
 _PREFIX_FAMILIES = ("alert", "cp", "hb", "membership", "opt", "pushsum",
-                    "watchdog", "win")
+                    "tune", "watchdog", "win")
 
 
 def help_for(name: str) -> str:

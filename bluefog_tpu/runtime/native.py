@@ -278,23 +278,58 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
 #   trunc=1        request-lost drops first write HALF the frame, so the
 #                  server sees a truncated message, not a clean close
 #   seed=S         shifts which ops the drop counter fires on
+#   delay_edges=src>dst:ms,...
+#                  per-EDGE deposit delay (ISSUE r16): sleep ms before the
+#                  window deposit batch covering edge src->dst ships —
+#                  deterministic bandwidth ASYMMETRY, the self-tuning
+#                  controller's slow-edge fixture. Applied at the python
+#                  deposit site (ops/windows.py), not inside the native
+#                  client; terms after the first may ride further commas
+#                  or ``;`` / ``|`` separators.
 #
 # OFF unless BLUEFOG_CP_FAULT is set (or a test arms it explicitly): the
 # production path pays one relaxed atomic load per op, nothing else — the
 # chaos suite asserts this default (tests/test_chaos.py).
 
+def _parse_edge_delays(text: str) -> dict:
+    """``src>dst:ms(;src>dst:ms)*`` -> {(src, dst): ms}."""
+    out: dict = {}
+    for term in str(text).replace("|", ";").split(";"):
+        term = term.strip()
+        if not term:
+            continue
+        try:
+            edge_s, ms_s = term.rsplit(":", 1)
+            src_s, dst_s = edge_s.split(">", 1)
+            out[(int(src_s), int(dst_s))] = int(ms_s)
+        except ValueError:
+            raise ValueError(
+                f"BLUEFOG_CP_FAULT: bad delay_edges term {term!r} "
+                "(grammar: delay_edges=src>dst:ms,src>dst:ms,...)")
+    return out
+
+
 def parse_fault_spec(spec: str) -> dict:
-    out = {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0}
+    out = {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0,
+           "delay_edges": {}}
     for item in (spec or "").split(","):
         item = item.strip()
         if not item:
             continue
         key, sep, val = item.partition("=")
         key = key.strip()
-        if not sep or key not in out:
+        if sep and key == "delay_edges":
+            out["delay_edges"].update(_parse_edge_delays(val))
+            continue
+        if not sep and ">" in item and ":" in item:
+            # continuation of a comma-separated delay_edges list
+            out["delay_edges"].update(_parse_edge_delays(item))
+            continue
+        if not sep or key not in out or key == "delay_edges":
             raise ValueError(
                 f"BLUEFOG_CP_FAULT: bad entry {item!r} (grammar: "
-                "drop_after=N,delay_ms=M,trunc=0|1,seed=S)")
+                "drop_after=N,delay_ms=M,trunc=0|1,seed=S,"
+                "delay_edges=src>dst:ms,...)")
         out[key] = int(val.strip())
     return out
 
@@ -313,14 +348,43 @@ def fault_arm(spec=None, **overrides) -> dict:
     lib.bf_cp_fault(int(cfg.get("drop_after", 0)),
                     int(cfg.get("delay_ms", 0)),
                     int(cfg.get("trunc", 0)), int(cfg.get("seed", 0)))
+    global _edge_delays
+    _edge_delays = dict(cfg.get("delay_edges") or {})
     return cfg
 
 
 def fault_disarm() -> None:
     """Turn injection off (counters reset)."""
+    global _edge_delays
+    _edge_delays = {}
     lib = load()
     if lib is not None:
         lib.bf_cp_fault(0, 0, 0, 0)
+
+
+# Per-edge deposit delays live python-side (the native client has no edge
+# concept — a deposit is just a keyed append): lazily parsed from the env
+# so they work even where the native library is unavailable, and kept in
+# sync by fault_arm / fault_disarm.
+_edge_delays: Optional[dict] = None
+
+
+def edge_delays() -> dict:
+    """{(src, dst): ms} from BLUEFOG_CP_FAULT's delay_edges clause
+    (empty unless armed). ops/windows.py consults this per deposit
+    batch; a malformed env spec degrades to no delays (the native arm
+    path already warned)."""
+    global _edge_delays
+    if _edge_delays is None:
+        cfg: dict = {}
+        spec = os.environ.get("BLUEFOG_CP_FAULT")
+        if spec:
+            try:
+                cfg = parse_fault_spec(spec).get("delay_edges") or {}
+            except ValueError:
+                cfg = {}
+        _edge_delays = cfg
+    return _edge_delays
 
 
 def fault_stats() -> dict:

@@ -164,6 +164,10 @@ def init(
     # alert-rule state; re-reads BLUEFOG_ALERT_RULES/TS_* knobs).
     from . import timeseries as _timeseries
     _timeseries.reset_for_job()
+    # Fresh self-tuning controller state (hysteresis clocks, codec
+    # levels, demotion view; re-reads BLUEFOG_TUNE* knobs).
+    from . import tuner as _tuner
+    _tuner.reset_for_job()
     # Fresh flight-recorder ring + wall-clock anchor (a postmortem dump
     # belongs to THIS job), and the abnormal-exit hook so an uncaught
     # exception leaves a dump behind (docs/flight_recorder.md).

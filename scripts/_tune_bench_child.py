@@ -1,0 +1,154 @@
+"""Child process for scripts/tune_bench.py (one of 4 controllers).
+
+Runs the optimizer-shaped gossip loop — healed send/receive tables with
+the self-tuning controller's demoted edges dropped from the send side
+(the exact tables ``optimizers._gossip`` builds) — over the REAL hosted
+window wire, under ``BLUEFOG_CP_FAULT delay_edges`` asymmetry, with one
+rank straggling by a per-round sleep. Free-running rounds (no per-round
+barrier): the straggler genuinely falls behind in published ``opt.step``,
+which is the step-counter-spread signal the controller's in-degree lever
+consumes. Controller ticks ride the production funnels (heartbeat tail +
+the per-round ``tuner.maybe_tick`` the optimizer step tail mirrors).
+
+The jax mesh stays single-device per controller (CPU multiprocess
+collectives are unavailable — the win_microbench constraint), so the
+gossip rides numpy rows through the window plane exactly like
+scripts/_win_microbench_child.py.
+
+Configuration via env (set by the parent): BLUEFOG_TB_CONFIG (row
+label), BLUEFOG_TB_SECONDS (timed duration), BLUEFOG_TB_STRAGGLER
+(rank), BLUEFOG_TB_STRAGGLE_MS (its per-round sleep).
+"""
+
+import json
+import os
+import struct
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+import bluefog_tpu as bf  # noqa: E402
+from bluefog_tpu import optimizers as O  # noqa: E402
+from bluefog_tpu.ops import windows as win_mod  # noqa: E402
+from bluefog_tpu.runtime import control_plane  # noqa: E402
+from bluefog_tpu.runtime import metrics as mx  # noqa: E402
+from bluefog_tpu.runtime import tuner  # noqa: E402
+
+N = 4
+ELEMS = 4096  # 16 KB f32 rows: wire-meaningful, mailbox-cap safe
+WARMUP = 3
+
+CONFIG = os.environ.get("BLUEFOG_TB_CONFIG") or "static-none"
+DURATION = float(os.environ.get("BLUEFOG_TB_SECONDS", "12"))
+STRAGGLER = int(os.environ.get("BLUEFOG_TB_STRAGGLER", "3"))
+STRAGGLE_MS = float(os.environ.get("BLUEFOG_TB_STRAGGLE_MS", "150"))
+
+
+def put_f(cl, key, v):
+    cl.put(key, struct.unpack("<q", struct.pack("<d", float(v)))[0])
+
+
+def get_f(cl, key):
+    return struct.unpack("<d", struct.pack("<q", cl.get(key)))[0]
+
+
+def main() -> int:
+    bf.init()
+    pid = jax.process_index("cpu")
+    assert bf.size() == N and control_plane.world() == N
+    bf.set_topology(bf.topology_util.ExponentialTwoGraph(N))
+    cl = control_plane.client()
+
+    x = np.zeros((N, ELEMS), np.float32)
+    x[:] = np.arange(N, dtype=np.float32)[:, None]
+    name = "tb.win"
+    assert bf.win_create(x, name, zero_init=True)
+    win = win_mod._get_window(name)
+    control_plane.barrier("tb.sync")
+
+    def gossip_round():
+        # the optimizer gossip shape (optimizers._gossip): demoted edges
+        # drop from the send table — skipping the deposit is where the
+        # demotion saves both the wire bytes and the injected edge delay
+        demoted = tuner.demoted_edges()
+        send = O._healed_send_table(win, set(), None, demoted)
+        sw, nw = O._healed_recv_weights(win, set(), None, None, demoted)
+        bf.win_put(x, name, dst_weights=send)
+        bf.win_update(name, sw, nw)
+
+    for _ in range(WARMUP):
+        gossip_round()
+    control_plane.barrier("tb.warm")
+
+    bytes0 = mx.counter("win.deposit_bytes").value
+    rounds = 0
+    first_demote = None
+    t_start = time.monotonic()
+    t_end = t_start + DURATION
+    while time.monotonic() < t_end:
+        gossip_round()
+        rounds += 1
+        mx.gauge("opt.step").set(rounds)
+        mx.maybe_publish(cl)
+        tuner.maybe_tick(cl)
+        if first_demote is None and tuner.demoted_edges():
+            first_demote = time.monotonic() - t_start
+        if pid == STRAGGLER:
+            time.sleep(STRAGGLE_MS / 1e3)
+    wire_mb = (mx.counter("win.deposit_bytes").value - bytes0) / 1e6
+
+    put_f(cl, f"tb.rounds.{pid}", rounds)
+    put_f(cl, f"tb.wire.{pid}", wire_mb)
+    put_f(cl, f"tb.tdem.{pid}", -1.0 if first_demote is None
+          else first_demote)
+    control_plane.barrier("tb.done")
+    if pid == 0:
+        per_rounds = [int(get_f(cl, f"tb.rounds.{p}")) for p in range(N)]
+        per_wire = [round(get_f(cl, f"tb.wire.{p}"), 2) for p in range(N)]
+        tdems = [get_f(cl, f"tb.tdem.{p}") for p in range(N)]
+        tdems = [t for t in tdems if t >= 0]
+        healthy = [per_rounds[p] for p in range(N) if p != STRAGGLER]
+        row = {
+            "config": CONFIG,
+            "seconds": DURATION,
+            "rounds": per_rounds,
+            "healthy_steps_per_s": round(sum(healthy) / DURATION, 1),
+            "straggler_steps_per_s": round(
+                per_rounds[STRAGGLER] / DURATION, 1),
+            "wire_mb": per_wire,
+            "time_to_first_demotion_s": (round(min(tdems), 2)
+                                         if tdems else None),
+            "demoted_final": sorted(list(e)
+                                    for e in tuner.demoted_edges()),
+        }
+        try:
+            blob = cl.get_bytes(tuner.TUNE_KEY_FMT.format(rank=0))
+            if blob:
+                doc = json.loads(bytes(blob).decode())
+                row["decision_trail"] = [
+                    d for d in doc.get("decisions", [])
+                    if d.get("status") == "applied"]
+        except OSError:
+            pass
+        print(json.dumps(row), flush=True)
+    control_plane.barrier("tb.exit")
+    # Skip bf.shutdown() + the jax.distributed atexit teardown: on the
+    # single-core CI box the staggered interpreter exits can hold one
+    # task past the coordination-service heartbeat window while it sits
+    # in the shutdown barrier, SIGABRTing the whole job AFTER every
+    # result is posted. All rows are on the wire by the barrier above;
+    # a hard exit is the reliable teardown for this harness.
+    time.sleep(1.0)  # let the slowest rank observe the barrier release
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

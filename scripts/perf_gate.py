@@ -14,12 +14,14 @@ copy, a lost overlap) costs 2-10x.
 
 Only the *stable* quick-mode series gate: the hosted window ops
 (win_put / win_accumulate / win_update / win_get MB/s), the optimizer
-step rates, the ``hybrid.*`` plane-sweep rates (gating since r15), and —
-since r18, two stable rounds after r15 introduced them — the ``codec.*``
-compressed-wire window-op rates. Sub-millisecond raw-socket probes, the
-codec wire-leg probes (``drain_stream``: 2x run-to-run jitter), and the
-``sharded.*`` sharded-window series are reported in the JSON but never
-gate (sharded.* graduates the same way once it shows two stable rounds).
+step rates, the ``hybrid.*`` plane-sweep rates (gating since r15), the
+``codec.*`` compressed-wire window-op rates (gating since r18), and —
+since r19, two stable rounds after r17 introduced them — the
+``sharded.*`` sharded-window series, including the counter-delta
+``wire_reduction_x`` ratios (deterministic byte accounting, the least
+noisy rows in the gate). Sub-millisecond raw-socket probes and the codec
+wire-leg probes (``drain_stream``: 2x run-to-run jitter) are reported in
+the JSON but never gate.
 
 Exit codes: 0 pass, 1 regression (or a bench failed), 2 usage/baseline
 problems.
@@ -72,10 +74,10 @@ def collect_once() -> dict:
     out: dict = {}
     # the --codec and --sharded sweeps ride the SAME 4-process run (extra
     # rows after the plain series, which stay untouched): codec.* GATES
-    # since r18 (window-op rates only — see gating()); `sharded.*` stays
-    # info-only per the stable-series rule; the sharded run also
-    # counter-delta ASSERTS the ≥0.9·S wire-byte reduction inside the
-    # child — a broken claim fails the run
+    # since r18 (window-op rates only — see gating()); sharded.* GATES
+    # since r19 (mbps rows plus the wire_reduction_x counter-delta
+    # ratios); the sharded run also ASSERTS the ≥0.9·S wire-byte
+    # reduction inside the child — a broken claim fails the run outright
     text = _run([sys.executable, "scripts/win_microbench.py", "--quick",
                  "--codec", "int8,topk:0.01", "--sharded", "2,4"],
                 timeout=900)
@@ -156,12 +158,6 @@ def collect(repeats: int) -> dict:
 def gating(metrics: dict) -> dict:
     keep = {}
     for name, v in metrics.items():
-        if name.startswith("sharded."):
-            # r17 sharded-window series: info-only until two stable
-            # rounds (the gate's stable-series rule) — then delete this
-            # branch and refresh the baseline, exactly as hybrid.* (r15)
-            # and codec.* (r18) graduated
-            continue
         if name.startswith("codec.") and \
                 not any(name.endswith(f"{op}.mbps")
                         for op in _GATING_OPS):
@@ -171,8 +167,14 @@ def gating(metrics: dict) -> dict:
             continue
         if name.startswith("opt.") or name.startswith("hybrid.") or \
                 name.startswith("codec.") or \
+                name.startswith("sharded.") or \
                 any(name.endswith(f"{op}.mbps") or f".{op}." in name
                     for op in _GATING_OPS):
+            # sharded.* GATES since r19 (two stable rounds elapsed since
+            # r17, per the stable-series rule — the same graduation
+            # hybrid.* took at r15 and codec.* at r18); its
+            # wire_reduction_x rows are counter-delta ratios, the most
+            # deterministic series in the gate
             keep[name] = v
     return keep
 
@@ -213,7 +215,7 @@ def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
             "band": band,
             "harnesses": ["win_microbench --quick --codec int8,topk:0.01 "
                           "--sharded 2,4 (codec.* window-op rates gating "
-                          "since r18; sharded.* info-only)",
+                          "since r18; sharded.* gating since r19)",
                           "opt_matrix_bench --quick --modes "
                           + " ".join(_OPT_MODES),
                           "opt_matrix_bench --quick --hybrid"],

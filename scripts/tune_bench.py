@@ -1,0 +1,168 @@
+"""Self-tuning controller benchmark: BLUEFOG_TUNE=1 vs static configs
+under an injected straggler + per-edge delay asymmetry (the PR 16
+acceptance experiment, PERF.md "self-tuning" section).
+
+Launches 4 REAL controller processes through ``bfrun`` (auth ON, the
+win_microbench pattern) three times — identical fault injection each
+run, only the tuning config differs:
+
+  static-none   no wire codec, no controller
+  static-int8   BLUEFOG_WIN_CODEC=int8 (the best static answer that
+                doesn't change the graph)
+  tuned         BLUEFOG_TUNE=1 with bench-cadence rules (straggler_for=2,
+                dwell=5, keep_in=1; codec lever parked via slow_ratio=0 —
+                transit percentiles live in the receiver's store, so the
+                in-degree lever is the one under test here)
+
+Fault shape (BLUEFOG_CP_FAULT delay_edges + a sleeping rank):
+
+  * every deposit on 0>1, 1>3 and 2>3 pays +60 ms — each healthy rank
+    owns exactly ONE delayed out-edge, so their untuned round rates are
+    comparable and any win is attributable to the controller;
+  * rank 3 additionally sleeps 150 ms per round — the sustained
+    straggler whose step-counter spread the in-degree lever demotes.
+
+Static configs pay the delayed edges forever (int8 shrinks bytes but a
+fixed per-deposit delay doesn't care). The tuned run's leader demotes
+the straggler's slowest in-edges with total-preserving renorm; the
+freed senders skip both the bytes AND the injected delay, so healthy
+aggregate steps/s must beat both statics — that number, plus wire MB,
+time-to-first-demotion, and rank 0's decision trail, is the output.
+
+Each child prints one JSON row (rank 0 only); this parent relays them
+and renders the PERF.md markdown table at the end.
+
+Usage:  python scripts/tune_bench.py [--quick] [--seconds N]
+  --quick: 4 s timed window per config — shakes out harness bugs in
+           ~30 s; numbers are NOT meaningful for PERF.md.
+"""
+
+import argparse
+import json
+import os
+import secrets
+import socket
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
+
+DELAY_SPEC = "delay_edges=0>1:60,1>3:60,2>3:60"
+TUNED_RULES = "slow_ratio=0,straggler_for=2,dwell=5,keep_in=1"
+
+CONFIGS = [
+    ("static-none", {}),
+    ("static-int8", {"BLUEFOG_WIN_CODEC": "int8"}),
+    ("tuned", {"BLUEFOG_TUNE": "1",
+               "BLUEFOG_TUNE_INTERVAL": "0.5",
+               "BLUEFOG_TUNE_RULES": TUNED_RULES}),
+]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_config(label: str, extra_env: dict, seconds: float) -> dict:
+    env = os.environ.copy()
+    for k in ("XLA_FLAGS", "JAX_PLATFORMS", "BLUEFOG_TIMELINE",
+              "BLUEFOG_CP_HOST", "BLUEFOG_CP_PORT", "BLUEFOG_WIN_CODEC",
+              "BLUEFOG_TUNE", "BLUEFOG_TUNE_INTERVAL",
+              "BLUEFOG_TUNE_RULES", "BLUEFOG_CP_FAULT"):
+        env.pop(k, None)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BLUEFOG_CP_SECRET"] = secrets.token_hex(16)
+    env["BLUEFOG_CP_FAULT"] = DELAY_SPEC
+    # bench cadences: publish/tick fast enough that a 12 s window holds
+    # detection (straggler_for=2 sustained) + dwell + recovery headroom
+    env["BLUEFOG_HEARTBEAT_INTERVAL"] = "0.5"
+    env["BLUEFOG_METRICS_INTERVAL"] = "0.5"
+    env["BLUEFOG_TS_INTERVAL"] = "0.5"
+    env["BLUEFOG_TB_CONFIG"] = label
+    env["BLUEFOG_TB_SECONDS"] = str(seconds)
+    env.update(extra_env)
+
+    port = free_port()
+    child = str(REPO / "scripts" / "_tune_bench_child.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "bluefog_tpu.launcher", "-np", "4",
+             "--coordinator", f"127.0.0.1:{port}", "--process-id", str(i),
+             "--simulate", "1", "--", sys.executable, child],
+            env=env,
+            stdout=subprocess.PIPE if i == 0 else subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        for i in range(4)
+    ]
+    row = None
+    out, _ = procs[0].communicate(timeout=600)
+    for p in procs[1:]:
+        p.wait(timeout=600)
+    for line in out.decode(errors="replace").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if doc.get("config") == label:
+                row = doc
+    rcs = [p.returncode for p in procs]
+    if any(rcs) or row is None:
+        raise SystemExit(f"tune_bench: config {label} failed "
+                         f"(rcs={rcs}, row={'ok' if row else 'missing'})")
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--seconds", type=float, default=None)
+    args = ap.parse_args()
+    seconds = args.seconds or (4.0 if args.quick else 12.0)
+
+    rows = []
+    for label, extra in CONFIGS:
+        print(f"# tune_bench: {label} ({seconds:g}s timed)...",
+              file=sys.stderr, flush=True)
+        row = run_config(label, extra, seconds)
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+
+    by = {r["config"]: r for r in rows}
+    tuned, none_, int8 = by["tuned"], by["static-none"], by["static-int8"]
+    print("\n| config | healthy steps/s (sum of 3) | straggler steps/s "
+          "| wire MB (per rank) | first demotion |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        t = r.get("time_to_first_demotion_s")
+        print(f"| {r['config']} | {r['healthy_steps_per_s']} "
+              f"| {r['straggler_steps_per_s']} "
+              f"| {', '.join(str(w) for w in r['wire_mb'])} "
+              f"| {t if t is not None else '—'} s |"
+              .replace("| None s |", "| — |"))
+    best_static = max(none_["healthy_steps_per_s"],
+                      int8["healthy_steps_per_s"])
+    win = tuned["healthy_steps_per_s"] / best_static if best_static else 0
+    print(f"\n# tuned vs best static: {win:.2f}x healthy throughput; "
+          f"demoted_final={tuned.get('demoted_final')}", flush=True)
+    if not args.quick:
+        assert tuned["healthy_steps_per_s"] > best_static, (
+            "acceptance: BLUEFOG_TUNE=1 must beat both static configs "
+            f"({tuned['healthy_steps_per_s']} vs {best_static})")
+        assert tuned.get("demoted_final"), \
+            "tuned run ended with no demoted edges"
+    print("TUNE_BENCH_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

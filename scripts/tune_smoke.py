@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Self-tuning-controller smoke test (`make tune-smoke`).
+
+A 4-rank in-process job with the control plane + hosted window plane
+forced on, asserting the acceptance surface of the online performance
+controller (docs/self_tuning.md) end to end:
+
+  * **healthy fleet => zero decisions**: with no slow edges, no
+    stragglers, and no alerts, repeated controller ticks apply nothing
+    (``tune.decisions`` stays 0 and the demotion set stays empty);
+  * **asymmetric edge delay** (``BLUEFOG_CP_FAULT delay_edges``) is
+    really armed: the deposit batch covering the delayed edge ships
+    measurably late, and the slow edge's transit pressure escalates its
+    wire codec one ladder rung (``Window.set_edge_codec``, receiver
+    untouched) within a few ticks;
+  * **injected straggler => demotion within N ticks**: a rank whose
+    published ``opt.step`` gauge trails the fleet is demoted to its
+    ``keep_in`` fastest in-edges, the decision rides the epoch-fenced
+    ``bf.tune.demoted`` document, and the membership epoch is bumped so
+    every optimizer re-plans at the same boundary;
+  * **numpy-oracle parity**: the optimizers' healed receive weights
+    under the demotion equal the column-renormalized weight matrix
+    computed independently in numpy (total-preserving, convex), and the
+    healed send table drops exactly the demoted edges;
+  * **recovery => promotion**: once the straggler catches up, the
+    demotion is lifted and the healed tables return to the original
+    uniform weights EXACTLY (the demote -> promote round-trip);
+  * the decision trail (``bf.tune.<rank>``) records every move and
+    ``bfrun --top`` renders the SELF-TUNER section from it.
+
+Exits non-zero (with a message) on any violated assertion.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("BLUEFOG_FLIGHT_DIR",
+                      tempfile.mkdtemp(prefix="bf_flight_"))
+
+_s = socket.socket()
+_s.bind(("127.0.0.1", 0))
+PORT = _s.getsockname()[1]
+_s.close()
+
+os.environ.update({
+    "BLUEFOG_CP_HOST": "127.0.0.1",
+    "BLUEFOG_CP_PORT": str(PORT),
+    "BLUEFOG_CP_WORLD": "1",
+    "BLUEFOG_CP_RANK": "0",
+    "BLUEFOG_WIN_HOST_PLANE": "1",
+    "BLUEFOG_METRICS_INTERVAL": "1",
+    "BLUEFOG_TS_INTERVAL": "1",
+    # the knob is ON (the demotion consumers are live) but the passive
+    # heartbeat/step funnels are interval-gated out of the way — the
+    # harness drives tick() with a synthetic clock for determinism
+    "BLUEFOG_TUNE": "1",
+    "BLUEFOG_TUNE_INTERVAL": "3600",
+    # deterministic bandwidth asymmetry: deposits covering 0->1 ship late
+    "BLUEFOG_CP_FAULT": "delay_edges=0>1:60",
+})
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+import bluefog_tpu as bf  # noqa: E402
+from bluefog_tpu import optimizers as O  # noqa: E402
+from bluefog_tpu.ops import codec as codec_mod  # noqa: E402
+from bluefog_tpu.ops import windows as win_mod  # noqa: E402
+from bluefog_tpu.runtime import control_plane as cp  # noqa: E402
+from bluefog_tpu.runtime import heartbeat as hb  # noqa: E402
+from bluefog_tpu.runtime import metrics as mx  # noqa: E402
+from bluefog_tpu.runtime import timeseries as ts  # noqa: E402
+from bluefog_tpu.runtime import tuner  # noqa: E402
+from bluefog_tpu.runtime.state import _global_state  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORLD = 4
+
+# fast-hysteresis decision table: seconds-scale sustained windows so the
+# smoke converges in a handful of synthetic-clock ticks; slow_ratio off so
+# the codec lever is driven by the transit trigger alone (deterministic)
+RULES = dict(tuner.DEFAULT_RULES, slow_ratio=0.0, transit_p99_ms=10.0,
+             slow_for=1.0, straggler_for=1.0, dwell=2.0, keep_in=1.0)
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"tune-smoke FAILED: {msg}", file=sys.stderr)
+        sys.exit(1)
+
+
+def publish_snapshot(cl, rank: int, step: float) -> None:
+    """Publish a peer metrics snapshot (the straggler injection: the
+    step-counter-spread detector consumes exactly these gauges)."""
+    cl.put_bytes(mx._metrics_key(rank), mx.pack_snapshot({
+        "meta": {"schema": 1, "rank": rank, "inc": 0, "ts": time.time()},
+        "counters": {}, "gauges": {"opt.step": float(step)}, "hists": {}}))
+
+
+def main() -> int:
+    bf.init(devices=jax.devices("cpu")[:WORLD])
+    st = _global_state()
+    cl = cp.client()
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf.DistributedWinPutOptimizer(optax.sgd(0.1), zloss,
+                                        window_prefix="tune.wp")
+    state = opt.init({"w": jnp.ones((64,), jnp.float32)})
+    for _ in range(6):
+        state, _ = opt.step(state, jnp.zeros((WORLD, 1), jnp.float32))
+    s0 = mx.gauge("opt.step").value
+
+    # one controller, world-4 sensor view, harness-pinned decision table;
+    # installed as the singleton so module consumers share its state
+    tn = tuner.Tuner(0, WORLD, rules=RULES)
+    tuner._singleton = tn
+    t = time.time()
+
+    def tick():
+        # production order (heartbeat tail): sample the telemetry plane,
+        # then tick the controller off the freshened store
+        ts.maybe_sample(force=True, publish=True)
+        nonlocal t
+        t += 1.0
+        return tn.tick(cl, t)
+
+    # 1) healthy fleet: N ticks, zero decisions, nothing demoted
+    for _ in range(3):
+        check(tick() == [], "controller applied a decision on a healthy "
+              "fleet")
+    check(mx.counter("tune.decisions").value == 0,
+          "tune.decisions moved on a healthy fleet")
+    check(tuner.demoted_edges() == frozenset(),
+          "demotion set non-empty on a healthy fleet")
+    print("healthy fleet: 0 decisions over 3 ticks — ok")
+
+    # 2) asymmetric delay + slow-edge codec escalation. Split-ownership
+    # flow pair (the test_metrics harness): the origin half owns rank 0
+    # and deposits over the REAL server wire — where the delay_edges
+    # clause injects — and the owner half drains late, so the 0->1
+    # transit estimator carries the pressure the codec lever keys on.
+    x = bf.shard_rank_stacked(bf.mesh(), jnp.ones((WORLD, 256)))
+    orig_owned = cp.owned_ranks
+    try:
+        cp.owned_ranks = lambda devs, pid: [0]
+        check(bf.win_create(x, "tune.flow", zero_init=True),
+              "win_create failed")
+        cp.owned_ranks = lambda devs, pid: [1]
+        win_b = win_mod.Window("tune.flow", np.ones((WORLD, 256), np.float32),
+                               zero_init=True)
+        slowest_put = 0.0
+        for _ in range(4):
+            t0 = time.monotonic()
+            bf.win_put(x, "tune.flow")
+            slowest_put = max(slowest_put, time.monotonic() - t0)
+            time.sleep(0.03)  # drain late: deposit->drain transit > 10 ms
+            with win_b.state_mu:
+                win_b._drain_deposits()
+    finally:
+        cp.owned_ranks = orig_owned
+    check(slowest_put >= 0.055,
+          f"delay_edges=0>1:60 not armed: slowest win_put "
+          f"{slowest_put * 1e3:.1f} ms")
+    win_o = st.windows["tune.flow"]
+    for i in range(4):
+        applied = tick()
+        if any(d.lever == "codec" and d.target == (0, 1) for d in applied):
+            break
+    check(tn._level.get((0, 1), 0) >= 1,
+          "slow edge 0->1 never escalated off the raw codec")
+    check((0, 1) in win_o._edge_codec and
+          win_o._edge_codec[(0, 1)].cid == codec_mod.CODEC_INT8,
+          f"edge codec not installed on the window: {win_o._edge_codec}")
+    check(mx.counter("win.codec.edge_switches").value >= 1,
+          "edge-switch counter never moved")
+    print(f"slow edge 0->1 escalated to int8 after {i + 1} tick(s) — ok")
+
+    # 3) injected straggler -> demotion within N ticks, epoch-fenced
+    ep0 = hb.membership_epoch()
+    demoted = frozenset()
+    for i in range(8):
+        for r in (1, 2):
+            publish_snapshot(cl, r, s0)
+        publish_snapshot(cl, 3, s0 - 10)  # rank 3 trails the fleet
+        tick()
+        demoted = tuner.demoted_edges()
+        if demoted:
+            break
+    check(demoted, "straggler was never demoted (8 ticks)")
+    check(i + 1 <= 4, f"demotion took {i + 1} ticks (bound: 4)")
+    check(all(dst == 3 for _, dst in demoted),
+          f"demoted edges not all into rank 3: {sorted(demoted)}")
+    in3 = set(win_o.in_neighbors[3])
+    check(len(demoted) == len(in3) - int(RULES["keep_in"]),
+          f"expected keep_in={RULES['keep_in']:g} of {sorted(in3)} kept, "
+          f"demoted {sorted(demoted)}")
+    doc = json.loads(bytes(cl.get_bytes(tuner.DEMOTE_KEY)).decode())
+    check({tuple(e) for e in doc["edges"]} == set(demoted),
+          f"bf.tune.demoted document disagrees: {doc}")
+    check(hb.membership_epoch() > ep0,
+          "membership epoch not bumped by the demotion")
+    print(f"straggler demoted after {i + 1} tick(s): {sorted(demoted)} — ok")
+
+    # 4) numpy-oracle parity: healed receive weights == the column-
+    # renormalized uniform weight matrix, healed send table drops exactly
+    # the demoted edges
+    W = np.zeros((WORLD, WORLD))
+    for r in range(WORLD):
+        w = 1.0 / (len(win_o.in_neighbors[r]) + 1)
+        W[r, r] = w
+        for s in win_o.in_neighbors[r]:
+            W[s, r] = w
+    Wd = W.copy()
+    for s, d in demoted:
+        Wd[s, d] = 0.0
+    for d in {d for _, d in demoted}:
+        Wd[:, d] *= W[:, d].sum() / Wd[:, d].sum()
+    sw, nw = O._healed_recv_weights(win_o, set(), None, None, demoted)
+    for r in range(WORLD):
+        check(abs(sw[r] - Wd[r, r]) < 1e-12, f"self weight rank {r}: "
+              f"{sw[r]} vs oracle {Wd[r, r]}")
+        oracle_in = {s: Wd[s, r] for s in win_o.in_neighbors[r]
+                     if (s, r) not in demoted}
+        check(set(nw[r]) == set(oracle_in) and
+              all(abs(nw[r][s] - oracle_in[s]) < 1e-12 for s in oracle_in),
+              f"in-weights rank {r}: {nw[r]} vs oracle {oracle_in}")
+        check(abs(sw[r] + sum(nw[r].values()) - 1.0) < 1e-12,
+              f"column {r} total not preserved")
+    send = O._healed_send_table(win_o, set(), None, demoted)
+    for s, d in demoted:
+        check(d not in send[s], f"demoted edge {s}->{d} still in the "
+              "send table")
+    print("healed tables match the numpy renormalization oracle — ok")
+
+    # 5) recovery -> promotion, demote -> promote round-trip exact
+    for i in range(8):
+        for r in (1, 2, 3):
+            publish_snapshot(cl, r, s0)  # rank 3 caught up
+        tick()
+        if not tuner.demoted_edges():
+            break
+    check(tuner.demoted_edges() == frozenset(),
+          "recovered straggler was never promoted (8 ticks)")
+    sw2, nw2 = O._healed_recv_weights(win_o, set(), None, None, frozenset())
+    for r in range(WORLD):
+        u = 1.0 / (len(win_o.in_neighbors[r]) + 1)
+        check(sw2[r] == u and
+              nw2[r] == {s: u for s in win_o.in_neighbors[r]},
+              f"round-trip weights rank {r} not restored exactly")
+    print(f"straggler promoted after {i + 1} tick(s), weights restored "
+          "exactly — ok")
+
+    # 6) decision trail + --top rendering
+    trail = json.loads(bytes(cl.get_bytes(
+        tuner.TUNE_KEY_FMT.format(rank=0))).decode())
+    acts = {(d["lever"], d["action"]) for d in trail["decisions"]
+            if d["status"] == "applied"}
+    check({("codec", "escalate"), ("indegree", "demote"),
+           ("indegree", "promote")} <= acts,
+          f"decision trail incomplete: {sorted(acts)}")
+    # the transit pressure persists across the phases, so the slow edge
+    # may have climbed past int8 by now — any raised rung is correct
+    check(trail["levels"].get("0>1") in ("int8", "topk:0.01"),
+          f"trail levels wrong: {trail['levels']}")
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--top", "--once"],
+        env=dict(os.environ), capture_output=True, text=True, timeout=120)
+    check(out.returncode == 0, f"bfrun --top failed: {out.stderr}")
+    check("SELF-TUNER" in out.stdout,
+          f"--top missing the SELF-TUNER section: {out.stdout!r}")
+    print("decision trail published and rendered by --top — ok")
+
+    opt.free()
+    bf.shutdown()
+    print("tune-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

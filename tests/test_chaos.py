@@ -83,14 +83,90 @@ def test_fault_layer_off_by_default(server):
 
 def test_parse_fault_spec_grammar():
     assert native.parse_fault_spec("drop_after=37,delay_ms=50,trunc=1,seed=7") \
-        == {"drop_after": 37, "delay_ms": 50, "trunc": 1, "seed": 7}
+        == {"drop_after": 37, "delay_ms": 50, "trunc": 1, "seed": 7,
+            "delay_edges": {}}
     assert native.parse_fault_spec("drop_after=5") == \
-        {"drop_after": 5, "delay_ms": 0, "trunc": 0, "seed": 0}
+        {"drop_after": 5, "delay_ms": 0, "trunc": 0, "seed": 0,
+         "delay_edges": {}}
     assert native.parse_fault_spec("")["drop_after"] == 0
     with pytest.raises(ValueError):
         native.parse_fault_spec("drop_every=5")
     with pytest.raises(ValueError):
         native.parse_fault_spec("drop_after")
+
+
+def test_parse_fault_spec_delay_edges():
+    """ISSUE r16: the per-edge asymmetric-delay clause — `;`/`|`
+    separators, comma continuation after the clause, composition with
+    the scalar knobs — and the malformed-term red path."""
+    assert native.parse_fault_spec("delay_edges=0>1:80") == \
+        {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0,
+         "delay_edges": {(0, 1): 80}}
+    # multi-edge: `;` and `|` separators, plus bare comma continuation
+    assert native.parse_fault_spec("delay_edges=0>1:80;2>3:40") \
+        ["delay_edges"] == {(0, 1): 80, (2, 3): 40}
+    assert native.parse_fault_spec("delay_edges=0>1:80|2>3:40") \
+        ["delay_edges"] == {(0, 1): 80, (2, 3): 40}
+    assert native.parse_fault_spec("delay_edges=0>1:80,2>3:40") \
+        ["delay_edges"] == {(0, 1): 80, (2, 3): 40}
+    # composes with the scalar knobs in either order
+    cfg = native.parse_fault_spec("drop_after=9,delay_edges=1>0:25,seed=3")
+    assert cfg["drop_after"] == 9 and cfg["seed"] == 3
+    assert cfg["delay_edges"] == {(1, 0): 25}
+    for bad in ("delay_edges=0-1:80", "delay_edges=0>1", "delay_edges=x>y:5"):
+        with pytest.raises(ValueError):
+            native.parse_fault_spec(bad)
+
+
+def test_edge_delays_accessor_off_and_armed(monkeypatch):
+    """edge_delays() is the deposit site's view: empty unless armed, in
+    sync with fault_arm/fault_disarm, env-lazy for library-less use."""
+    native.fault_disarm()
+    assert native.edge_delays() == {}
+    native.fault_arm("delay_edges=0>1:15,drop_after=0")
+    assert native.edge_delays() == {(0, 1): 15}
+    native.fault_disarm()
+    assert native.edge_delays() == {}
+    # env-lazy path (no explicit arm): honored after a cache reset
+    monkeypatch.setenv("BLUEFOG_CP_FAULT", "delay_edges=2>0:5")
+    native._edge_delays = None
+    assert native.edge_delays() == {(2, 0): 5}
+    monkeypatch.delenv("BLUEFOG_CP_FAULT")
+    native._edge_delays = None
+    assert native.edge_delays() == {}
+
+
+def test_asymmetric_edge_delay_at_deposit_site(monkeypatch):
+    """ISSUE r16 asymmetric-delay case: with ``delay_edges`` armed, the
+    hosted deposit batch partitions by per-edge delay — undelayed edges
+    ship immediately, the slow edge's records land only after its
+    injected delay, and every reply maps back to its original record
+    slot. This is the deterministic bandwidth-asymmetry fixture the
+    self-tuner's slow-edge detector trains against."""
+    from bluefog_tpu.ops import windows as win_mod
+
+    sent = []  # (elapsed_ms, names, tags) per wire batch
+
+    class _Client:
+        def append_bytes_tagged_many(self, names, blobs, tags):
+            sent.append((1e3 * (time.perf_counter() - t0),
+                         list(names), list(tags)))
+            return [100 + int(t) for t in tags]
+
+    monkeypatch.setattr(win_mod._cp, "client", lambda: _Client())
+    names = [f"dep.{i}" for i in range(4)]
+    blobs = [b"x"] * 4
+    tags = list(range(4))
+    edge_of = [(0, 1), (2, 3), (0, 1), (3, 0)]  # 0->1 is the slow edge
+    t0 = time.perf_counter()
+    replies = win_mod._send_deposits_delayed(
+        names, blobs, tags, edge_of, {(0, 1): 60})
+    # replies land in ORIGINAL record order despite the regrouped send
+    assert replies == [100, 101, 102, 103]
+    assert len(sent) == 2
+    fast, slow = sent
+    assert fast[1] == ["dep.1", "dep.3"] and fast[0] < 45.0
+    assert slow[1] == ["dep.0", "dep.2"] and slow[0] >= 55.0
 
 
 # ---------------------------------------------------------------------------

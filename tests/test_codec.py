@@ -653,3 +653,143 @@ def test_edge_flow_events_report_wire_bytes(bf_hosted, monkeypatch):
         (edge_bytes[-4:], raw)
     # server mailboxes still hold the (undelivered) deposits; clean up
     bf.win_free("cx.flow")
+
+
+# ---------------------------------------------------------------------------
+# per-edge codecs (ISSUE r16): grammar, mixed wire, runtime switching
+# ---------------------------------------------------------------------------
+
+def test_resolve_edge_spec_grammar():
+    base, over = cd.resolve_edge_spec("none;0>1=int8;2>3=topk:0.05")
+    assert base is None
+    assert isinstance(over[(0, 1)], cd.Int8Codec)
+    assert isinstance(over[(2, 3)], cd.TopKCodec)
+    assert over[(2, 3)].frac == 0.05
+    # a bare single-codec spec parses exactly as before
+    base, over = cd.resolve_edge_spec("int8")
+    assert isinstance(base, cd.Int8Codec) and over == {}
+    assert cd.resolve_edge_spec(None) == (None, {})
+    # per-edge `none` under a compressed base: the raw-escape override
+    base, over = cd.resolve_edge_spec("int8;1>0=none")
+    assert isinstance(base, cd.Int8Codec) and over[(1, 0)] is None
+    # malformed terms warn-skip; the rest of the spec survives
+    base, over = cd.resolve_edge_spec("none;garbage;0-1=int8;3>4=fp8")
+    assert base is None and set(over) == {(3, 4)}
+
+
+def test_per_edge_codec_mixed_wire(bf_hosted, monkeypatch):
+    """`none;0>1=int8`: every fold raw EXCEPT the overridden edge, whose
+    contribution is the int8 decode estimate — the same single-edge
+    escalation the tuner actuates, configured from the env grammar."""
+    monkeypatch.setenv("BLUEFOG_WIN_CODEC", "none;0>1=int8")
+    x = jnp.asarray(np.random.RandomState(5).randn(8, 4096).astype(
+        np.float32))
+    assert bf.win_create(x, "cx.pe")
+    win = win_ops._get_window("cx.pe")
+    assert win.codec is None
+    assert isinstance(win.codec_for(0, 1), cd.Int8Codec)
+    assert win.codec_for(0, 2) is None
+    bf.win_put(x, "cx.pe")
+    got = np.asarray(bf.win_update("cx.pe"))
+    topo = bf.load_topology()
+    xs = np.asarray(x)
+    c = cd.Int8Codec()
+    est01 = c.decode(c.encode(xs[0]), np.float32, 4096)
+    for r in range(8):
+        nbrs = bf.topology_util.in_neighbor_ranks(topo, r)
+        u = 1.0 / (len(nbrs) + 1)
+        want = u * xs[r] + u * sum(
+            (est01 if (s, r) == (0, 1) else xs[s]) for s in nbrs)
+        np.testing.assert_allclose(got[r], want, rtol=1e-5, atol=1e-5)
+    # the overridden edge actually compressed: quantized, not equal
+    assert np.abs(est01 - xs[0]).max() > 0
+    bf.win_free("cx.pe")
+
+
+def test_set_edge_codec_runtime_switch_and_rebase(bf_hosted, monkeypatch):
+    """The tuner's codec lever end to end: switching one edge to an EF
+    codec in put mode REBASES (full row through the state codec, fold
+    PUT), the following put ships a delta that tightens the receiver
+    estimate, and switching back to the base codec clears the override
+    table — the wire is structurally back to the pre-switch shape."""
+    monkeypatch.delenv("BLUEFOG_WIN_CODEC", raising=False)
+    x = jnp.asarray(np.random.RandomState(6).randn(8, 4096).astype(
+        np.float32))
+    assert bf.win_create(x, "cx.sw")
+    win = win_ops._get_window("cx.sw")
+    assert win.codec is None and not win._edge_codec
+    # no-op switch: same effective codec -> False, nothing recorded
+    assert win.set_edge_codec(0, 1, "none") is False
+    assert win.set_edge_codec(0, 1, "topk:0.5") is True
+    assert isinstance(win.codec_for(0, 1), cd.TopKCodec)
+    reb0 = bf_metrics.snapshot()["counters"].get(
+        "win.codec.edge_rebase", 0)
+    bf.win_put(x, "cx.sw")  # first EF put: rebase send
+    assert bf_metrics.snapshot()["counters"]["win.codec.edge_rebase"] \
+        == reb0 + 1
+    assert (0, 1) in win._ef_edge_ref
+    k = win.layout.slot_of[1][0]
+    xs = np.asarray(x)
+    gap_rebase = np.abs(win._mail_rows[1][k] - xs[0]).max()
+    assert gap_rebase > 0  # int8 state-codec rebase: quantized
+    bf.win_put(x, "cx.sw")  # second put: delta integrates on top
+    gap_delta = np.abs(win._mail_rows[1][k] - xs[0]).max()
+    assert gap_delta < gap_rebase  # the delta TIGHTENED the estimate
+    assert win.ef_edge_residual_norm(0, 1) >= 0.0
+    # switch back to the window codec: override table empties, put-mode
+    # reference dropped (the next full PUT supersedes it)
+    assert win.set_edge_codec(0, 1, None) is True
+    assert not win._edge_codec and (0, 1) not in win._ef_edge_ref
+    bf.win_put(x, "cx.sw")
+    np.testing.assert_array_equal(win._mail_rows[1][k], xs[0])
+    bf.win_free("cx.sw")
+
+
+def test_pushsum_mass_exact_across_edge_codec_switches(bf_hosted,
+                                                       monkeypatch):
+    """Acceptance pin (ISSUE r16): push-sum mass stays EXACT while the
+    tuner switches per-edge codecs mid-run. The associated-p channel
+    ships exact under every codec, and the numerator obeys
+    delivered + weighted-residual-in-flight == minted at every round —
+    EF residuals HOLD mass across none -> topk -> int8 -> none switches,
+    never lose it."""
+    monkeypatch.delenv("BLUEFOG_WIN_CODEC", raising=False)
+    bf.turn_on_win_ops_with_associated_p()
+    try:
+        x = jnp.arange(8.0).reshape(8, 1) + 1.0  # total mass 36
+        assert bf.win_create(x, "cx.msw", zero_init=True)
+        win = win_ops._get_window("cx.msw")
+        topo = bf.load_topology()
+        outn = {r: bf.topology_util.out_neighbor_ranks(topo, r)
+                for r in range(8)}
+        sw = {r: 1.0 / (len(outn[r]) + 1) for r in range(8)}
+        dw = {r: {d: 1.0 / (len(outn[r]) + 1) for d in outn[r]}
+              for r in range(8)}
+
+        def residual_mass():
+            # weighted by the edge weight the eventual delivery will
+            # carry (deposits ship wt * base; residuals track unweighted)
+            return sum(dw[s][d] * float(rows.sum())
+                       for (s, d), rows in win._ef_edge_rows.items())
+
+        switches = {1: [((0, 1), "topk:0.5"), ((2, 3), "topk:0.5")],
+                    2: [((0, 1), "int8")],
+                    3: [((0, 1), "none"), ((2, 3), "none")]}
+        val = x
+        for rnd in range(5):
+            for (s, d), spec in switches.get(rnd, ()):
+                assert win.set_edge_codec(s, d, spec) is True
+            bf.win_accumulate(val, "cx.msw", self_weight=sw,
+                              dst_weights=dw, require_mutex=True)
+            val = bf.win_update_then_collect("cx.msw")
+            p = bf.win_associated_p_all("cx.msw")
+            assert abs(p.sum() - 8.0) < 1e-9  # p NEVER compresses
+            total = float(np.asarray(val, np.float64).sum())
+            assert abs(total + residual_mass() - 36.0) < 1e-3, \
+                (rnd, total, residual_mass())
+        # after the switch back to raw, no residual mass remains in
+        # flight: the uncompressed wire flushed it all
+        assert residual_mass() == 0.0
+        bf.win_free("cx.msw")
+    finally:
+        bf.turn_off_win_ops_with_associated_p()

@@ -85,9 +85,9 @@ def test_gating_filter_keeps_stable_series_only():
         # ...but the codec wire-leg probes stay info-only (2x run-to-run
         # jitter measured at graduation time)
         "codec.int8.f32.drain_stream.mbps": 1.0,
-        # r17 sharded-window series: info-only under the same rule (the
-        # `sharded_sN.win_put` op names would otherwise match the op
-        # filter)
+        # r17 sharded-window series: GATING since r19 (two stable rounds
+        # elapsed per the stable-series rule), including the
+        # counter-delta wire_reduction_x ratios
         "sharded.f32.sharded_s2.win_put.mbps": 1.0,
         "sharded.f32.s4.wire_reduction_x": 4.0,
     }
@@ -97,7 +97,9 @@ def test_gating_filter_keeps_stable_series_only():
                          "hybrid.win_put.auto.ov0.img_per_sec",
                          "hybrid.win_put.hosted.ov0.img_per_sec",
                          "codec.int8.f32.win_put.mbps",
-                         "codec.topk:0.01.f32.win_update.mbps"}
+                         "codec.topk:0.01.f32.win_update.mbps",
+                         "sharded.f32.sharded_s2.win_put.mbps",
+                         "sharded.f32.s4.wire_reduction_x"}
 
 
 # ---------------------------------------------------------------------------
@@ -123,6 +125,12 @@ def test_committed_baseline_is_sound():
     assert any(k.startswith("codec.") and k.endswith(".win_put.mbps")
                for k in metrics)
     assert any(k.startswith("codec.") and k.endswith(".win_update.mbps")
+               for k in metrics)
+    # sharded.* graduated to gating in r19: measured mbps rows AND the
+    # counter-delta wire-reduction ratios committed
+    assert any(k.startswith("sharded.") and k.endswith(".win_put.mbps")
+               for k in metrics)
+    assert any(k.startswith("sharded.") and k.endswith(".wire_reduction_x")
                for k in metrics)
 
 

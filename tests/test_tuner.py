@@ -1,0 +1,407 @@
+"""Self-tuning controller (ISSUE r16): the decision table as a unit.
+
+``Tuner.decide`` is a pure function of (Snapshot, hysteresis state), so
+every row of the decision table — slow-edge codec escalation, pressure
+de-escalation, straggler demotion, recovery promotion — runs here over
+synthetic series with no control plane, no windows, no clock. The
+epoch-fence, dwell, and sustained-breach gates are pinned the same way,
+plus the three safety contracts the ISSUE names: BLUEFOG_TUNE=0 touches
+NOTHING (byte-identical off path), every actuation is fenced on the
+membership epoch, and demote -> promote restores the weight matrix
+EXACTLY (the topology round-trip).
+"""
+
+import json
+
+import networkx as nx
+import numpy as np
+import pytest
+
+from bluefog_tpu import topology_util as tu
+from bluefog_tpu.runtime import metrics as bf_metrics
+from bluefog_tpu.runtime import tuner
+
+
+RULES = dict(tuner.DEFAULT_RULES, slow_for=10.0, straggler_for=10.0,
+             dwell=30.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuner_state():
+    tuner.reset_for_job()
+    yield
+    tuner.reset_for_job()
+
+
+def _tuner(rank=0, world=4, **over):
+    return tuner.Tuner(rank, world, rules=dict(RULES, **over))
+
+
+def _snap(now, edges=None, stragglers=(), alerts=(), ef_norm=0.0,
+          owned=(0,), epoch=0, rank=0):
+    return tuner.Snapshot(
+        now=now, epoch=epoch, rank=rank, owned=set(owned),
+        edges={e: tuner.EdgeSample(*v) if isinstance(v, tuple)
+               else tuner.EdgeSample(bps=v)
+               for e, v in (edges or {}).items()},
+        stragglers=set(stragglers), alerts=set(alerts), ef_norm=ef_norm)
+
+
+def _apply(t, snap):
+    out = t.decide(snap)
+    for d in out:
+        t.note_applied(d, snap.now)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_tune_rules_grammar():
+    assert tuner.parse_tune_rules(None) == tuner.DEFAULT_RULES
+    r = tuner.parse_tune_rules("slow_ratio=0.3, dwell=5")
+    assert r["slow_ratio"] == 0.3 and r["dwell"] == 5.0
+    assert r["slow_for"] == tuner.DEFAULT_RULES["slow_for"]
+    # unknown keys and malformed values warn-skip (config never crashes)
+    r = tuner.parse_tune_rules("bogus=1,slow_for=abc,keep_in=2")
+    assert r["slow_for"] == tuner.DEFAULT_RULES["slow_for"]
+    assert r["keep_in"] == 2.0 and "bogus" not in r
+
+
+# ---------------------------------------------------------------------------
+# codec lever: escalation / de-escalation
+# ---------------------------------------------------------------------------
+
+def test_slow_edge_escalates_ladder_after_sustained_breach():
+    t = _tuner()
+    edges = {(0, 1): 10.0, (0, 2): 1000.0, (2, 3): 1100.0}
+    # first sighting starts the breach clock: no move yet
+    assert _apply(t, _snap(0.0, edges)) == []
+    # still breaching but not yet slow_for seconds: no move
+    assert _apply(t, _snap(5.0, edges)) == []
+    # sustained past slow_for: ONE rung up (none -> int8)
+    out = _apply(t, _snap(11.0, edges))
+    assert [(d.lever, d.target, d.action, d.arg) for d in out] == \
+        [("codec", (0, 1), "escalate", "int8")]
+    # dwell: the same edge cannot move again for dwell seconds, but the
+    # breach clock keeps running underneath
+    assert _apply(t, _snap(12.0, edges)) == []
+    assert _apply(t, _snap(40.0, edges)) == []   # 29 s since the move
+    # dwell expired + breach still sustained: the next rung (topk)
+    out = _apply(t, _snap(45.0, edges))
+    assert [(d.target, d.arg) for d in out] == [((0, 1), "topk:0.01")]
+    # top of the ladder: no further escalation ever
+    assert _apply(t, _snap(100.0, edges)) == []
+    assert _apply(t, _snap(111.0, edges)) == []
+
+
+def test_breach_clock_resets_when_edge_recovers():
+    t = _tuner()
+    slow = {(0, 1): 10.0, (0, 2): 1000.0, (2, 3): 1000.0}
+    fast = {(0, 1): 900.0, (0, 2): 1000.0, (2, 3): 1000.0}
+    _apply(t, _snap(0.0, slow))
+    _apply(t, _snap(8.0, fast))    # recovered before slow_for: clock off
+    assert _apply(t, _snap(11.0, slow)) == []  # new clock starts HERE
+    assert _apply(t, _snap(20.0, slow)) == []
+    out = _apply(t, _snap(22.0, slow))
+    assert len(out) == 1 and out[0].target == (0, 1)
+
+
+def test_only_owned_out_edges_escalate():
+    t = _tuner()
+    edges = {(3, 1): 10.0, (0, 2): 1000.0, (2, 3): 1100.0}
+    _apply(t, _snap(0.0, edges, owned=(0,)))
+    # (3,1) is slow but rank 3 is not ours: rank 3's controller owns it
+    assert _apply(t, _snap(11.0, edges, owned=(0,))) == []
+
+
+def test_absolute_floor_and_transit_p99_triggers():
+    t = _tuner(min_bps=500.0)
+    edges = {(0, 1): 400.0, (0, 2): 600.0, (2, 3): 650.0}
+    _apply(t, _snap(0.0, edges))
+    out = _apply(t, _snap(11.0, edges))
+    assert [d.target for d in out] == [(0, 1)] and "floor" in out[0].reason
+    t2 = _tuner(transit_p99_ms=50.0)
+    edges = {(0, 1): (1000.0, 80_000.0), (0, 2): (1000.0, 1000.0),
+             (2, 3): (1000.0, 900.0)}
+    _apply(t2, _snap(0.0, edges))
+    out = _apply(t2, _snap(11.0, edges))
+    assert [d.target for d in out] == [(0, 1)]
+    assert "p99" in out[0].reason
+
+
+def test_deescalation_on_consensus_stall_and_ef_pressure():
+    t = _tuner()
+    t._level[(0, 1)] = 2  # already at topk
+    t._level[(0, 2)] = 1  # at int8
+    out = _apply(t, _snap(0.0, alerts={"consensus_stall"}))
+    # every raised level walks ONE rung back
+    assert sorted((d.target, d.arg) for d in out
+                  if d.action == "deescalate") == \
+        [((0, 1), "int8"), ((0, 2), None)]
+    assert t._level == {(0, 1): 1}  # int8 edge fell off the ladder
+    # EF-residual pressure triggers the same path (after dwell)
+    t2 = _tuner(deesc_norm=5.0)
+    t2._level[(0, 1)] = 1
+    out = _apply(t2, _snap(0.0, ef_norm=9.0))
+    assert [(d.target, d.action) for d in out] == [((0, 1), "deescalate")]
+    assert t2._level == {}
+    # below the norm threshold: nothing moves
+    t2._level[(0, 1)] = 1
+    assert _apply(t2, _snap(100.0, ef_norm=1.0)) == []
+
+
+# ---------------------------------------------------------------------------
+# in-degree lever: demote / promote
+# ---------------------------------------------------------------------------
+
+def test_straggler_demotes_then_promotes_on_recovery():
+    t = _tuner()
+    _apply(t, _snap(0.0, stragglers={3}))
+    assert t._demoted == {}
+    out = _apply(t, _snap(11.0, stragglers={3}))
+    assert [(d.lever, d.target, d.action) for d in out] == \
+        [("indegree", 3, "demote")]
+    assert 3 in t._demoted
+    # still a straggler: no repeat demotion (already demoted)
+    assert _apply(t, _snap(12.0, stragglers={3})) == []
+    # recovery must be SUSTAINED too — and the promote respects dwell
+    assert _apply(t, _snap(45.0, stragglers=set())) == []
+    out = _apply(t, _snap(56.0, stragglers=set()))
+    assert [(d.target, d.action) for d in out] == [(3, "promote")]
+    assert t._demoted == {}
+
+
+def test_straggler_relapse_resets_recovery_clock():
+    t = _tuner()
+    t._demoted[3] = frozenset({(1, 3)})
+    t._last_act[("indegree", 3)] = -100.0
+    _apply(t, _snap(0.0, stragglers=set()))   # recovery clock starts
+    _apply(t, _snap(5.0, stragglers={3}))     # relapse: clock resets
+    assert _apply(t, _snap(12.0, stragglers=set())) == []  # fresh clock
+    out = _apply(t, _snap(23.0, stragglers=set()))
+    assert [(d.target, d.action) for d in out] == [(3, "promote")]
+
+
+def test_demote_targets_keep_fastest_in_edges():
+    t = _tuner(keep_in=1)
+
+    class _W:
+        in_neighbors = {3: [0, 1, 2]}
+    import bluefog_tpu.runtime.state as _state
+    st = _state._global_state()
+    old = dict(st.windows)
+    st.windows.clear()
+    st.windows["w"] = _W()
+    try:
+        snap = _snap(0.0, {(0, 3): 50.0, (1, 3): 900.0, (2, 3): 200.0})
+        drops = t._demote_targets(snap, 3)
+        # keeps the fastest in-edge (1->3); drops the rest
+        assert sorted(drops) == [(0, 3), (2, 3)]
+        t2 = _tuner(keep_in=2)
+        assert sorted(t2._demote_targets(snap, 3)) == [(0, 3)]
+    finally:
+        st.windows.clear()
+        st.windows.update(old)
+
+
+# ---------------------------------------------------------------------------
+# the tick: epoch fence, single-controller application, off path
+# ---------------------------------------------------------------------------
+
+def test_epoch_fence_defers_decision_racing_rejoin(monkeypatch):
+    """A membership-epoch bump (death/rejoin) between the sensor snapshot
+    and the actuation defers the decision: it was derived against a stale
+    edge set, and the next tick re-decides against the new membership."""
+    import bluefog_tpu.runtime.heartbeat as hb
+
+    monkeypatch.setenv("BLUEFOG_TUNE", "1")
+    t = _tuner()
+    t._breach[("straggler", 3)] = -100.0  # sustained long ago
+    snap = _snap(0.0, stragglers={3}, epoch=5)
+    monkeypatch.setattr(t, "gather", lambda cl=None, now=None: snap)
+    monkeypatch.setattr(hb, "membership_epoch", lambda: 6)  # mid-decision
+    deferred0 = bf_metrics.counter("tune.deferred").value
+    applied = t.tick(cl=None, now=0.0)
+    assert applied == []
+    assert bf_metrics.counter("tune.deferred").value == deferred0 + 1
+    assert t._demoted == {}                    # state untouched
+    assert ("indegree", 3) not in t._last_act  # dwell NOT burned
+    assert t._decisions[-1]["status"] == "deferred"
+
+
+def test_single_controller_demotion_applies_through_tick(monkeypatch):
+    import bluefog_tpu.runtime.heartbeat as hb
+
+    monkeypatch.setenv("BLUEFOG_TUNE", "1")
+    t = _tuner(rank=0, world=4)
+    t._breach[("straggler", 3)] = -100.0
+    snap = _snap(0.0, stragglers={3}, epoch=0)
+    monkeypatch.setattr(t, "gather", lambda cl=None, now=None: snap)
+    monkeypatch.setattr(hb, "membership_epoch", lambda: 0)
+    monkeypatch.setattr(hb, "dead_controllers", lambda: set())
+    monkeypatch.setattr(t, "_demote_targets",
+                        lambda s, p: [(0, 3), (2, 3)])
+    applied = t.tick(cl=None, now=0.0)
+    assert [(d.lever, d.action) for d in applied] == [("indegree",
+                                                       "demote")]
+    # the optimizers' accessor sees it immediately (no KV, no epoch wait)
+    assert tuner.demoted_edges() == frozenset({(0, 3), (2, 3)})
+    # recovery: sustained non-straggler past dwell -> promote, set empties
+    snap2 = _snap(45.0, stragglers=set(), epoch=0)
+    monkeypatch.setattr(t, "gather", lambda cl=None, now=None: snap2)
+    assert t.tick(cl=None, now=45.0) == []  # recovery clock starts
+    snap3 = _snap(56.0, stragglers=set(), epoch=0)
+    monkeypatch.setattr(t, "gather", lambda cl=None, now=None: snap3)
+    applied = t.tick(cl=None, now=56.0)
+    assert [(d.action) for d in applied] == ["promote"]
+    assert tuner.demoted_edges() == frozenset()
+
+
+def test_tune_off_touches_nothing(monkeypatch):
+    """BLUEFOG_TUNE=0 (the default): demoted_edges() is the empty set
+    with ZERO control-plane traffic, maybe_tick never builds the
+    singleton — the untuned build's wire is byte-identical by
+    construction because no tuner code path runs at all."""
+    import bluefog_tpu.runtime.control_plane as cp
+
+    monkeypatch.delenv("BLUEFOG_TUNE", raising=False)
+
+    def _boom(*a, **k):  # any control-plane touch is a failure
+        raise AssertionError("tuner touched the control plane while off")
+
+    monkeypatch.setattr(cp, "active", _boom)
+    monkeypatch.setattr(cp, "client", _boom)
+    assert tuner.enabled() is False
+    assert tuner.demoted_edges() == frozenset()
+    tuner.maybe_tick(cl=None)
+    assert tuner._singleton is None  # never even constructed
+
+
+def test_maybe_tick_interval_gated(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TUNE", "1")
+    monkeypatch.setenv("BLUEFOG_TUNE_INTERVAL", "100")
+    t = _tuner()
+    calls = []
+    monkeypatch.setattr(t, "tick",
+                        lambda cl=None, now=None: calls.append(now))
+    t.maybe_tick(cl=None, now=1000.0)
+    assert calls == [1000.0]
+    t._last_tick = 1000.0
+    t.maybe_tick(cl=None, now=1050.0)   # inside the interval: gated
+    assert calls == [1000.0]
+    t.maybe_tick(cl=None, now=1101.0)
+    assert calls == [1000.0, 1101.0]
+
+
+def test_decision_trail_document_shape(monkeypatch):
+    """The bf.tune.<rank> document --top renders: codec levels in the
+    `s>d` grammar, demoted map, bounded decision ring."""
+    monkeypatch.setenv("BLUEFOG_TUNE", "1")
+    t = _tuner()
+    t._level[(0, 1)] = 1
+    t._demoted[3] = frozenset({(0, 3)})
+    t._record(tuner.Decision("codec", (0, 1), "escalate", "int8", "slow"),
+              1.0, "applied")
+    wrote = {}
+
+    class _Cl:
+        def put_bytes(self, key, blob):
+            wrote[key] = blob
+    t._publish_trail(_Cl(), now=2.0)
+    doc = json.loads(wrote["bf.tune.0"].decode())
+    assert doc["levels"] == {"0>1": "int8"}
+    assert doc["demoted"] == {"3": [[0, 3]]}
+    assert doc["decisions"][-1]["action"] == "escalate"
+    assert doc["decisions"][-1]["target"] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# optimizers: healed tables honor demoted edges
+# ---------------------------------------------------------------------------
+
+def test_healed_tables_treat_demoted_edges_like_dead_for_that_column():
+    """The demotion's runtime realization: the demoted edge drops from
+    the receiver's column (renormalized — convex combination preserved)
+    AND from the sender's table (the skipped send is where the wire
+    bytes are actually saved). Other columns never move."""
+    from bluefog_tpu import optimizers as O
+
+    class _Win:
+        size = 4
+        out_neighbors = {0: [1], 1: [2], 2: [3], 3: [0]}
+        in_neighbors = {0: [3], 1: [0], 2: [1], 3: [2]}
+
+    win = _Win()
+    demoted = frozenset({(2, 3)})
+    sw, nw = O._healed_recv_weights(win, set(), None, None, demoted)
+    assert nw[3] == {} and sw[3] == 1.0   # only in-edge demoted: self-only
+    assert nw[1] == {0: 0.5} and sw[1] == 0.5  # untouched column
+    send = O._healed_send_table(win, set(), None, demoted)
+    assert send[2] == {} and send[1] == {2: 1.0}
+    # custom weights: the demoted column renormalizes to its old total
+    nbr_w = {r: {p: 0.5 for p in win.in_neighbors[r]} for r in range(4)}
+    sw2, nw2 = O._healed_recv_weights(win, set(), 0.5, nbr_w, demoted)
+    assert sw2[3] == pytest.approx(1.0) and nw2[3] == {}
+    assert sw2[1] == pytest.approx(0.5)
+    assert nw2[1] == {0: pytest.approx(0.5)}
+    # demotion composes with a dead set
+    sw3, nw3 = O._healed_recv_weights(win, {0}, None, None, demoted)
+    assert nw3[1] == {} and nw3[3] == {}
+
+
+# ---------------------------------------------------------------------------
+# topology: demote -> promote restores W exactly
+# ---------------------------------------------------------------------------
+
+def test_demote_preserves_column_sums_and_composes():
+    G = tu.ExponentialTwoGraph(8)
+    W0 = nx.to_numpy_array(G)
+    Gd = tu.demote_in_edges(G, 3, {1, 2})
+    Wd = nx.to_numpy_array(Gd)
+    # only column 3 changed; its sum is preserved exactly
+    np.testing.assert_allclose(np.delete(Wd, 3, axis=1),
+                               np.delete(W0, 3, axis=1))
+    assert Wd[:, 3].sum() == pytest.approx(W0[:, 3].sum(), abs=1e-12)
+    assert Wd[1, 3] == 0.0 and Wd[2, 3] == 0.0
+    assert Wd[3, 3] > W0[3, 3]  # renormalized onto the survivors
+    # composes: a second rank's demotion re-derives from the ORIGINAL
+    Gdd = tu.demote_in_edges(Gd, 5, {4})
+    Wdd = nx.to_numpy_array(Gdd)
+    np.testing.assert_allclose(Wdd[:, 3], Wd[:, 3])
+    assert Wdd[:, 5].sum() == pytest.approx(W0[:, 5].sum(), abs=1e-12)
+
+
+def test_demote_promote_roundtrip_restores_w_exactly():
+    """The acceptance pin: promote(demote(G)) == G, bit for bit — the
+    controller's recovery path leaves NO residue in the mixing matrix."""
+    G = tu.ExponentialTwoGraph(8)
+    W0 = nx.to_numpy_array(G)
+    Gd = tu.demote_in_edges(G, 3, {1, 2})
+    Gp = tu.promote_rank(Gd, 3)
+    np.testing.assert_array_equal(nx.to_numpy_array(Gp), W0)
+    assert "_bf_demote" not in Gp.graph or \
+        not Gp.graph["_bf_demote"]["demoted"]
+    # partial promotion: rank 5's demotion survives rank 3's recovery
+    Gd2 = tu.demote_in_edges(Gd, 5, {4})
+    Gp2 = tu.promote_rank(Gd2, 3)
+    W2 = nx.to_numpy_array(Gp2)
+    np.testing.assert_allclose(W2[:, 3], W0[:, 3])
+    assert W2[4, 5] == 0.0
+    # promoting a never-demoted rank is the identity (idempotent)
+    assert tu.promote_rank(G, 2) is G
+
+
+def test_demote_never_drops_self_loop_and_guards_empty_column():
+    G = tu.RingGraph(4, connect_style=1)  # single-direction ring
+    # rank 1's only real in-edge is 2->1; self in the drop set: ignored
+    Gd = tu.demote_in_edges(G, 1, {2, 1})
+    Wd = nx.to_numpy_array(Gd)
+    assert Wd[1, 1] == pytest.approx(nx.to_numpy_array(G)[:, 1].sum())
+    # dropping EVERY in-edge of a rank with no self-weight must raise,
+    # not silently zero the column
+    W = np.array([[0.0, 1.0], [1.0, 0.0]])
+    G2 = nx.from_numpy_array(W, create_using=nx.DiGraph)
+    with pytest.raises(ValueError, match="renormalize"):
+        tu.demote_in_edges(G2, 1, {0})

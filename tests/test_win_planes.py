@@ -138,6 +138,54 @@ def test_planner_size_floor_and_override():
     assert (0, 1) in part.hosted and (1, 2) in part.compiled
 
 
+def test_planner_per_edge_wire_scale_mixed_codec_floor():
+    """ISSUE r16 regression: ``wire_scale`` is per-EDGE with the scalar
+    as fallback. A mixed-codec window (per-edge BLUEFOG_WIN_CODEC
+    grammar / tuner escalation) must floor-check each edge at ITS OWN
+    codec's nominal ratio — the old scalar-only estimate either
+    mis-compiled every compressed edge or mis-hosted every raw one."""
+    owner_of = {r: 0 for r in range(4)}  # all mesh-local: floor decides
+    # 1 MB rows, floor at 0.5 MB: raw edges clear it, a topk:0.01-scaled
+    # edge (0.02x -> ~21 KB) lands far below it
+    pl = _planner(owner_of=owner_of, min_bytes=1 << 19)
+    assert pl.partition().compiled == pl.edges
+    assert pl.set_edge_scale((0, 1), 0.02) is True  # verdict flips
+    part = pl.partition()
+    assert (0, 1) in part.hosted  # ITS codec's ratio, not the scalar's
+    assert part.compiled == pl.edges - {(0, 1)}
+    # every other edge still uses the scalar fallback
+    assert pl.edge_cost((1, 2)) == 1 << 20
+    assert pl.edge_cost((0, 1)) == (1 << 20) * 0.02
+    # int8 on another edge (0.26x of 1 MB ~ 272 KB < 512 KB floor)
+    assert pl.set_edge_scale((2, 3), 0.26) is True
+    assert pl.partition().hosted >= {(0, 1), (2, 3)}
+    # back to raw: exact scalar-fallback restoration, verdict flips back
+    assert pl.set_edge_scale((0, 1), 1.0) is True
+    assert (0, 1) in pl.partition().compiled
+
+
+def test_planner_ingest_live_replans_only_on_verdict_flip():
+    """The tuner's plane lever: measured per-edge bytes override the
+    static estimate, but the partition cache is dropped ONLY when a
+    size-floor verdict actually flips — steady measurements cost no
+    re-jit."""
+    owner_of = {r: 0 for r in range(4)}
+    pl = _planner(owner_of=owner_of, min_bytes=1 << 19)
+    pl.partition()
+    assert pl.rebuilds == 1
+    # live bytes above the floor on an already-compiled edge: no flip
+    assert pl.ingest_live({(0, 1): float(1 << 20)}) is False
+    pl.partition()
+    assert pl.rebuilds == 1  # cache intact
+    # live bytes below the floor: verdict flips -> re-plan scheduled
+    assert pl.ingest_live({(0, 1): 1024.0}) is True
+    part = pl.partition()
+    assert pl.rebuilds == 2 and (0, 1) in part.hosted
+    # live beats the static estimate AND the per-edge scale
+    pl.set_edge_scale((0, 1), 0.5)
+    assert pl.edge_cost((0, 1)) == 1024.0
+
+
 def test_planner_policy_hosted_compiles_nothing():
     pl = _planner(policy="hosted")
     assert not pl.partition().compiled
