@@ -8,7 +8,7 @@ MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
         test_launcher test_models bench chaos dryrun native scaling \
         lm_bench metrics-smoke flight-smoke soak-smoke obs-smoke \
-        tune-smoke perf-gate lint bfcheck check tsan asan
+        tune-smoke serve-smoke perf-gate lint bfcheck check tsan asan
 
 # Test files replayed under the sanitizers: the chaos suite (reconnect /
 # dedup / fencing churn) plus the striped-transport + hosted-window stress
@@ -74,6 +74,15 @@ tune-smoke:      ## self-tuning-controller acceptance: 4-rank in-process
                  ## and the bf.tune.* trail rendered by bfrun --top
 	JAX_PLATFORMS=cpu python scripts/tune_smoke.py
 
+serve-smoke:     ## serving-plane acceptance: 2-rank trainer publishing
+                 ## every comm step + one read-only serve client — hot-swap
+                 ## on fence bumps while training continues, batched
+                 ## replies matching a numpy oracle on the swapped-in
+                 ## snapshot, queue_full shedding with every admitted
+                 ## future still resolving, and bfrun --serve/--status
+                 ## attaching from a separate process (docs/serving.md)
+	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
+
 soak-smoke:      ## durable sharded-control-plane churn soak, quick mode
                  ## (<= 2 min): 2 WAL-replicated shard server processes,
                  ## ~64 raw clients with incarnation churn, one injected
@@ -129,7 +138,7 @@ asan:            ## AddressSanitizer build of csrc + the same replay.
 	    ASAN_OPTIONS="detect_leaks=0 exitcode=66" \
 	    JAX_PLATFORMS=cpu $(PYTEST) $(SANITIZE_TESTS) -q -m "not slow"
 
-chaos: check metrics-smoke flight-smoke obs-smoke tune-smoke soak-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
+chaos: check metrics-smoke flight-smoke obs-smoke tune-smoke serve-smoke soak-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
                  ## seed offsets (BLUEFOG_CHAOS_SEED shifts every armed drop
                  ## point, so reconnect/dedup/fencing — and the telemetry
                  ## counters asserted against them — face different drop sites)

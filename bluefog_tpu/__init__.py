@@ -162,3 +162,9 @@ from .utils import (
 from . import checkpoint
 from . import models
 from . import parallel
+
+# serving plane: versioned snapshot distribution + batched read-only
+# inference over the control-plane wire (docs/serving.md). bf.serve_client()
+# attaches from inside a job; standalone serving processes import
+# ``bluefog_tpu.serving`` through the lean bootstrap instead (no jax).
+from .serving import RequestShed, ServeClient, serve_client

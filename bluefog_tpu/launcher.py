@@ -34,7 +34,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 # Remote shells print this after turning pty echo off; the launcher holds the
 # job secret until it arrives (see the ssh fan-out below).
@@ -197,6 +197,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how long --dump waits for rank acks (ranks poll "
                         "the trigger on their heartbeat cadence, default "
                         "5 s, so the default 60 covers slow ticks)")
+    p.add_argument("--serve", action="store_true",
+                   help="attach a read-only serving client to the job's "
+                        "snapshot plane (docs/serving.md): pull the "
+                        "current versioned snapshot, hot-swap on every "
+                        "fence bump, and print one line per swap "
+                        "(version, wire bytes, pull MB/s, publish lag) "
+                        "until Ctrl-C. Works from OUTSIDE the job like "
+                        "--status: raw control-plane client, no jax, no "
+                        "mesh join. With --once: exit after the first "
+                        "complete snapshot (0) or --serve-timeout (1)")
+    p.add_argument("--serve-model", type=str, default=None,
+                   metavar="MODULE:FN",
+                   help="with --serve: import FN from MODULE as "
+                        "model_fn(params, batch) and serve batched "
+                        "inference behind the admission gate instead of "
+                        "only mirroring snapshots")
+    p.add_argument("--serve-timeout", type=float, default=30.0,
+                   metavar="SEC",
+                   help="with --serve: how long to wait for the first "
+                        "complete snapshot before giving up (default 30)")
     p.add_argument("--cp", type=str, default=None,
                    metavar="HOST:PORT[,HOST:PORT...]",
                    help="control-plane address(es) for --status/--dump — "
@@ -765,10 +785,24 @@ def _status(args) -> int:
                         # successor lagging/absent: this shard is serving
                         # acked writes that live NOWHERE else
                         under_replicated.append(name)
+        serve_lines, serve_st = _serve_status_lines(cl)
+        for line in serve_lines:
+            print(line)
         if getattr(args, "strict", False):
+            from .runtime.config import knob_env
+
             findings = _strict_findings(health)
             findings.extend(
                 _shard_drift_findings(cl, health["world"]))
+            if serve_st is not None:
+                lag = serve_st.get("publish_lag_s")
+                stale_s = float(knob_env("BLUEFOG_SERVE_STALE_S"))
+                if lag is not None and lag > stale_s:
+                    findings.append(
+                        f"stale serving snapshot: v{serve_st['version']} "
+                        f"published {lag:.1f} s ago (threshold "
+                        f"BLUEFOG_SERVE_STALE_S={stale_s:g} s — the "
+                        "publisher hook stopped or the trainer is down)")
             if dead_shards:
                 findings.append(
                     f"dead control-plane shard(s): {dead_shards}")
@@ -784,6 +818,92 @@ def _status(args) -> int:
     finally:
         cl.close()
     return 0
+
+
+def _serve_status_lines(cl) -> Tuple[List[str], Optional[dict]]:
+    """The serving-plane rows for ``--status`` (empty when the job never
+    published a snapshot — serving is opt-in via
+    BLUEFOG_SERVE_PUBLISH_EVERY)."""
+    from .serving.snapshot import read_serve_status
+
+    try:
+        st = read_serve_status(cl)
+    except (OSError, RuntimeError):
+        return [], None
+    if not st:
+        return [], None
+    lag = st.get("publish_lag_s")
+    lag_txt = f"published {lag:.1f} s ago" if lag is not None \
+        else "publish time unknown"
+    lines = [
+        "  serving plane (docs/serving.md):",
+        f"    snapshot v{st['version']} (step {st['pub_step']}), "
+        f"{lag_txt}, {st['shards']} stripe(s), "
+        f"gc floor v{st['gc_floor']}",
+        f"    serve clients: {st['clients_live']}/{st['clients_total']} "
+        "heartbeating",
+    ]
+    return lines, st
+
+
+def _serve(args) -> int:
+    """``bfrun --serve``: attach a read-only serving client from OUTSIDE
+    the job (docs/serving.md).
+
+    Like --status this is a raw control-plane attachment — no jax, no
+    mesh join, no membership registration — so it runs on an inference
+    host that shares nothing with the trainer but the control-plane
+    address. The client pulls the committed snapshot, hot-swaps on every
+    fence bump, and prints one line per swap; --serve-model MODULE:FN
+    additionally serves batched inference behind the admission gate."""
+    addr = _cp_address(args, "--serve")
+    if addr is None:
+        return 1
+    model_fn = None
+    if args.serve_model:
+        import importlib
+
+        mod_name, _, fn_name = args.serve_model.partition(":")
+        fn_name = fn_name or "model_fn"
+        try:
+            model_fn = getattr(importlib.import_module(mod_name), fn_name)
+        except (ImportError, AttributeError) as exc:
+            print(f"bfrun --serve: cannot load --serve-model "
+                  f"{args.serve_model!r} ({exc})", file=sys.stderr)
+            return 1
+    from .serving.client import ServeClient
+
+    sc = ServeClient(addr, model_fn,
+                     secret=os.environ.get("BLUEFOG_CP_SECRET", ""))
+    try:
+        if not sc.wait_ready(timeout=args.serve_timeout):
+            st = sc.stats()
+            print(f"bfrun --serve: no complete snapshot within "
+                  f"{args.serve_timeout:g} s "
+                  f"({st['pull_failures']} pull failure(s)) — is the "
+                  "trainer publishing (BLUEFOG_SERVE_PUBLISH_EVERY)?",
+                  file=sys.stderr)
+            return 1
+        last = 0
+        while True:
+            ver = sc.version()
+            if ver > last:
+                last = ver
+                st = sc.stats()
+                lag = st.get("publish_lag_s")
+                lag_txt = f"{lag:.1f}" if lag is not None else "?"
+                print(f"bfrun --serve: snapshot v{ver} "
+                      f"({st['wire_bytes'] / 1e6:.1f} MB wire total, "
+                      f"{st.get('pull_mbps', 0.0):.0f} MB/s, "
+                      f"publish lag {lag_txt} s, "
+                      f"{st['swaps']} swap(s))", flush=True)
+                if args.once:
+                    return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        sc.close()
 
 
 def _discover_world(cl) -> int:
@@ -1001,6 +1121,8 @@ def main(argv=None) -> int:
         return _top(args)
     if args.dump:
         return _dump(args)
+    if args.serve:
+        return _serve(args)
     if not args.command:
         build_parser().print_usage()
         return 1

@@ -678,6 +678,12 @@ class _WindowOptimizer(_FusedOptimizer):
         self._rejoin_shards: Dict[Tuple[str, int], Dict[int, Any]] = {}
         self._consensus_fn = None  # cached jit for the consensus gauge
         self._consensus_t = 0.0    # last gauge computation (monotonic)
+        # Serving plane (docs/serving.md): controller 0 publishes the
+        # post-gossip model as a versioned immutable snapshot every
+        # BLUEFOG_SERVE_PUBLISH_EVERY communicating steps. Lazy — no
+        # publisher object, no KV traffic, unless the knob is set.
+        self._serve_publisher = None
+        self._serve_pub_dead = False
 
     def _resolve_shard_factor(self) -> int:
         S = int(knob_env("BLUEFOG_WIN_SHARD") or 1)
@@ -952,6 +958,51 @@ class _WindowOptimizer(_FusedOptimizer):
                 self._counter)
         except (OSError, RuntimeError):
             pass
+
+    def _maybe_publish_snapshot(self, leaves) -> None:
+        """Serving-plane publisher hook (docs/serving.md).
+
+        On controller 0, every ``BLUEFOG_SERVE_PUBLISH_EVERY``-th
+        COMMUNICATING step, the post-gossip leaves are written to the
+        control plane as one versioned immutable snapshot (version = the
+        step counter, codec = the trainer's wire codec through
+        ``state_codec_for``). Publish failures degrade the serving plane,
+        never the training step — this method must not raise.
+        """
+        if self._serve_pub_dead:
+            return
+        try:
+            every = int(knob_env("BLUEFOG_SERVE_PUBLISH_EVERY") or 0)
+            if every <= 0:
+                return
+            if _global_state().process_index != 0 or not _cp.active():
+                return
+            if (self._counter // self.num_steps_per_communication) \
+                    % every != 0:
+                return
+            if self._serve_publisher is None:
+                from .serving.snapshot import (SnapshotPublisher,
+                                               resolve_serve_codec)
+                win = _windows._get_window(self._win_names[0])
+                self._serve_publisher = SnapshotPublisher(
+                    _cp.client(),
+                    codec=resolve_serve_codec(getattr(win, "codec", None)))
+            stats = self._serve_publisher.publish(
+                [np.asarray(v) for v in leaves], self._counter,
+                step=self._counter)
+            _metrics.counter("serve.publishes").inc()
+            _metrics.counter("serve.publish_wire_bytes").inc(
+                int(stats["wire_bytes"]))
+            _metrics.gauge("serve.version").set(int(stats["version"]))
+            _metrics.gauge("serve.publish_sec").set(stats["seconds"])
+        except (OSError, RuntimeError) as exc:
+            # transient wire trouble: skip this version, keep training
+            logger.warning("serving-plane snapshot publish failed (%s); "
+                           "version %d skipped", exc, self._counter)
+        except Exception as exc:  # noqa: BLE001 — structural: disable
+            self._serve_pub_dead = True
+            logger.warning(
+                "serving-plane publisher disabled for this run (%s)", exc)
 
     def _serve_rejoin_requests(self) -> None:
         """Donor-side hook, run once per membership-epoch change (base
@@ -1333,6 +1384,10 @@ class _WindowOptimizer(_FusedOptimizer):
                 self._record_consensus(leaves, out)
             params = jax.tree_util.tree_unflatten(self._treedef, out)
             state = TrainState(params, state.opt_state, state.model_state)
+            # serving plane: publish the post-gossip model as a versioned
+            # immutable snapshot (controller 0, every N-th comm step; a
+            # no-op without BLUEFOG_SERVE_PUBLISH_EVERY)
+            self._maybe_publish_snapshot(out)
         # live telemetry plane: ~1 Hz self-gated sample so single-
         # controller jobs (no heartbeat tick) still stream bf.ts.<rank>
         _timeseries.maybe_sample()

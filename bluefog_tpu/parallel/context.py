@@ -84,11 +84,28 @@ def ring_attention_shard(q, k, v, *, axis_name: str, causal: bool = False,
     return _ring_einsum_diff(q, k, v, axis_name, causal)
 
 
+def _axis_index(axis_name: str):
+    """``lax.axis_index`` that also lowers on the jax-0.4.x CPU backend.
+
+    There, the ring bodies' axis index emits a PartitionId HLO that the
+    SPMD partitioner rejects (``UNIMPLEMENTED: PartitionId``). An
+    all_to_all over an iota is equivalent — device i keeps element i of
+    ``arange(n)`` — and lowers on every backend; it costs one n-element
+    int32 exchange outside the scan, so keep the native lowering where it
+    works.
+    """
+    if jax.default_backend() != "cpu":
+        return lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+    return lax.all_to_all(jnp.arange(n, dtype=jnp.int32), axis_name,
+                          split_axis=0, concat_axis=0, tiled=True)[0]
+
+
 def _ring_einsum_partials(q, k, v, axis_name: str, causal: bool):
     """Einsum ring forward; returns (normalized out, row max m, row sum l),
     m/l in [B, Sq, H] layout — the backward's softmax reconstruction keys."""
     n = lax.psum(1, axis_name)
-    me = lax.axis_index(axis_name)
+    me = _axis_index(axis_name)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -150,7 +167,7 @@ def _ring_backward(axis_name: str, causal: bool, res, g,
     """
     q, k, v, out, m, l = res
     n = lax.psum(1, axis_name)
-    me = lax.axis_index(axis_name)
+    me = _axis_index(axis_name)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -240,7 +257,7 @@ def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool,
     from .flash import flash_block
 
     n = lax.psum(1, axis_name)
-    me = lax.axis_index(axis_name)
+    me = _axis_index(axis_name)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     q_off = me * Sq

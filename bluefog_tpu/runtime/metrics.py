@@ -432,6 +432,14 @@ def unpack_snapshot(blob: bytes) -> dict:
 # families (per-op-class transport counters). Scrapes are self-describing:
 # every sample gets a ``# HELP`` line (prom-lint asserts it).
 _HELP_EXACT: Dict[str, str] = {
+    "serve.publishes": "serving-plane snapshots committed behind the "
+                       "version fence by this trainer (docs/serving.md)",
+    "serve.publish_wire_bytes": "encoded snapshot bytes written to the "
+                                "control plane by the serving publisher",
+    "serve.version": "latest committed serving snapshot version "
+                     "(bf.serve.ver fence value)",
+    "serve.publish_sec": "wall seconds of the last serving snapshot "
+                         "publish (encode + stripe writes + fence)",
     "opt.step": "optimizer step counter of this rank",
     "opt.step_sec": "wall seconds per optimizer step",
     "opt.pack_sec": "seconds packing the fusion buffer per gossip step",
@@ -520,7 +528,7 @@ _HELP_PREFIX = (
 # resolution for every creation site in the package — a new family must
 # be added here (with curated HELP coverage) before it can ship.
 _PREFIX_FAMILIES = ("alert", "cp", "hb", "membership", "opt", "pushsum",
-                    "tune", "watchdog", "win")
+                    "serve", "tune", "watchdog", "win")
 
 
 def help_for(name: str) -> str:

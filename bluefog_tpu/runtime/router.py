@@ -52,6 +52,8 @@ handing off.
 
 from __future__ import annotations
 
+import socket
+import struct
 import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -79,6 +81,36 @@ _DEAD_FLAG = "bf.cp.shard_dead.{idx}"
 
 def _gen_dead(gen: int) -> bool:
     return gen > 0 and gen % 2 == 1
+
+
+# Published rejoin address (r19, lifting the r16 "must reuse its old
+# host:port" limit): a shard server restarted SOMEWHERE ELSE publishes its
+# new endpoint here, generation-stamped so the monotone put_max merge can
+# never regress to a stale address. The key rides the ``bf.cp.`` replicated
+# family, so any live shard can answer for it.
+SHARD_ADDR_FMT = "bf.cp.shard_addr.{idx}"
+
+
+def pack_shard_addr(gen: int, host: str, port: int) -> int:
+    """``(gen << 48) | (ipv4 << 16) | port`` — monotone in the liveness
+    generation, self-describing on decode. Hostname operands resolve to
+    IPv4 here (the wire carries only the packed form)."""
+    try:
+        ip = struct.unpack("!I", socket.inet_aton(host))[0]
+    except OSError:
+        ip = struct.unpack(
+            "!I", socket.inet_aton(socket.gethostbyname(host)))[0]
+    return ((int(gen) & 0xFFFF) << 48) | (ip << 16) | (int(port) & 0xFFFF)
+
+
+def unpack_shard_addr(value: int) -> Optional[Tuple[int, str, int]]:
+    """Packed rejoin address -> (generation, host, port); None for the
+    never-published (<= 0) value."""
+    value = int(value)
+    if value <= 0:
+        return None
+    host = socket.inet_ntoa(struct.pack("!I", (value >> 16) & 0xFFFFFFFF))
+    return (value >> 48) & 0xFFFF, host, value & 0xFFFF
 
 # Endpoints whose death was already ERROR-announced by THIS process: many
 # routers (one per subsystem, hundreds in the soak) detect the same death
@@ -373,6 +405,36 @@ class ShardRouter:
             except (OSError, RuntimeError):
                 pass
 
+    def _adopt_published_addr(self, idx: int) -> None:
+        """A shard that rejoined on a NEW host:port published it under
+        ``bf.cp.shard_addr.<idx>`` (generation-stamped, put_max-merged).
+        Take the max across live shards and re-point the shared endpoint
+        table before dialing — otherwise the rejoin dial would hit the
+        dead old endpoint forever (the r16 same-port limitation)."""
+        key = SHARD_ADDR_FMT.format(idx=idx)
+        best = 0
+        for j in self._live():
+            cl = self._clients[j]
+            if cl is None:
+                continue
+            try:
+                best = max(best, int(cl.get(key)))
+            except (OSError, RuntimeError):
+                continue
+        dec = unpack_shard_addr(best)
+        if dec is None:
+            return
+        _gen, host, port = dec
+        with self._st.mu:
+            old = tuple(self._st.endpoints[idx])
+            if (host, port) == old:
+                return
+            self._st.endpoints[idx] = (host, port)
+        logger.warning(
+            "control-plane shard %d moved: %s:%d -> %s:%d (published "
+            "rejoin address adopted; generation %d)", idx, old[0], old[1],
+            host, port, _gen)
+
     def _mark_alive(self, idx: int, why) -> None:
         """Shard rejoin (even liveness generation observed): dial the
         endpoint fresh and move its keyspace back. The superseded client
@@ -380,6 +442,7 @@ class ShardRouter:
         with self._st.mu:
             if idx not in self._st.dead:
                 return
+        self._adopt_published_addr(idx)
         try:
             cl = self._dial(idx)
         except (OSError, RuntimeError):

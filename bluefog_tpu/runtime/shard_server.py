@@ -30,10 +30,16 @@ host:port,host:port,...`` line to each shard's stdin, and only then does
 the server configure its ring successor (WAL replication,
 ``BLUEFOG_CP_REPLICATION``) and print the READY line. Ephemeral ports
 (``--port 0``) therefore need no pre-agreed port plan. ``--rejoin``
-(requires an explicit ``--port`` — the routers hold the old endpoint)
 additionally pulls a state snapshot from the ring successor, loads it,
-and publishes the next EVEN liveness generation under
-``bf.cp.shard_dead.<i>`` so every router moves the keyspace back.
+publishes the next EVEN liveness generation under ``bf.cp.shard_dead.<i>``
+so every router moves the keyspace back, and publishes its CURRENT
+endpoint under ``bf.cp.shard_addr.<i>`` (generation-stamped put_max) so a
+rejoin on a NEW host:port (``--port 0`` included) is re-dialed too — the
+r16 "must reuse its old endpoint" limit is lifted for the router plane.
+(The ring PREDECESSOR's WAL successor stream is still pinned to the old
+endpoint — ``set_successor`` is one-shot native-side — so replication to
+a moved shard stays degraded until the ring is restarted; routed traffic
+and catch-up are unaffected.)
 """
 
 from __future__ import annotations
@@ -100,8 +106,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rejoin", action="store_true",
                    help="restarted-shard catch-up: pull a state snapshot "
                         "from the ring successor, load it, and publish "
-                        "the next even liveness generation before READY "
-                        "(requires --port and a peer list)")
+                        "the next even liveness generation plus this "
+                        "server's current endpoint (bf.cp.shard_addr.<i>) "
+                        "before READY (requires a peer list; a new port — "
+                        "--port 0 included — is fine, routers re-dial it)")
+    p.add_argument("--advertise-host", default=None,
+                   help="host routers should re-dial after a rejoin "
+                        "(default: this shard's entry in the peer list)")
     return p
 
 
@@ -114,6 +125,32 @@ def _parse_peers(spec: str):
         host, _, port = item.rpartition(":")
         out.append((host, int(port)))
     return out
+
+
+def _published_addr(peers, idx: int, secret: str, skip: int = -1):
+    """Best-effort: shard ``idx``'s CURRENT endpoint per the replicated
+    ``bf.cp.shard_addr.<idx>`` key (None when never moved / no peer
+    reachable). Lets a rejoiner catch up from a ring peer that itself
+    rejoined on a new port earlier. ``skip`` names the CALLING shard:
+    a same-port rejoiner must never dial its own listed endpoint — the
+    op would park on its own still-closed rejoin gate (deadlock)."""
+    from .router import SHARD_ADDR_FMT, unpack_shard_addr
+
+    best = 0
+    for j, (h, p) in enumerate(peers):
+        if j == idx or j == skip:
+            continue
+        try:
+            cl = ControlPlaneClient(h, p, 0, secret=secret, streams=1)
+            try:
+                best = max(best,
+                           int(cl.get(SHARD_ADDR_FMT.format(idx=idx))))
+            finally:
+                cl.close()
+        except (OSError, RuntimeError):
+            continue
+    dec = unpack_shard_addr(best)
+    return (dec[1], dec[2]) if dec else None
 
 
 def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
@@ -142,7 +179,10 @@ def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
     last = None
     while True:
         try:
-            host, port = peers[succ]
+            # a ring peer may itself have moved in an earlier rejoin; its
+            # published address supersedes the static peer list
+            host, port = _published_addr(peers, succ, secret, skip=idx) \
+                or peers[succ]
             cl = ControlPlaneClient(host, port, 0, secret=secret, streams=1)
             try:
                 if n <= 2:
@@ -155,7 +195,8 @@ def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
                 else:
                     srv.load_snapshot(cl.snapshot(n, idx), set_fence=False,
                                       adopt_wal=True)
-                    ph, pp = peers[pred]
+                    ph, pp = _published_addr(peers, pred, secret,
+                                             skip=idx) or peers[pred]
                     pcl = ControlPlaneClient(ph, pp, 0, secret=secret,
                                              streams=1)
                     try:
@@ -184,10 +225,6 @@ def main(argv=None) -> int:
         max_mb = float(knob_env("BLUEFOG_CP_MAILBOX_MAX_MB"))
     cap = int(max_mb * (1 << 20))
     secret = os.environ.get("BLUEFOG_CP_SECRET", "")
-    if args.rejoin and not args.port:
-        print("shard_server: --rejoin requires an explicit --port (the "
-              "routers hold the old endpoint)", file=sys.stderr)
-        return 2
     # --rejoin arms the rejoin gate ATOMICALLY with the bind: any op
     # served against the not-yet-loaded store would lose records now and
     # resurrect them out of order later. The cap self-publish is skipped
@@ -229,10 +266,13 @@ def main(argv=None) -> int:
               "open)", file=sys.stderr)
         srv.stop()
         return 2
+    addr_val = None
     if peers and len(peers) > 1 and int(knob_env("BLUEFOG_CP_REPLICATION")):
+        succ_idx = (args.shard + 1) % len(peers)
         if args.rejoin:
             _rejoin_catch_up(srv, args.shard, peers, secret)
-        sh, sp = peers[(args.shard + 1) % len(peers)]
+        sh, sp = (_published_addr(peers, succ_idx, secret, skip=args.shard)
+                  if args.rejoin else None) or peers[succ_idx]
         srv.set_successor(sh, sp, len(peers), args.shard)
         logger.info("shard %d: WAL replication to ring successor %s:%d",
                     args.shard, sh, sp)
@@ -242,15 +282,29 @@ def main(argv=None) -> int:
             # generation, and an op served before set_successor would be
             # acked UNREPLICATED (a split-brain seed the soak caught as
             # counter-era violations). Monotone put_max + the successor's
-            # WAL propagate the flag to every shard.
+            # WAL propagate the flag to every shard. The next even
+            # generation also stamps bf.cp.shard_addr.<i> with THIS
+            # server's endpoint — the key routers consult before the
+            # rejoin re-dial, which is what lets a restart land on a new
+            # host:port (--port 0 included).
+            from .router import pack_shard_addr
+
+            adv_host = args.advertise_host or \
+                (peers[args.shard][0] if args.shard < len(peers)
+                 else "127.0.0.1")
             try:
-                sh0, sp0 = peers[(args.shard + 1) % len(peers)]
-                cl = ControlPlaneClient(sh0, sp0, 0, secret=secret,
+                cl = ControlPlaneClient(sh, sp, 0, secret=secret,
                                         streams=1)
                 flag = f"bf.cp.shard_dead.{args.shard}"
                 cur = cl.put_max(flag, 0)
-                if cur % 2 == 1:
-                    cl.put_max(flag, cur + 1)
+                # odd (dead) -> next even; even -> next even AGAIN so the
+                # generation stamped into the address key is strictly
+                # fresher than any earlier rejoin's (put_max can then
+                # never keep a stale endpoint)
+                new_gen = cur + 1 if cur % 2 == 1 else cur + 2
+                cl.put_max(flag, new_gen)
+                addr_val = pack_shard_addr(new_gen, adv_host, srv.port)
+                cl.put_max(f"bf.cp.shard_addr.{args.shard}", addr_val)
                 cl.close()
             except OSError as exc:
                 logger.warning("shard %d: alive-generation publish failed "
@@ -273,23 +327,48 @@ def main(argv=None) -> int:
         # the monotone put_max around the ring), so a false death claim
         # self-corrects within a poll interval; a real death stops the
         # keeper with the process.
-        sh, sp = peers[(args.shard + 1) % len(peers)]
         flag = f"bf.cp.shard_dead.{args.shard}"
+        addr_key = f"bf.cp.shard_addr.{args.shard}"
 
         def _alive_keeper() -> None:
+            from .router import pack_shard_addr
+
             cl = None
             while not done.wait(2.0):
                 try:
                     if cl is None:
-                        cl = ControlPlaneClient(sh, sp, 0, secret=secret,
+                        ah, ap = _published_addr(
+                            peers, (args.shard + 1) % len(peers), secret,
+                            skip=args.shard) \
+                            or peers[(args.shard + 1) % len(peers)]
+                        cl = ControlPlaneClient(ah, ap, 0, secret=secret,
                                                 streams=1)
                     cur = cl.put_max(flag, 0)
+                    if cur < 0:
+                        # transport-level failure surfaces as -1, not an
+                        # exception: the successor died (possibly to come
+                        # back on a NEW port) — drop the client and
+                        # re-resolve its published address next tick
+                        cl.close()
+                        cl = None
+                        continue
                     if cur % 2 == 1:
                         cl.put_max(flag, cur + 1)
+                        if addr_val is not None:
+                            # a moved shard's endpoint must outlive false
+                            # death claims: restamp it at the new even gen
+                            cl.put_max(addr_key,
+                                       pack_shard_addr(
+                                           cur + 1,
+                                           args.advertise_host
+                                           or peers[args.shard][0],
+                                           srv.port))
                         logger.warning(
                             "shard %d: re-asserted ALIVE (liveness "
                             "generation %d -> %d; a peer's death claim "
                             "was spurious)", args.shard, cur, cur + 1)
+                    elif addr_val is not None:
+                        cl.put_max(addr_key, addr_val)
                 except OSError:
                     if cl is not None:
                         cl.close()

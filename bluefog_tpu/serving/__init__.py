@@ -1,0 +1,44 @@
+"""Serving plane: versioned snapshot distribution + read-only inference.
+
+The training side of the repo gossips *windows*; this package is the read
+side (ROADMAP "Serving plane", docs/serving.md). Training ranks publish
+**versioned, immutable model snapshots** over the existing KV/striped-get
+wire (``bf.serve.snap.<ver>.<shard>`` + a monotone ``bf.serve.ver``
+commit fence written only after every shard landed), and external
+processes attach with a raw control-plane client — no mesh join, no jax
+anywhere on the fetch path — to pull them concurrently across the
+control-plane shards, hot-swap weights on a version bump, and serve
+batched inference behind an admission-control gate driven by the live
+telemetry plane.
+
+Import discipline: everything under ``bluefog_tpu.serving`` is
+numpy-only. A standalone serving process uses the same lean bootstrap as
+``scripts/cp_soak.py`` (stub parent packages, then import
+``bluefog_tpu.serving.client``); inside a training job,
+``bf.serve_client()`` re-exports :func:`serve_client`.
+"""
+
+from .snapshot import (  # noqa: F401
+    GC_FLOOR_KEY,
+    META_KEY,
+    PUB_STEP_KEY,
+    PUB_TS_KEY,
+    SNAP_KEY_FMT,
+    VER_KEY,
+    SnapshotGone,
+    SnapshotMeta,
+    SnapshotPublisher,
+    current_version,
+    fetch_meta,
+    fetch_snapshot,
+    read_serve_status,
+    serve_shard_count,
+)
+from .client import RequestShed, ServeClient, serve_client  # noqa: F401
+
+__all__ = [
+    "SNAP_KEY_FMT", "VER_KEY", "META_KEY", "PUB_TS_KEY", "PUB_STEP_KEY",
+    "GC_FLOOR_KEY", "SnapshotMeta", "SnapshotPublisher", "SnapshotGone",
+    "current_version", "fetch_meta", "fetch_snapshot", "read_serve_status",
+    "serve_shard_count", "ServeClient", "RequestShed", "serve_client",
+]

@@ -1,0 +1,505 @@
+"""Read-only inference client over the snapshot plane.
+
+A :class:`ServeClient` attaches to the control plane the way ``bfrun
+--status`` does — a raw client, no mesh join, no jax anywhere on the
+path — and runs three concerns on top of it:
+
+* **Puller.** A poller thread watches the ``bf.serve.ver`` fence and, on
+  a bump, pulls the new snapshot's shards IN PARALLEL: keys are grouped
+  by the router's FNV placement, each group is fetched on a dedicated
+  per-endpoint client (its own striped-stream pool), so aggregate pull
+  bandwidth scales with the control-plane shard count instead of being
+  serialized through one socket. The swap is atomic under a lock — a
+  request is always served by exactly one complete version.
+
+* **Batcher.** ``submit()`` enqueues a single example and blocks on a
+  future; a batcher thread drains the queue into stacked batches (max
+  ``BLUEFOG_SERVE_BATCH``, linger ``BLUEFOG_SERVE_BATCH_WAIT_MS``) and
+  runs the user's ``model_fn(params, batch)`` once per batch.
+
+* **Admission gate.** Before enqueueing, ``submit()`` consults the r18
+  telemetry the trainer is already publishing — queue depth, control
+  -plane mailbox pressure, publish lag, live alert blobs — and resolves
+  to ``accept`` / ``queue`` (admitted, counted as degraded) / ``shed``
+  (:class:`RequestShed`). Serving load can therefore never push the
+  control plane into the overload regimes the training side alarms on.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+import zlib
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..runtime.config import knob_env
+from ..runtime.logging import logger
+from ..runtime.router import _fnv64
+from . import snapshot as _snap
+
+
+class RequestShed(RuntimeError):
+    """The admission gate refused this request (overload protection).
+
+    Callers should back off and retry later; ``gate`` carries the input
+    that tripped (``queue_full`` / ``mailbox`` / ``not_ready``)."""
+
+    def __init__(self, message: str, gate: str = "") -> None:
+        self.gate = gate
+        super().__init__(message)
+
+
+def _endpoint_for(key: str, n: int) -> int:
+    return _fnv64(key) % n
+
+
+class ServeClient:
+    """Versioned-snapshot puller + batched read-only inference server.
+
+    ``model_fn(params, batch) -> outputs`` runs on stacked numpy batches
+    (``params`` is the snapshot's leaf list). Without a ``model_fn`` the
+    client still pulls and hot-swaps — ``params()``/``version()`` expose
+    the freshest complete snapshot for callers doing their own compute.
+    """
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]],
+                 model_fn: Optional[Callable] = None, *,
+                 secret: str = "", streams: Optional[int] = None,
+                 poll_s: Optional[float] = None,
+                 register: bool = True, start: bool = True) -> None:
+        from ..runtime.native import ControlPlaneClient
+        from ..runtime.router import ShardRouter
+
+        if not endpoints:
+            raise ValueError("ServeClient needs at least one control-plane "
+                             "endpoint")
+        self._endpoints = [(str(h), int(p)) for h, p in endpoints]
+        self._secret = secret
+        self._streams = streams
+        self._model_fn = model_fn
+        self._poll_s = float(knob_env("BLUEFOG_SERVE_POLL_S")) \
+            if poll_s is None else float(poll_s)
+        # scalar/meta/telemetry path: the same lenient attach --status uses
+        if len(self._endpoints) == 1:
+            host, port = self._endpoints[0]
+            self._cl = ControlPlaneClient(host, port, 0, secret=secret,
+                                          streams=1)
+        else:
+            self._cl = ShardRouter(self._endpoints, 0, secret=secret,
+                                   streams=1, lenient=True)
+        # bulk path: dedicated per-endpoint clients, dialed lazily so a
+        # shard that is down between publishes never blocks attach
+        self._bulk: Dict[int, ControlPlaneClient] = {}
+        self._bulk_mu = threading.Lock()
+        self._pace_mbps = 0.0  # bench/test hook, see pull_blobs()
+
+        self._mu = threading.Lock()          # guards the swap state below
+        self._params: Optional[List[np.ndarray]] = None
+        self._version = 0
+        self._meta: Optional[_snap.SnapshotMeta] = None
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+        self._health: dict = {}
+        self._stats = {"swaps": 0, "pulls": 0, "pull_failures": 0,
+                       "wire_bytes": 0, "pull_mbps": 0.0,
+                       "accepted": 0, "queued": 0, "shed": 0,
+                       "requests": 0, "batches": 0}
+
+        qmax = int(knob_env("BLUEFOG_SERVE_QUEUE_MAX"))
+        soft = int(knob_env("BLUEFOG_SERVE_QUEUE_SOFT")) or max(1, qmax // 2)
+        self._qmax, self._qsoft = qmax, min(soft, qmax)
+        self._stale_s = float(knob_env("BLUEFOG_SERVE_STALE_S"))
+        self._mailbox_cap: Optional[int] = None
+        self._batch_max = max(1, int(knob_env("BLUEFOG_SERVE_BATCH")))
+        self._linger_s = max(
+            0.0, float(knob_env("BLUEFOG_SERVE_BATCH_WAIT_MS")) / 1e3)
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=qmax)
+
+        self._cid = -1
+        if register:
+            try:
+                self._cid = int(self._cl.fetch_add(_snap.CLIENTS_KEY, 1))
+            except (OSError, RuntimeError):
+                pass  # registration is observability, not correctness
+
+        self._threads: List[threading.Thread] = []
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        t = threading.Thread(target=self._poll_loop,
+                             name="bf-serve-poll", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self._model_fn is not None:
+            t = threading.Thread(target=self._batch_loop,
+                                 name="bf-serve-batch", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        while True:  # fail anything still parked in the queue
+            try:
+                _, fut = self._q.get_nowait()
+            except _queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(RequestShed("serve client closed",
+                                              gate="closed"))
+        with self._bulk_mu:
+            for cl in self._bulk.values():
+                try:
+                    cl.close()
+                except (OSError, RuntimeError):
+                    pass
+            self._bulk.clear()
+        try:
+            self._cl.close()
+        except (OSError, RuntimeError):
+            pass
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- snapshot access ---------------------------------------------------
+
+    def params(self) -> Optional[List[np.ndarray]]:
+        with self._mu:
+            return self._params
+
+    def version(self) -> int:
+        with self._mu:
+            return self._version
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the first complete snapshot is swapped in."""
+        return self._ready.wait(timeout)
+
+    def refresh(self) -> int:
+        """Synchronous poll: pull and swap if the fence moved. Returns the
+        serving version after the check."""
+        self._maybe_pull()
+        return self.version()
+
+    # -- parallel bulk puller ---------------------------------------------
+
+    def _bulk_client(self, idx: int):
+        from ..runtime.native import ControlPlaneClient
+
+        with self._bulk_mu:
+            cl = self._bulk.get(idx)
+            if cl is None:
+                # a shard that died and rejoined on a NEW port re-points
+                # the router's endpoint table (bf.cp.shard_addr adoption);
+                # bulk re-dials must follow it, not the attach-time copy
+                eps = self._cl.endpoints \
+                    if hasattr(self._cl, "endpoints") else self._endpoints
+                host, port = eps[idx]
+                cl = ControlPlaneClient(host, port, 0, secret=self._secret,
+                                        streams=self._streams)
+                self._bulk[idx] = cl
+            return cl
+
+    def _drop_bulk_client(self, idx: int) -> None:
+        with self._bulk_mu:
+            cl = self._bulk.pop(idx, None)
+        if cl is not None:
+            try:
+                cl.close()
+            except (OSError, RuntimeError):
+                pass
+
+    def pull_blobs(self, keys: Sequence[str]) -> List[Optional[bytes]]:
+        """Fetch ``keys`` grouped by FNV placement, one thread + one
+        dedicated striped client per control-plane endpoint — the
+        fan-out that makes pull bandwidth scale with shard count."""
+        n = len(self._endpoints)
+        groups: Dict[int, List[int]] = {}
+        for pos, key in enumerate(keys):
+            groups.setdefault(_endpoint_for(key, n), []).append(pos)
+        out: List[Optional[bytes]] = [None] * len(keys)
+        errs: List[str] = []
+
+        def pull_group(idx: int, positions: List[int]) -> None:
+            t0 = time.perf_counter()
+            try:
+                blobs = self._bulk_client(idx).get_bytes_many(
+                    [keys[p] for p in positions])
+                for p, b in zip(positions, blobs):
+                    out[p] = b
+                if self._pace_mbps > 0.0:
+                    # bench/test hook: model a per-endpoint link capacity.
+                    # Groups sleep out their byte budget CONCURRENTLY, the
+                    # way NIC-bound pulls overlap across real shard hosts.
+                    nbytes = sum(len(b) for b in blobs if b)
+                    time.sleep(max(0.0, nbytes / (self._pace_mbps * 1e6)
+                                   - (time.perf_counter() - t0)))
+            except (OSError, RuntimeError) as exc:
+                self._drop_bulk_client(idx)
+                errs.append(f"{self._endpoints[idx][0]}:"
+                            f"{self._endpoints[idx][1]}: {exc}")
+
+        if len(groups) == 1:
+            idx, positions = next(iter(groups.items()))
+            pull_group(idx, positions)
+        else:
+            workers = [threading.Thread(target=pull_group, args=(i, ps),
+                                        daemon=True)
+                       for i, ps in groups.items()]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+        if errs:
+            raise OSError("snapshot pull failed on "
+                          f"{len(errs)}/{len(groups)} endpoint group(s): "
+                          + "; ".join(errs))
+        return out
+
+    def _maybe_pull(self) -> None:
+        ver = _snap.current_version(self._cl)
+        if ver <= self._version or ver == 0:
+            return
+        if self._meta is None:
+            self._meta = _snap.fetch_meta(self._cl)
+            if self._meta is None:
+                return  # fence moved but meta not visible yet; next poll
+        t0 = time.perf_counter()
+        try:
+            got = _snap.fetch_snapshot(self._cl, meta=self._meta,
+                                       pull=self.pull_blobs)
+        except (OSError, RuntimeError) as exc:
+            self._stats["pull_failures"] += 1
+            logger.warning("serve client: snapshot pull failed (%s); "
+                           "keeping version %d", exc, self._version)
+            return
+        if got is None:
+            return
+        leaves, got_ver, wire = got
+        dt = max(1e-9, time.perf_counter() - t0)
+        with self._mu:
+            if got_ver <= self._version:
+                return  # raced with a concurrent refresh
+            self._params = leaves
+            self._version = got_ver
+            self._stats["swaps"] += 1
+            self._stats["pulls"] += 1
+            self._stats["wire_bytes"] += wire
+            self._stats["pull_mbps"] = wire / dt / 1e6
+        self._ready.set()
+
+    # -- poller ------------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._maybe_pull()
+            except (OSError, RuntimeError, ValueError) as exc:
+                self._stats["pull_failures"] += 1
+                logger.warning("serve client: poll failed (%s)", exc)
+            try:
+                self._update_health()
+            except (OSError, RuntimeError):
+                pass
+            self._stop.wait(self._poll_s)
+
+    def _update_health(self) -> None:
+        if hasattr(self._cl, "poll_shard_health"):
+            # drives the router's dead -> rejoined -> adopt-new-address
+            # cycle; without a periodic probe a shard that moved ports
+            # would stay dead in this client's view forever
+            self._cl.poll_shard_health()
+        h: dict = {}
+        ts = _snap._get_float(self._cl, _snap.PUB_TS_KEY)
+        h["publish_lag_s"] = max(0.0, time.time() - ts) if ts > 0 else None
+        h["mailbox_frac"] = self._mailbox_frac()
+        h["alerts"] = self._alert_count()
+        self._health = h
+        if self._cid >= 0:
+            _snap._put_float(
+                self._cl, _snap.CLIENT_HB_FMT.format(cid=self._cid),
+                time.time())
+
+    def _mailbox_frac(self) -> float:
+        cap = self._mailbox_cap
+        if cap is None:
+            # the serving process publishes (cap + 1) at startup; fall
+            # back to this process's own knob when it predates the key
+            try:
+                v = int(self._cl.get("bf.cp.mailbox_cap_bytes"))
+            except (OSError, RuntimeError):
+                v = 0
+            if v > 0:
+                cap = v - 1
+            else:
+                from ..runtime.control_plane import mailbox_cap_bytes
+                cap = mailbox_cap_bytes()
+            self._mailbox_cap = cap
+        if cap <= 0:
+            return 0.0
+        worst = 0
+        if hasattr(self._cl, "server_stats_all"):
+            for _, st in self._cl.server_stats_all():
+                if st:
+                    worst = max(worst, int(st.get("mailbox_bytes", 0)))
+        else:
+            st = self._cl.server_stats()
+            worst = int(st.get("mailbox_bytes", 0)) if st else 0
+        return worst / float(cap)
+
+    def _alert_count(self) -> int:
+        from ..runtime.timeseries import ALERTS_KEY_FMT
+
+        try:
+            world = max(1, int(self._cl.get("bf.metrics.world")))
+        except (OSError, RuntimeError):
+            return 0
+        n = 0
+        for r in range(min(world, 64)):
+            try:
+                blob = self._cl.get_bytes(ALERTS_KEY_FMT.format(rank=r))
+            except (OSError, RuntimeError):
+                continue
+            if not blob:
+                continue
+            try:
+                import json
+                n += len(json.loads(zlib.decompress(bytes(blob))))
+            except (ValueError, zlib.error):
+                n += 1  # unreadable alert blob still counts as one
+        return n
+
+    # -- admission + batching ----------------------------------------------
+
+    def admission(self) -> Tuple[str, str]:
+        """(verdict, reason): ``accept`` | ``queue`` | ``shed``."""
+        depth = self._q.qsize()
+        if depth >= self._qmax:
+            return "shed", "queue_full"
+        h = self._health
+        if h.get("mailbox_frac", 0.0) > 0.8:
+            return "shed", "mailbox"
+        if not self._ready.is_set():
+            return "queue", "not_ready"
+        if depth >= self._qsoft:
+            return "queue", "queue_depth"
+        lag = h.get("publish_lag_s")
+        if lag is not None and lag > self._stale_s:
+            return "queue", "publish_lag"
+        if h.get("alerts", 0) > 0:
+            return "queue", "alerts"
+        return "accept", ""
+
+    def submit(self, example: np.ndarray) -> Future:
+        """Admit one example; the future resolves to its model output.
+
+        Raises :class:`RequestShed` when the gate sheds. A ``queue``
+        verdict still admits (counted in ``stats()['queued']``)."""
+        if self._model_fn is None:
+            raise RuntimeError("ServeClient was built without a model_fn")
+        verdict, reason = self.admission()
+        if verdict == "shed":
+            self._stats["shed"] += 1
+            raise RequestShed(
+                f"request shed by admission control ({reason})", reason)
+        fut: Future = Future()
+        try:
+            self._q.put_nowait((np.asarray(example), fut))
+        except _queue.Full:
+            self._stats["shed"] += 1
+            raise RequestShed("request shed by admission control "
+                              "(queue_full)", "queue_full") from None
+        self._stats["queued" if verdict == "queue" else "accepted"] += 1
+        self._stats["requests"] += 1
+        return fut
+
+    def infer(self, example: np.ndarray,
+              timeout: Optional[float] = None) -> np.ndarray:
+        """``submit`` + block on the result."""
+        return self.submit(example).result(timeout)
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self._ready.wait(timeout=self._poll_s):
+                continue
+            try:
+                first = self._q.get(timeout=self._poll_s)
+            except _queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self._linger_s
+            while len(batch) < self._batch_max:
+                left = deadline - time.monotonic()
+                try:
+                    batch.append(self._q.get(
+                        timeout=max(0.0, left)) if left > 0
+                        else self._q.get_nowait())
+                except _queue.Empty:
+                    break
+            with self._mu:
+                params = self._params
+            xs = np.stack([x for x, _ in batch])
+            try:
+                ys = self._model_fn(params, xs)
+            except Exception as exc:  # noqa: BLE001 — fail the futures
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                continue
+            self._stats["batches"] += 1
+            for i, (_, fut) in enumerate(batch):
+                if not fut.done():
+                    fut.set_result(np.asarray(ys)[i])
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        out = dict(self._stats)
+        out["version"] = self.version()
+        out["queue_depth"] = self._q.qsize()
+        out["publish_lag_s"] = self._health.get("publish_lag_s")
+        return out
+
+
+def serve_client(model_fn: Optional[Callable] = None,
+                 endpoints: Optional[Sequence[Tuple[str, int]]] = None,
+                 **kw) -> ServeClient:
+    """Attach a :class:`ServeClient` to the job's control plane.
+
+    Endpoint resolution mirrors ``bfrun --status``: explicit
+    ``endpoints``, else ``BLUEFOG_CP_HOSTS``, else
+    ``BLUEFOG_CP_HOST``/``BLUEFOG_CP_PORT``. The secret defaults to
+    ``BLUEFOG_CP_SECRET``.
+    """
+    if endpoints is None:
+        from ..runtime.router import parse_endpoints
+
+        spec = knob_env("BLUEFOG_CP_HOSTS")
+        if spec:
+            endpoints = parse_endpoints(spec)
+        else:
+            host = knob_env("BLUEFOG_CP_HOST")
+            port = knob_env("BLUEFOG_CP_PORT")
+            if not host or not port:
+                raise RuntimeError(
+                    "serve_client: control-plane address unknown; pass "
+                    "endpoints=[(host, port)] or set BLUEFOG_CP_HOSTS / "
+                    "BLUEFOG_CP_HOST+BLUEFOG_CP_PORT")
+            endpoints = [(host, int(port))]
+    kw.setdefault("secret", knob_env("BLUEFOG_CP_SECRET") or "")
+    return ServeClient(endpoints, model_fn, **kw)

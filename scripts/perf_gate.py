@@ -136,6 +136,24 @@ def collect_once() -> dict:
                 f"{row.get('overlap')} failed: {row['error']}")
         out[f"hybrid.{row['mode']}.{row['plane']}.ov{row['overlap']}"
             ".img_per_sec"] = row["img_per_sec"]
+    # serving plane: `serve.*` is INFO-ONLY — kept out of the baseline
+    # (gating() drops it on --update-baseline) so every row renders as
+    # info, per the stable-series rule new series follow before they
+    # graduate. The latency rows are LOWER-better: they must be inverted
+    # (or replaced by a rate) before ever gating under compare()'s
+    # higher-is-better band.
+    text = _run([sys.executable, "scripts/serve_bench.py", "--quick"],
+                timeout=900)
+    for line in text.splitlines():
+        if not line.startswith("BF_SERVE_BENCH "):
+            continue
+        row = json.loads(line.split(None, 1)[1])
+        for key in ("pull_mbps_1shard", "pull_mbps_4shard",
+                    "pull_mbps_1shard_net", "pull_mbps_4shard_net",
+                    "pull_scaling_x_net", "int8_wire_ratio",
+                    "p50_ms", "p99_ms"):
+            if row.get(key) is not None:
+                out[f"serve.{key}"] = row[key]
     return out
 
 
@@ -218,7 +236,8 @@ def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
                           "since r18; sharded.* gating since r19)",
                           "opt_matrix_bench --quick --modes "
                           + " ".join(_OPT_MODES),
-                          "opt_matrix_bench --quick --hybrid"],
+                          "opt_matrix_bench --quick --hybrid",
+                          "serve_bench --quick (serve.* INFO-ONLY)"],
             "note": "quick-mode numbers: gate-relative only, meaningless "
                     "as absolute throughput (see PERF.md for real runs)",
         },

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Serving-plane smoke test (`make serve-smoke`).
+
+A 2-rank in-process trainer with the publisher hook armed
+(BLUEFOG_SERVE_PUBLISH_EVERY=1) plus one read-only serve client,
+asserting the train-while-serve acceptance surface end to end
+(docs/serving.md):
+
+  * the trainer's post-gossip snapshots land behind the version fence
+    and the attached client hot-swaps on every bump while training
+    continues (swap count grows across extra steps);
+  * batched inference returns non-empty replies that EXACTLY match a
+    numpy oracle applied to the client's own swapped-in snapshot —
+    the params the gate admitted against are the params that answered;
+  * the admission gate sheds at the hard queue cap (gate
+    ``queue_full``) and every already-admitted future still resolves;
+  * ``bfrun --serve --once`` attaches from a SEPARATE process (raw
+    control-plane client, no jax) and prints the swap line;
+  * ``bfrun --status`` from outside shows the serving-plane rows.
+
+Exits non-zero (with a message) on any violated assertion.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+_s = socket.socket()
+_s.bind(("127.0.0.1", 0))
+PORT = _s.getsockname()[1]
+_s.close()
+
+os.environ.update({
+    "BLUEFOG_CP_HOST": "127.0.0.1",
+    "BLUEFOG_CP_PORT": str(PORT),
+    "BLUEFOG_CP_WORLD": "1",
+    "BLUEFOG_CP_RANK": "0",
+    "BLUEFOG_SERVE_PUBLISH_EVERY": "1",
+    "BLUEFOG_SERVE_POLL_S": "0.1",
+})
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+import bluefog_tpu as bf  # noqa: E402
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"serve-smoke FAILED: {msg}", file=sys.stderr)
+        sys.exit(1)
+
+
+def main() -> int:
+    # 1) a 2-rank trainer whose every communicating step publishes
+    bf.init(devices=jax.devices("cpu")[:2])
+
+    def zloss(p, b):
+        return 0.0 * jnp.sum(p["w"])
+
+    opt = bf.DistributedPushSumOptimizer(optax.sgd(0.1), zloss,
+                                         window_prefix="smoke.serve")
+    state = opt.init({"w": jnp.arange(96, dtype=jnp.float32)})
+    for _ in range(3):
+        state, _ = opt.step(state, jnp.zeros((2, 1), jnp.float32))
+
+    # 2) serve client hot-swaps while the trainer keeps stepping
+    def model_fn(params, xs):
+        return xs + params[0][0]
+
+    sc = bf.serve_client(model_fn, endpoints=[("127.0.0.1", PORT)])
+    check(sc.wait_ready(timeout=20), "no complete snapshot within 20 s — "
+          "did the publisher hook fire?")
+    v0, s0 = sc.version(), sc.stats()["swaps"]
+    check(v0 >= 1, f"serving version {v0} after 3 published steps")
+    for _ in range(3):
+        state, _ = opt.step(state, jnp.zeros((2, 1), jnp.float32))
+    deadline = 20.0
+    while sc.version() <= v0 and deadline > 0:
+        deadline -= 0.1
+        threading.Event().wait(0.1)
+    check(sc.version() > v0 and sc.stats()["swaps"] > s0,
+          f"no hot-swap: version {v0} -> {sc.version()}, "
+          f"swaps {s0} -> {sc.stats()['swaps']}")
+
+    # 3) batched replies match the numpy oracle on the swapped-in params
+    params = sc.params()
+    xs = np.linspace(-1.0, 1.0, 8, dtype=np.float32)
+    futs = [sc.submit(np.array([x], np.float32)) for x in xs]
+    ys = np.array([f.result(timeout=10)[0] for f in futs])
+    want = xs + float(np.asarray(params[0]).ravel()[0])
+    check(np.allclose(ys, want),
+          f"batched replies diverge from the snapshot oracle: {ys} != {want}")
+    check(sc.stats()["batches"] >= 1, "no batch was formed")
+    sc.close()
+
+    # 4) shed path: a hard queue cap of 2 with a blocked model must shed
+    os.environ.update({"BLUEFOG_SERVE_QUEUE_MAX": "2",
+                       "BLUEFOG_SERVE_QUEUE_SOFT": "1",
+                       "BLUEFOG_SERVE_BATCH": "1"})
+    gate = threading.Event()
+
+    def slow_fn(params, xs):
+        gate.wait(20)
+        return xs
+
+    from bluefog_tpu.serving.client import RequestShed
+    sc2 = bf.serve_client(slow_fn, endpoints=[("127.0.0.1", PORT)])
+    check(sc2.wait_ready(timeout=20), "second client never became ready")
+    admitted, shed = [], 0
+    for i in range(8):
+        try:
+            admitted.append(sc2.submit(np.zeros(1, np.float32)))
+        except RequestShed as exc:
+            shed += 1
+            check(exc.gate == "queue_full",
+                  f"shed gate {exc.gate!r}, expected queue_full")
+    check(shed >= 1, "queue cap 2 never shed across 8 submits")
+    gate.set()
+    for f in admitted:
+        f.result(timeout=10)  # every admitted request still resolves
+    check(sc2.stats()["shed"] == shed, "shed counter out of sync")
+    sc2.close()
+    del os.environ["BLUEFOG_SERVE_QUEUE_MAX"]
+    del os.environ["BLUEFOG_SERVE_QUEUE_SOFT"]
+    del os.environ["BLUEFOG_SERVE_BATCH"]
+
+    # 5) the external attach path: bfrun from a separate process
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--serve", "--once",
+         "--cp", f"127.0.0.1:{PORT}"],
+        env=env, capture_output=True, text=True, timeout=120)
+    print(out.stdout, end="")
+    check(out.returncode == 0, f"bfrun --serve --once failed: {out.stderr}")
+    check("snapshot v" in out.stdout,
+          f"--serve printed no swap line: {out.stdout!r}")
+
+    out = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.launcher", "--status"],
+        env=env, capture_output=True, text=True, timeout=120)
+    check(out.returncode == 0, f"bfrun --status failed: {out.stderr}")
+    check("serving plane" in out.stdout and "snapshot v" in out.stdout,
+          f"--status output missing serving rows: {out.stdout!r}")
+
+    opt.free()
+    bf.shutdown()
+    print("serve-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

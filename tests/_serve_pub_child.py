@@ -1,0 +1,67 @@
+"""Snapshot-publisher child for the serving chaos tests (SIGKILL bait).
+
+Loops publishing versioned snapshots whose every element equals the
+version number — so a torn read (shards from two versions stitched
+together) is detectable as a value mismatch on the reader side. An
+``--inter-shard-ms`` sleep stretches the publish window (shards land one
+by one) to make a SIGKILL reliably land MID-publish: after every shard
+write but before the ``bf.serve.ver`` fence move.
+
+Lean bootstrap (no jax) — the publisher wire is numpy-only by contract.
+
+    python tests/_serve_pub_child.py --host H --port P --start-ver V \
+        [--shards S] [--elems N] [--inter-shard-ms MS] [--codec C]
+
+Prints ``PUB <ver>`` after each committed version; runs until killed.
+"""
+
+import argparse
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+for _name in ("bluefog_tpu", "bluefog_tpu.runtime", "bluefog_tpu.ops"):
+    _mod = types.ModuleType(_name)
+    _mod.__path__ = [os.path.join(_REPO, _name.replace(".", os.sep))]
+    sys.modules[_name] = _mod
+
+import numpy as np  # noqa: E402
+
+from bluefog_tpu.ops import codec as codec_mod  # noqa: E402
+from bluefog_tpu.runtime.native import ControlPlaneClient  # noqa: E402
+from bluefog_tpu.serving.snapshot import SnapshotPublisher  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--start-ver", type=int, default=1)
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--elems", type=int, default=5000)
+    p.add_argument("--inter-shard-ms", type=float, default=0.0)
+    p.add_argument("--codec", default=None)
+    p.add_argument("--keep", type=int, default=2)
+    args = p.parse_args()
+
+    cl = ControlPlaneClient(args.host, args.port, 0,
+                            secret=os.environ.get("BLUEFOG_CP_SECRET", ""),
+                            streams=1)
+    codec = codec_mod.state_codec_for(
+        codec_mod.resolve(args.codec)) if args.codec else None
+    pub = SnapshotPublisher(cl, shards=args.shards, codec=codec,
+                            keep=args.keep)
+    pub._inter_shard_sleep = args.inter_shard_ms / 1e3
+    ver = args.start_ver
+    while True:
+        leaves = [np.full(args.elems, float(ver), np.float32),
+                  np.full(args.elems // 3 + 1, float(ver), np.float32)]
+        pub.publish(leaves, ver, step=ver)
+        print(f"PUB {ver}", flush=True)
+        ver += 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1665,6 +1665,55 @@ def test_repl_shard_rejoin_catches_up(repl_pair):
     r.close()
 
 
+def test_repl_shard_rejoin_on_new_port(repl_pair):
+    """Satellite (r19): a restarted shard may land on a NEW ephemeral
+    port. The rejoiner publishes its endpoint under
+    ``bf.cp.shard_addr.<i>`` (generation-stamped put_max) through its
+    ring successor; routers consult the key before the rejoin re-dial and
+    adopt the moved endpoint — lifting the r16 'must reuse its old
+    host:port' limit for the router plane. State must survive exactly as
+    in the same-port rejoin."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    eps = _endpoints(repl_pair)
+    r = ShardRouter(eps, 0, streams=1)
+    key = next(f"npj.ctr.{j}" for j in range(64)
+               if r.shard_of(f"npj.ctr.{j}") == 1)
+    box = next(f"npj.box.{j}" for j in range(64)
+               if r.shard_of(f"npj.box.{j}") == 1)
+    assert [r.fetch_add(key, 1) for _ in range(10)] == list(range(10))
+    proc, old_port = repl_pair[1]
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    # failover era: counter continues, deposits land on the survivor
+    assert [r.fetch_add(key, 1) for _ in range(5)] == list(range(10, 15))
+    r.append_bytes_many([box] * 2, [b"alpha" * 40, b"beta" * 30])
+    # restart on an EPHEMERAL port; the peer ring still names the OLD
+    # endpoint for shard 1 (exactly what a respawn-anywhere scheduler
+    # hands the new process)
+    nproc, nport = _spawn_shard_repl(1, port=0, rejoin=True)
+    repl_pair[1] = (nproc, nport)
+    ring = ",".join(f"127.0.0.1:{p}"
+                    for p in (repl_pair[0][1], old_port))
+    nproc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+    nproc.stdin.flush()
+    assert nproc.stdout.readline().startswith("BF_SHARD_READY")
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and r.poll_shard_health():
+        time.sleep(0.2)
+    assert r.dead_shards() == set(), \
+        "routers never adopted the published rejoin address"
+    if nport != old_port:  # ephemeral could in principle recycle old_port
+        assert r.endpoints[1] == ("127.0.0.1", nport), \
+            f"endpoint table not re-pointed: {r.endpoints[1]}"
+    # the moved shard serves its keyspace with full state
+    assert [r.fetch_add(key, 1) for _ in range(5)] == list(range(15, 20))
+    drained = [bytes(x) for lst in r.take_bytes_many([box]) for x in lst]
+    assert drained == [b"alpha" * 40, b"beta" * 30], \
+        "failover-era deposits lost across the new-port rejoin"
+    r.close()
+
+
 def test_repl_status_reports_degraded_survivor(repl_pair):
     """After the kill the survivor serves UNREPLICATED (its successor is
     gone): its stats block must say so (repl_status == 2) — the signal

@@ -1,0 +1,332 @@
+"""Serving plane: snapshot wire format, the version fence's never-torn
+property (SIGKILL mid-publish included), hot-swap, and admission control.
+
+The fence contract under test (docs/serving.md): ``bf.serve.ver`` moves
+ONLY after every shard of that version is on the wire, so a reader that
+pulls the fence and then the fence's keys can never stitch two versions
+together — a publisher killed between shard writes leaves the fence at
+the last complete snapshot. The chaos publisher child
+(``_serve_pub_child.py``) makes torn reads DETECTABLE: every element of
+version v equals float(v), so any mix of versions fails an equality
+check.
+"""
+
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.ops import codec as codec_mod
+from bluefog_tpu.runtime import native
+from bluefog_tpu.serving import snapshot as snap
+from bluefog_tpu.serving.client import RequestShed, ServeClient
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native runtime unavailable (no g++?)")
+
+TESTS = Path(__file__).resolve().parent
+PUB_CHILD = TESTS / "_serve_pub_child.py"
+
+
+class FakeKV:
+    """In-memory stand-in for the scalar+bytes KV surface the snapshot
+    protocol uses (wire-free unit tests)."""
+
+    def __init__(self):
+        self.b = {}
+        self.s = {}
+
+    def put_bytes(self, k, v):
+        self.b[k] = bytes(v)
+
+    def get_bytes(self, k):
+        return self.b.get(k, b"")
+
+    def bytes_len(self, k):
+        return len(self.b.get(k, b""))
+
+    def put_bytes_many(self, ks, vs):
+        for k, v in zip(ks, vs):
+            self.put_bytes(k, v)
+
+    def get_bytes_many(self, ks):
+        return [self.get_bytes(k) for k in ks]
+
+    def put(self, k, v):
+        self.s[k] = int(v)
+
+    def get(self, k):
+        return self.s.get(k, 0)
+
+    def put_max(self, k, v):
+        self.s[k] = max(self.s.get(k, 0), int(v))
+        return self.s[k]
+
+    def fetch_add(self, k, d=1):
+        old = self.s.get(k, 0)
+        self.s[k] = old + d
+        return old
+
+
+def _leaves(seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((17, 33)).astype(np.float32),
+            rng.standard_normal((5,)).astype(np.float32),
+            (rng.standard_normal((64, 8)) * 3).astype(np.float32)]
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def test_meta_boundaries_cover_and_balance():
+    m = snap.SnapshotMeta.for_arrays(_leaves(), 4)
+    assert m.boundaries[0] == 0 and m.boundaries[-1] == m.total
+    sizes = np.diff(m.boundaries)
+    assert sizes.min() >= 0 and sizes.max() - sizes.min() <= 1
+    m2 = snap.SnapshotMeta.from_json(m.to_json())
+    assert m2.boundaries == m.boundaries and m2.leaves == m.leaves
+
+
+def test_meta_shards_clamped_to_elements():
+    m = snap.SnapshotMeta([((2,), "float32")], 16)
+    assert m.shards == 2  # never more pull units than elements
+
+
+@pytest.mark.parametrize("spec", [None, "int8", "fp8"])
+@pytest.mark.parametrize("shards", [1, 3, 5])
+def test_shard_roundtrip_codecs(spec, shards):
+    codec = codec_mod.state_codec_for(codec_mod.resolve(spec)) \
+        if spec else None
+    leaves = _leaves(7)
+    cl = FakeKV()
+    pub = snap.SnapshotPublisher(cl, shards=shards, codec=codec)
+    pub.publish(leaves, 3)
+    out, ver, wire = snap.fetch_snapshot(cl)
+    assert ver == 3 and len(out) == len(leaves)
+    tol = 0.0 if spec is None else (0.05 if spec == "int8" else 0.5)
+    for a, b in zip(leaves, out):
+        assert b.shape == a.shape
+        np.testing.assert_allclose(a, b, atol=tol)
+    if spec == "int8":
+        raw = sum(a.nbytes for a in leaves)
+        assert wire < raw / 3  # the compression the bench pins exactly
+
+
+def test_decode_rejects_corruption():
+    leaves = _leaves(1)
+    cl = FakeKV()
+    snap.SnapshotPublisher(cl, shards=2).publish(leaves, 1)
+    meta = snap.fetch_meta(cl)
+    key = snap.SNAP_KEY_FMT.format(ver=1, shard=0)
+    good = cl.get_bytes(key)
+    with pytest.raises(snap.SnapshotGone):
+        snap.decode_shard(b"", meta, 0, 1)          # GC'd slot
+    with pytest.raises(ValueError):
+        snap.decode_shard(b"\x00" * len(good), meta, 0, 1)  # bad magic
+    with pytest.raises(ValueError):
+        snap.decode_shard(good, meta, 1, 1)         # wrong shard slot
+
+
+# ---------------------------------------------------------------------------
+# version fence + GC (wire-free)
+# ---------------------------------------------------------------------------
+
+def test_versions_are_monotone():
+    cl = FakeKV()
+    pub = snap.SnapshotPublisher(cl, shards=2)
+    pub.publish(_leaves(), 5)
+    with pytest.raises(ValueError):
+        pub.publish(_leaves(), 5)
+    with pytest.raises(ValueError):
+        pub.publish(_leaves(), 4)
+    pub.publish(_leaves(), 6)
+    assert snap.current_version(cl) == 6
+
+
+def test_gc_keeps_window_and_moves_floor():
+    cl = FakeKV()
+    pub = snap.SnapshotPublisher(cl, shards=2, keep=2)
+    for v in (1, 2, 3, 4):
+        pub.publish(_leaves(v), v)
+    assert cl.get(snap.GC_FLOOR_KEY) == 3
+    # retained versions still fetch pinned; GC'd ones raise SnapshotGone
+    for v in (3, 4):
+        out, got, _ = snap.fetch_snapshot(cl, ver=v)
+        assert got == v
+    for v in (1, 2):
+        with pytest.raises(snap.SnapshotGone):
+            snap.fetch_snapshot(cl, ver=v)
+
+
+def test_partial_publish_invisible_behind_fence():
+    """The core never-torn property, deterministically: version 2's
+    shards land WITHOUT the fence moving (a publisher dying mid-publish)
+    — readers keep resolving the complete version 1."""
+    cl = FakeKV()
+    pub = snap.SnapshotPublisher(cl, shards=3)
+    one = [np.full(100, 1.0, np.float32)]
+    pub.publish(one, 1)
+    meta = snap.fetch_meta(cl)
+    flat = snap.flatten_leaves([np.full(100, 2.0, np.float32)])
+    # two of three shards of version 2 land; the fence write never comes
+    for s in (0, 1):
+        cl.put_bytes(snap.SNAP_KEY_FMT.format(ver=2, shard=s),
+                     snap.encode_shard(flat, meta, s, 2))
+    out, ver, _ = snap.fetch_snapshot(cl)
+    assert ver == 1
+    np.testing.assert_array_equal(out[0], one[0])
+
+
+def test_fetch_retries_past_gc_race():
+    """A reader holding fence v loses the GC race mid-pull: the pull
+    returns empty slots, fetch re-reads the fence and succeeds at the
+    current version instead of failing."""
+    cl = FakeKV()
+    pub = snap.SnapshotPublisher(cl, shards=2, keep=2)
+    for v in (1, 2, 3):
+        pub.publish([np.full(50, float(v), np.float32)], v)
+    meta = snap.fetch_meta(cl)
+    state = {"first": True}
+
+    def racy_pull(keys):
+        if state["first"]:
+            state["first"] = False
+            return [b""] * len(keys)  # version GC'd under the reader
+        return cl.get_bytes_many(keys)
+
+    out, ver, _ = snap.fetch_snapshot(cl, meta=meta, pull=racy_pull)
+    assert ver == 3
+    np.testing.assert_array_equal(out[0], np.full(50, 3.0, np.float32))
+
+
+def test_read_serve_status_fields():
+    cl = FakeKV()
+    assert snap.read_serve_status(cl) is None  # no serving plane ever
+    pub = snap.SnapshotPublisher(cl, shards=2)
+    pub.publish(_leaves(), 7, step=7)
+    st = snap.read_serve_status(cl)
+    assert st["version"] == 7 and st["pub_step"] == 7
+    assert st["shards"] == 2 and st["publish_lag_s"] < 5.0
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL/churn chaos over a real control plane
+# ---------------------------------------------------------------------------
+
+def _fence_values_consistent(cl):
+    """Fetch at the committed fence; every element must equal the version
+    (how the child makes torn reads detectable)."""
+    got = snap.fetch_snapshot(cl)
+    if got is None:
+        return 0
+    out, ver, _ = got
+    for leaf in out:
+        np.testing.assert_array_equal(
+            leaf, np.full(leaf.shape, float(ver), np.float32),
+            err_msg=f"TORN READ at committed version {ver}")
+    return ver
+
+
+def test_sigkill_mid_publish_never_torn():
+    """Version monotonicity + never-torn reads while the publisher is
+    repeatedly SIGKILLed mid-publish (the inter-shard sleep makes the
+    kill land between a shard write and the fence move with near
+    certainty)."""
+    with native.ControlPlaneServer(world=2) as srv:
+        cl = native.ControlPlaneClient("127.0.0.1", srv.port, rank=0)
+        last_fence = 0
+        next_ver = 1
+        for era in range(4):
+            proc = subprocess.Popen(
+                [sys.executable, str(PUB_CHILD), "--port", str(srv.port),
+                 "--start-ver", str(next_ver), "--shards", "4",
+                 "--inter-shard-ms", "15"],
+                stdout=subprocess.DEVNULL)
+            time.sleep(0.25 + 0.07 * era)  # kill lands mid-publish
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+            fence = _fence_values_consistent(cl)
+            assert fence >= last_fence, \
+                f"fence regressed: {last_fence} -> {fence}"
+            last_fence = fence
+            next_ver = max(fence + 1, next_ver) + 2  # skip the torn slot
+        assert last_fence > 0, "no snapshot ever committed"
+        cl.close()
+
+
+# ---------------------------------------------------------------------------
+# serve client: hot-swap + admission control
+# ---------------------------------------------------------------------------
+
+def test_client_hot_swaps_on_version_bump(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_SERVE_POLL_S", "0.05")
+    with native.ControlPlaneServer(world=2) as srv:
+        pcl = native.ControlPlaneClient("127.0.0.1", srv.port, rank=0)
+        pub = snap.SnapshotPublisher(pcl, shards=3)
+        pub.publish([np.full(200, 1.0, np.float32)], 1)
+        sc = ServeClient([("127.0.0.1", srv.port)],
+                         model_fn=lambda params, xs: xs + params[0][0])
+        try:
+            assert sc.wait_ready(timeout=10), "first snapshot never pulled"
+            assert sc.version() == 1
+            out = sc.infer(np.zeros(3, np.float32), timeout=10)
+            np.testing.assert_array_equal(out, np.ones(3, np.float32))
+            pub.publish([np.full(200, 5.0, np.float32)], 2)
+            deadline = time.monotonic() + 10
+            while sc.version() < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert sc.version() == 2, "client never hot-swapped"
+            out = sc.infer(np.zeros(3, np.float32), timeout=10)
+            np.testing.assert_array_equal(out, np.full(3, 5.0, np.float32))
+            st = sc.stats()
+            assert st["swaps"] >= 2 and st["requests"] == 2
+        finally:
+            sc.close()
+        pcl.close()
+
+
+def test_admission_gate_sheds_at_queue_cap(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_SERVE_POLL_S", "0.05")
+    monkeypatch.setenv("BLUEFOG_SERVE_QUEUE_MAX", "2")
+    monkeypatch.setenv("BLUEFOG_SERVE_QUEUE_SOFT", "1")
+    monkeypatch.setenv("BLUEFOG_SERVE_BATCH", "1")
+    release = threading.Event()
+
+    def slow_model(params, xs):
+        release.wait(timeout=30)
+        return xs
+
+    with native.ControlPlaneServer(world=2) as srv:
+        pcl = native.ControlPlaneClient("127.0.0.1", srv.port, rank=0)
+        snap.SnapshotPublisher(pcl, shards=1).publish(
+            [np.zeros(10, np.float32)], 1)
+        sc = ServeClient([("127.0.0.1", srv.port)], model_fn=slow_model)
+        try:
+            assert sc.wait_ready(timeout=10)
+            futs = []
+            shed = 0
+            # one request parks in the batcher; two fill the queue; the
+            # rest MUST shed (never hang, never grow the queue)
+            for _ in range(8):
+                try:
+                    futs.append(sc.submit(np.zeros(2, np.float32)))
+                except RequestShed as exc:
+                    assert exc.gate == "queue_full"
+                    shed += 1
+                time.sleep(0.02)
+            assert shed >= 1, "queue overflow never shed"
+            assert sc.stats()["shed"] == shed
+            release.set()
+            for f in futs:
+                np.testing.assert_array_equal(
+                    f.result(timeout=10), np.zeros(2, np.float32))
+        finally:
+            release.set()
+            sc.close()
+        pcl.close()
