@@ -84,16 +84,23 @@ serve-smoke:     ## serving-plane acceptance: 2-rank trainer publishing
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
 soak-smoke:      ## durable sharded-control-plane churn soak, quick mode
-                 ## (<= 2 min): 2 WAL-replicated shard server processes,
+                 ## (<= 4 min): WAL-replicated shard server processes,
                  ## ~64 raw clients with incarnation churn, one injected
                  ## SIGKILL — asserts ZERO lost deposit mass, exactly-once
                  ## counters continuous across the failover, health
                  ## convergence, bounded server RSS; then a second pass
                  ## with --rejoin (kill + in-place restart with snapshot
-                 ## catch-up, ring converges back). No JAX anywhere; full
-                 ## mode: scripts/cp_soak.py --clients 5000 --churn --rejoin
+                 ## catch-up, ring converges back); then the quorum
+                 ## (R=3) passes: --kill-pairs SIGKILLs a shard AND its
+                 ## ring successor simultaneously (still zero loss), and
+                 ## --partition arms the deterministic 2|2 network cut
+                 ## (typed QuorumLostError during the window, exact
+                 ## ledgers after heal). No JAX anywhere; full mode:
+                 ## scripts/cp_soak.py --clients 5000 --churn --rejoin
 	python scripts/cp_soak.py --quick
 	python scripts/cp_soak.py --quick --rejoin
+	python scripts/cp_soak.py --quick --kill-pairs
+	python scripts/cp_soak.py --quick --partition
 
 perf-gate:       ## perf regression gate: quick win_microbench +
                  ## opt_matrix_bench medians vs the committed

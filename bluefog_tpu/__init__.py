@@ -57,7 +57,8 @@ from .runtime.heartbeat import (
     shutdown_requested,
     suspect_controllers,
 )
-from .runtime.native import PeerLostError, StaleIncarnationError
+from .runtime.native import (PeerLostError, QuorumLostError,
+                             StaleIncarnationError)
 
 # timeline
 from .runtime.timeline import (

@@ -761,6 +761,7 @@ def _status(args) -> int:
                   "BLUEFOG_METRICS_INTERVAL set on the job?)")
         dead_shards = []
         under_replicated = []
+        below_quorum = []
         if hasattr(cl, "server_stats_all"):
             # sharded plane: merge the per-shard server views; a dead
             # shard is a named row, never a raised probe failure
@@ -773,6 +774,13 @@ def _status(args) -> int:
                     repl = {0: "off", 1: "live", 2: "DEGRADED"}.get(
                         st.get("repl_status", 0), "?")
                     lag = st.get("wal_enqueued", 0) - st.get("wal_acked", 0)
+                    # quorum replication (r20): replicas = this shard's
+                    # copy count (itself + live successor streams);
+                    # quorum=LOST marks a shard serving read-only behind
+                    # the typed QuorumLostError gate
+                    quorum = {0: "n/a", 1: "held", 2: "LOST"}.get(
+                        st.get("quorum_state", 0), "?")
+                    replicas = 1 + int(st.get("repl_targets_live", 0))
                     print(f"    {name}: conns={st['live_connections']} "
                           f"kv={st['kv_entries']} "
                           f"mailbox={st['mailbox_records']} recs/"
@@ -780,11 +788,19 @@ def _status(args) -> int:
                           f"locks={st['locks_held']} "
                           f"stale_rejects={st['stale_rejects']} "
                           f"repl={repl} wal_lag={lag} "
-                          f"wal_dropped={st.get('wal_dropped', 0)}")
+                          f"wal_dropped={st.get('wal_dropped', 0)} "
+                          f"replicas={replicas} quorum={quorum} "
+                          f"quorum_acks={st.get('quorum_acks', 0)} "
+                          f"replica_sources="
+                          f"{st.get('replica_sources', 0)} "
+                          f"partition_rejects="
+                          f"{st.get('partition_rejects', 0)}")
                     if st.get("repl_status", 0) == 2:
                         # successor lagging/absent: this shard is serving
                         # acked writes that live NOWHERE else
                         under_replicated.append(name)
+                    if st.get("quorum_state", 0) == 2:
+                        below_quorum.append(name)
         serve_lines, serve_st = _serve_status_lines(cl)
         for line in serve_lines:
             print(line)
@@ -811,6 +827,17 @@ def _status(args) -> int:
                     "under-replicated control-plane shard(s) (WAL "
                     f"degraded, successor lagging or absent): "
                     f"{under_replicated}")
+            if below_quorum:
+                # an UNHEALED partition shows up exactly here: every
+                # shard the cut isolated from its commit quorum stays in
+                # quorum=LOST until the cut heals (a healed one leaves
+                # only the cp.partitions counter trail, which is history,
+                # not a finding)
+                findings.append(
+                    "control-plane shard(s) below commit quorum — "
+                    "unhealed partition or too many replica deaths "
+                    "(mutating ops rejected with QuorumLostError): "
+                    f"{below_quorum}")
             if findings:
                 for f in findings:
                     print(f"  STRICT: {f}", file=sys.stderr)

@@ -90,6 +90,8 @@ class PeerMonitor:
         self._dead_inc: Dict[int, int] = {}  # incarnation at death time
         self._epoch: int = 0             # membership-epoch mirror
         self._cl = None  # dedicated control-plane connection (see start())
+        self._partition_rejects_seen = 0  # cp.partitions counter baseline
+        self._quorum_lost_last = 0       # edge-detect for timeline instants
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -185,6 +187,8 @@ class PeerMonitor:
         try:
             lag = 0
             under = 0
+            qlost = 0
+            rejects = 0
             for _name, st in cl.server_stats_all():
                 if not st:
                     continue
@@ -192,8 +196,34 @@ class PeerMonitor:
                     lag = max(lag, st["wal_enqueued"] - st["wal_acked"])
                 elif st.get("repl_status") == 2:
                     under += 1
+                # quorum replication (r20): a shard below its commit
+                # quorum is ALIVE (it serves reads) but rejects mutating
+                # ops — the partition-alert gauge routers/operators watch
+                if st.get("quorum_state") == 2:
+                    qlost += 1
+                rejects += int(st.get("partition_rejects", 0))
             _metrics.gauge("cp.repl_lag").set(lag)
             _metrics.gauge("cp.under_replicated").set(under)
+            _metrics.gauge("cp.quorum_lost").set(qlost)
+            prev = self._partition_rejects_seen
+            if rejects > prev:
+                # counter trail + one flight instant per NEW episode (the
+                # first rejected op after a clean interval), so postmortem
+                # dumps pin when the cut engaged
+                _metrics.counter("cp.partitions").inc(rejects - prev)
+                if prev == 0:
+                    try:
+                        from . import flight as _flight
+
+                        _flight.recorder().instant("cp.partition",
+                                                   a=float(rejects))
+                    except Exception:  # noqa: BLE001 — telemetry only
+                        pass
+                self._partition_rejects_seen = rejects
+            if qlost != self._quorum_lost_last:
+                for_ = "LOST" if qlost else "RESTORED"
+                timeline_instant("cp.quorum", f"QUORUM_{for_}")
+                self._quorum_lost_last = qlost
         except (OSError, RuntimeError):
             pass  # stats probe must never break the heartbeat cadence
 

@@ -474,6 +474,12 @@ _HELP_EXACT: Dict[str, str] = {
                    "shards (replication lag)",
     "cp.under_replicated": "shards serving DEGRADED (successor lagging "
                            "or absent — acked writes live nowhere else)",
+    "cp.quorum_lost": "shards below their commit quorum (alive, serving "
+                      "reads, rejecting mutating ops with "
+                      "QuorumLostError)",
+    "cp.partitions": "mutating control-plane ops rejected below quorum "
+                     "(grows while a partition or correlated replica "
+                     "loss is in effect)",
     "pushsum.mass": "this rank's share of global push-sum de-bias mass",
     "pushsum.minted": "push-sum mass minted (created, not transferred) by "
                       "this rank",

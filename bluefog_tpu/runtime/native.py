@@ -88,11 +88,30 @@ class StaleIncarnationError(RuntimeError):
     """
 
 
+class QuorumLostError(RuntimeError):
+    """A mutating control-plane op was rejected: the shard is below its
+    commit quorum (r20 quorum replication, ``BLUEFOG_CP_REPLICATION>=3``).
+
+    The serving shard cannot reach ack-from-⌈R/2⌉ of its replica set —
+    it is on the minority side of a network partition (or too many
+    replicas died at once). Rather than silently applying the write
+    locally and minting split-brain state, the server degrades to
+    READ-ONLY: reads still serve, every mutation gets this typed
+    rejection. The condition clears when the partition heals (or enough
+    replicas return); callers that can wait should back off and retry,
+    callers that cannot should surface the error. Never raised at R<=2
+    (the legacy chain degrades to unreplicated instead; see
+    docs/fault_tolerance.md, "Partitions & quorum").
+    """
+
+
 # Status codes shared with csrc/bf_runtime.cc: -1 wire failure, -2 mailbox
 # byte cap, -3 dead holder / deadline on a blocking primitive, -4 stale
-# incarnation (fenced zombie).
+# incarnation (fenced zombie), -5 below commit quorum (partition-aware
+# read-only degrade; typed as QuorumLostError).
 _DEAD_HOLDER = -3
 _STALE = -4
+_QUORUM_LOST = -5
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -264,6 +283,33 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
                                        ctypes.c_int]
     lib.bf_cp_failed_over.restype = ctypes.c_int
     lib.bf_cp_failed_over.argtypes = [ctypes.c_void_p]
+    # Quorum replication + partition injector (r20)
+    lib.bf_cp_server_set_successors.restype = ctypes.c_int
+    lib.bf_cp_server_set_successors.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.bf_cp_server_load_snapshot2.restype = ctypes.c_longlong
+    lib.bf_cp_server_load_snapshot2.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int]
+    lib.bf_cp_server_reset_store.restype = None
+    lib.bf_cp_server_reset_store.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_server_rejoin_done.restype = None
+    lib.bf_cp_server_rejoin_done.argtypes = [ctypes.c_void_p]
+    lib.bf_cp_set_failover2.restype = None
+    lib.bf_cp_set_failover2.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.bf_cp_client_set_group.restype = None
+    lib.bf_cp_client_set_group.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.bf_cp_partition.restype = None
+    lib.bf_cp_partition.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                    ctypes.c_double, ctypes.c_double]
+    lib.bf_cp_partition_heal.restype = None
+    lib.bf_cp_partition_heal.argtypes = []
+    lib.bf_cp_partition_disarm.restype = None
+    lib.bf_cp_partition_disarm.argtypes = []
+    lib.bf_cp_partition_active.restype = ctypes.c_int
+    lib.bf_cp_partition_active.argtypes = []
+    lib.bf_cp_partition_cuts.restype = ctypes.c_longlong
+    lib.bf_cp_partition_cuts.argtypes = []
     return lib
 
 
@@ -286,6 +332,21 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
 #                  deposit site (ops/windows.py), not inside the native
 #                  client; terms after the first may ride further commas
 #                  or ``;`` / ``|`` separators.
+#   partition=0,1|2,3
+#                  deterministic network partition (ISSUE r20): SHARD
+#                  indices grouped into sides by ``|`` (bare numeric terms
+#                  after the first ride the comma-separated spec). Connects
+#                  and in-flight ops crossing the cut fail at the client
+#                  socket layer, both directions; shards that lose their
+#                  commit quorum degrade to read-only (QuorumLostError).
+#                  The shard-index spec is resolved to listener ports and
+#                  armed by the process that knows the port map
+#                  (shard_server / cp_soak) via :func:`partition_arm`.
+#   part_after=S   the cut activates S seconds after arming (float; 0 =
+#                  immediately) — lets a soak arm it pre-fork and have it
+#                  fire mid-run.
+#   heal_after=S   the cut heals itself S seconds after activation
+#                  (float; 0 = only on an explicit heal/disarm).
 #
 # OFF unless BLUEFOG_CP_FAULT is set (or a test arms it explicitly): the
 # production path pays one relaxed atomic load per op, nothing else — the
@@ -309,9 +370,38 @@ def _parse_edge_delays(text: str) -> dict:
     return out
 
 
+def parse_partition_groups(text: str) -> list:
+    """``"0,1|2,3"`` -> ``[[0, 1], [2, 3]]`` (shard-index sides)."""
+    groups = []
+    for side in str(text).split("|"):
+        side = side.strip()
+        if not side:
+            continue
+        try:
+            groups.append(sorted({int(t) for t in side.split(",")
+                                  if t.strip()}))
+        except ValueError:
+            raise ValueError(
+                f"BLUEFOG_CP_FAULT: bad partition side {side!r} "
+                "(grammar: partition=0,1|2,3)")
+    if len(groups) < 2:
+        raise ValueError(
+            "BLUEFOG_CP_FAULT: partition= needs at least two '|'-separated "
+            "sides (grammar: partition=0,1|2,3)")
+    seen: set = set()
+    for g in groups:
+        if seen.intersection(g):
+            raise ValueError(
+                "BLUEFOG_CP_FAULT: partition sides must be disjoint")
+        seen.update(g)
+    return groups
+
+
 def parse_fault_spec(spec: str) -> dict:
     out = {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0,
-           "delay_edges": {}}
+           "delay_edges": {}, "partition": None, "part_after": 0.0,
+           "heal_after": 0.0}
+    part_raw = None
     for item in (spec or "").split(","):
         item = item.strip()
         if not item:
@@ -325,12 +415,26 @@ def parse_fault_spec(spec: str) -> dict:
             # continuation of a comma-separated delay_edges list
             out["delay_edges"].update(_parse_edge_delays(item))
             continue
-        if not sep or key not in out or key == "delay_edges":
+        if sep and key == "partition":
+            part_raw = val.strip()
+            continue
+        if not sep and part_raw is not None and \
+                item.replace("|", "").replace(" ", "").isdigit():
+            # continuation of the comma-separated partition group spec
+            part_raw += "," + item
+            continue
+        if not sep or key not in out or key in ("delay_edges", "partition"):
             raise ValueError(
                 f"BLUEFOG_CP_FAULT: bad entry {item!r} (grammar: "
                 "drop_after=N,delay_ms=M,trunc=0|1,seed=S,"
-                "delay_edges=src>dst:ms,...)")
-        out[key] = int(val.strip())
+                "delay_edges=src>dst:ms,...,partition=0,1|2,3,"
+                "part_after=S,heal_after=S)")
+        if key in ("part_after", "heal_after"):
+            out[key] = float(val.strip())
+        else:
+            out[key] = int(val.strip())
+    if part_raw is not None:
+        out["partition"] = parse_partition_groups(part_raw)
     return out
 
 
@@ -394,6 +498,57 @@ def fault_stats() -> dict:
         return {"ops": 0, "drops": 0}
     return {"ops": int(lib.bf_cp_fault_ops()),
             "drops": int(lib.bf_cp_fault_drops())}
+
+
+# -- deterministic partition injector (r20 quorum durability) -----------------
+
+def partition_arm(port_groups: dict, self_group: int = -1,
+                  start_after: float = 0.0, heal_after: float = 0.0) -> None:
+    """Arm the native partition injector for THIS process.
+
+    ``port_groups`` maps control-plane LISTENER ports to sides (the
+    caller — shard_server, cp_soak, a test — resolves the shard-index
+    spec from ``parse_fault_spec``'s ``partition`` field to ports, since
+    only it knows the port map). ``self_group`` places this process's
+    ordinary clients on a side (-1 = ungrouped: only server-side quorum
+    gates and group-bound replicator streams enforce the cut). The cut
+    activates ``start_after`` seconds from now and heals itself
+    ``heal_after`` seconds after activation (0 = never / explicit only).
+    """
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    spec = ",".join(f"{int(p)}:{int(g)}" for p, g in
+                    sorted(port_groups.items()))
+    lib.bf_cp_partition(int(self_group), spec.encode(),
+                        float(start_after), float(heal_after))
+
+
+def partition_heal() -> None:
+    """Heal the armed cut now (idempotent; the cut counter survives)."""
+    lib = load()
+    if lib is not None:
+        lib.bf_cp_partition_heal()
+
+
+def partition_disarm() -> None:
+    """Fully disarm the injector (port map cleared)."""
+    lib = load()
+    if lib is not None:
+        lib.bf_cp_partition_disarm()
+
+
+def partition_active() -> bool:
+    """True while an armed cut is live (post-start, pre-heal)."""
+    lib = load()
+    return bool(lib is not None and lib.bf_cp_partition_active())
+
+
+def partition_cuts() -> int:
+    """Connects/ops this process failed at the injected cut since arming
+    (feeds the ``cp.partitions`` counter trail)."""
+    lib = load()
+    return 0 if lib is None else int(lib.bf_cp_partition_cuts())
 
 
 # Op-class names for the telemetry counter block: _OP_NAMES (imported
@@ -661,16 +816,20 @@ class _MultiReply:
         return False
 
 
-_SRV_STAT_SLOTS = 48  # 32 per-op counts + 16 aggregates (csrc layout)
+_SRV_STAT_SLOTS = 53  # 32 per-op counts + 21 aggregates (csrc layout)
 
 
 def _server_stats_dict(buf) -> dict:
-    """Decode the 48-slot server counter block (one layout, two transports:
+    """Decode the 53-slot server counter block (one layout, two transports:
     the in-process bf_cp_server_counters read and the kStats wire op).
     Slots 43-47 are the WAL-replication view: ``repl_status`` is 0 when no
     successor is configured, 1 while the chain commit is live, 2 when the
     shard DEGRADED to unreplicated (`bfrun --status --strict` reports 2 as
-    an under-replicated finding)."""
+    an under-replicated finding). Slots 48-52 are the r20 quorum view:
+    ``quorum_state`` is 0 when not in quorum mode (R<=2), 1 while the
+    commit quorum holds, 2 while the shard is below quorum (read-only —
+    also a --strict finding); ``replica_sources`` counts distinct incoming
+    WAL streams, ``repl_targets_live`` live outgoing ones."""
     ops = {name: int(buf[code]) for code, name in _OP_NAMES.items()
            if buf[code]}
     return {
@@ -691,6 +850,11 @@ def _server_stats_dict(buf) -> dict:
         "wal_dropped": int(buf[45]),
         "repl_status": int(buf[46]),
         "repl_applied": int(buf[47]),
+        "quorum_acks": int(buf[48]),
+        "partition_rejects": int(buf[49]),
+        "replica_sources": int(buf[50]),
+        "quorum_state": int(buf[51]),
+        "repl_targets_live": int(buf[52]),
     }
 
 
@@ -754,6 +918,37 @@ class ControlPlaneServer:
                 int(idx)) < 0:
             raise RuntimeError("replication successor already configured")
 
+    def set_successors(self, targets, nshards: int = 0,
+                       idx: int = -1) -> None:
+        """Quorum generalization of :meth:`set_successor` (R >= 3):
+        ``targets`` is a list of ``(shard_idx, host, port)`` naming this
+        server's R-1 ring successors. One target degenerates to the legacy
+        chain (same thread, same wire — R=2 stays byte-identical); two or
+        more arm quorum mode: a dedicated WAL stream per target and the
+        ack-from-⌈R/2⌉ commit rule. One-shot per server."""
+        spec = ";".join(f"{int(i)}:{h}:{int(p)}" for i, h, p in targets)
+        r = self._lib.bf_cp_server_set_successors(
+            self._h, spec.encode(), int(nshards), int(idx))
+        if r == -2:
+            raise ValueError(f"malformed successor spec {spec!r}")
+        if r < 0:
+            raise RuntimeError("replication successors already configured")
+
+    def reset_store(self) -> None:
+        """Drop the whole store and re-arm the rejoin gate — the guarded
+        in-place self-rejoin a shard runs after surviving on the minority
+        side of a healed partition: local state may have diverged from
+        the quorum, so it rebuilds from replica snapshots like a
+        restarted process would, without losing its listener."""
+        self._lib.bf_cp_server_reset_store(self._h)
+
+    def rejoin_done(self) -> None:
+        """Reopen the rejoin gate after an in-place self-rejoin
+        (:meth:`reset_store` + snapshot catch-up): the successor streams
+        of a living process are already armed, so the legacy gate-open
+        path (``set_successor``, one-shot) never runs again."""
+        self._lib.bf_cp_server_rejoin_done(self._h)
+
     def set_rejoin_pending(self) -> None:
         """Arm the rejoin gate BEFORE pulling a snapshot: incoming WAL
         records park until :meth:`load_snapshot` (with ``set_fence``)
@@ -762,7 +957,7 @@ class ControlPlaneServer:
         self._lib.bf_cp_server_set_rejoin_pending(self._h)
 
     def load_snapshot(self, blob: bytes, set_fence: bool = True,
-                      adopt_wal: bool = False) -> int:
+                      adopt_wal: bool = False, src_idx: int = -2) -> int:
         """Apply a snapshot blob pulled from a peer shard (rejoin
         catch-up); returns the record count applied. ``set_fence`` adopts
         the blob's WAL fence so the predecessor's resumed stream skips
@@ -773,10 +968,13 @@ class ControlPlaneServer:
         it only for a blob served by the ring SUCCESSOR (our stream's
         receiver); restarting at zero would leave every post-rejoin
         record at or below the receiver's stale fence, silently
-        dropped-and-acked."""
-        r = int(self._lib.bf_cp_server_load_snapshot(
+        dropped-and-acked. ``src_idx`` names WHICH incoming stream the
+        fence belongs to under quorum replication — the serving shard's
+        ring index (its stream frames carry rank -(100+src_idx)); the
+        default -2 is the legacy chain stream."""
+        r = int(self._lib.bf_cp_server_load_snapshot2(
             self._h, blob, len(blob), 1 if set_fence else 0,
-            1 if adopt_wal else 0))
+            1 if adopt_wal else 0, int(src_idx)))
         if r < 0:
             raise RuntimeError("malformed control-plane snapshot blob")
         return r
@@ -900,6 +1098,21 @@ class ControlPlaneClient:
         if r == _STALE and self._any_stale():
             raise StaleIncarnationError(self._stale_message())
 
+    def _check_quorum(self, r, what: str) -> None:
+        """Raise typed when a -5 status is the server's below-quorum
+        rejection. Only MUTATING ops are gated server-side, so -5 from
+        one of them is unambiguous (reads — which could legitimately
+        return a stored -5 — are never gated and never checked)."""
+        if r == _QUORUM_LOST:
+            host, port, _rank, _ = self._conn
+            raise QuorumLostError(
+                f"{what}: shard at {host}:{port} is below its commit "
+                "quorum (minority side of a partition, or too many "
+                "replicas down) and has degraded to READ-ONLY; the "
+                "mutation was NOT applied. Retry after the partition "
+                "heals — see docs/fault_tolerance.md, 'Partitions & "
+                "quorum'.")
+
     def _wire_error(self, message: str):
         """Map a failed native call to the right exception: typed fence
         verdict when the connection was superseded, plain OSError else."""
@@ -960,6 +1173,7 @@ class ControlPlaneClient:
     def lock(self, name: str) -> None:
         r = self._lib.bf_cp_lock(self._h, name.encode())
         self._check_stale(r)
+        self._check_quorum(r, f"lock '{name}'")
         if r == _DEAD_HOLDER:
             # the lock was left FREE: after handling the error a fresh
             # acquire succeeds — see docs/fault_tolerance.md
@@ -974,6 +1188,7 @@ class ControlPlaneClient:
     def unlock(self, name: str) -> None:
         r = self._lib.bf_cp_unlock(self._h, name.encode())
         self._check_stale(r)
+        self._check_quorum(r, f"unlock '{name}'")
         if r == _DEAD_HOLDER:
             raise _peer_lost(
                 f"unlock '{name}': this client no longer held the lock — "
@@ -988,11 +1203,13 @@ class ControlPlaneClient:
         (MPI_Fetch_and_op semantics, mpi_controller.cc:1532-1602)."""
         r = self._lib.bf_cp_fetch_add(self._h, name.encode(), delta)
         self._check_stale(r)
+        self._check_quorum(r, f"fetch_add '{name}'")
         return r
 
     def put(self, name: str, value: int) -> None:
         r = self._lib.bf_cp_put(self._h, name.encode(), value)
         self._check_stale(r)
+        self._check_quorum(r, f"put '{name}'")
         if r < 0:
             raise OSError("control plane put failed (connection lost "
                           "or not authenticated)")
@@ -1008,6 +1225,7 @@ class ControlPlaneClient:
         it (lost reply, failover re-send) can never regress the value."""
         r = self._lib.bf_cp_put_max(self._h, name.encode(), value)
         self._check_stale(r)
+        self._check_quorum(r, f"put_max '{name}'")
         return r
 
     def set_failover(self, host: str, port: int) -> None:
@@ -1019,9 +1237,27 @@ class ControlPlaneClient:
         of double-applying (exactly-once across failover)."""
         self._lib.bf_cp_set_failover(self._h, host.encode(), int(port))
 
+    def set_failover_chain(self, targets) -> None:
+        """Multi-hop generalization (quorum replication, R >= 3):
+        ``targets`` is a list of ``(host, port)`` ring successors in walk
+        order. Reconnect advances past runs of consecutive dead shards,
+        sticky on the first entry that answers — the re-sent request
+        keeps its (cid, seq) identity, so whichever replica it lands on
+        replays the WAL-recorded reply (exactly-once past R-1 deaths)."""
+        spec = ",".join(f"{h}:{int(p)}" for h, p in targets)
+        self._lib.bf_cp_set_failover2(self._h, spec.encode())
+
+    def set_group(self, group: int) -> None:
+        """Bind this client to a partition-injector side, overriding the
+        process default (in-process multi-server tests and the soak's
+        worker pool place each client on its shard's side)."""
+        self._lib.bf_cp_client_set_group(self._h, int(group))
+
     def failed_over(self) -> bool:
-        """True once this client permanently redirected to its failover
-        target (lock-free read — safe next to a blocked op)."""
+        """True once this client permanently redirected past its primary
+        (lock-free read — safe next to a blocked op). Under a failover
+        CHAIN the underlying native value is the 1-based chain index the
+        client stuck to; bool-ness is preserved."""
         return bool(self._lib.bf_cp_failed_over(self._h))
 
     def snapshot(self, filter_shards: int = 0, filter_idx: int = 0,
@@ -1085,9 +1321,12 @@ class ControlPlaneClient:
             return
         n = len(names)
         args = (ctypes.c_int64 * n)(*[int(v) for v in values])
+        out = (ctypes.c_int64 * n)()
         if self._lib.bf_cp_multi(self._h, OP_CODES["put"], "\n".join(names).encode(),
-                                 args, None, n) < 0:
+                                 args, out, n) < 0:
             self._wire_error("control plane put_many failed")
+        if _QUORUM_LOST in out:
+            self._check_quorum(_QUORUM_LOST, "put_many")
 
     def fetch_add_many(self, names, deltas=None) -> list:
         """Batched fetch_add (default delta 1 each): pre-add values, one
@@ -1102,7 +1341,10 @@ class ControlPlaneClient:
         if self._lib.bf_cp_multi(self._h, OP_CODES["fetch_add"], "\n".join(names).encode(),
                                  args, out, n) < 0:
             self._wire_error("control plane fetch_add_many failed")
-        return list(out)
+        out = list(out)
+        if _QUORUM_LOST in out:
+            self._check_quorum(_QUORUM_LOST, "fetch_add_many")
+        return out
 
     # -- bulk bytes: the host tensor transport for one-sided windows --------
 
@@ -1125,6 +1367,7 @@ class ControlPlaneClient:
         r = self._lib.bf_cp_append_bytes(self._h, name.encode(), data,
                                          len(data))
         self._check_stale(r)
+        self._check_quorum(r, f"append_bytes '{name}'")
         if r == -2:
             raise RuntimeError(
                 f"control plane mailbox '{name}' is full (server byte cap, "
@@ -1142,6 +1385,7 @@ class ControlPlaneClient:
         r = self._lib.bf_cp_take_bytes(self._h, name.encode(),
                                        ctypes.byref(out),
                                        ctypes.byref(out_len))
+        self._check_quorum(r, f"take_bytes '{name}'")
         if r < 0:
             self._wire_error("control plane take_bytes failed")
         try:
@@ -1220,12 +1464,17 @@ class ControlPlaneClient:
             r = self._lib.bf_cp_bytes_multi_outv_tagged(
                 handle, op, "\n".join(names).encode(), ptrs, lens,
                 tag_arr, out, n)
+        self._check_quorum(r, "bytes batch")
         if r < 0:
             self._wire_error("control plane bytes batch failed (connection "
                              "lost or not authenticated)")
         out = list(out)
         if _STALE in out:
             self._check_stale(_STALE)
+        if _QUORUM_LOST in out:
+            # a below-quorum server rejects EVERY entry of a gated batch,
+            # so one -5 entry means the whole mutation batch was refused
+            self._check_quorum(_QUORUM_LOST, "bytes batch")
         return out
 
     def _bytes_multi_in_raw(self, op: int, names,
@@ -1235,10 +1484,12 @@ class ControlPlaneClient:
         n = len(names)
         out = ctypes.c_void_p()
         out_len = ctypes.c_int64()
-        if self._lib.bf_cp_bytes_multi_in(
+        r = self._lib.bf_cp_bytes_multi_in(
                 self._h if handle is None else handle, op,
                 "\n".join(names).encode(), n,
-                ctypes.byref(out), ctypes.byref(out_len)) < 0:
+                ctypes.byref(out), ctypes.byref(out_len))
+        self._check_quorum(r, "bulk drain")  # take_bytes batches are gated
+        if r < 0:
             self._wire_error("control plane bytes batch failed (connection "
                              "lost or not authenticated)")
         return NativeReply(self._lib, out, out_len.value)
@@ -1336,6 +1587,7 @@ class ControlPlaneClient:
                     [blobs[i] for i in small_idx]):
                 if r < 0:
                     self._check_stale(r)
+                    self._check_quorum(r, "put_bytes_many")
                     raise OSError("control plane put_bytes_many failed")
 
     def _put_bytes_striped(self, name: str, blob) -> None:
@@ -1362,6 +1614,7 @@ class ControlPlaneClient:
         r = self._lib.bf_cp_put_bytes_striped(arr, nh, name.encode(),
                                               ptr, nbytes)
         del keep
+        self._check_quorum(r, f"striped put_bytes '{name}'")
         if r < 0:
             self._wire_error("control plane striped put_bytes failed "
                              "(connection lost or not authenticated)")
@@ -1467,8 +1720,10 @@ class ControlPlaneClient:
         if self.streams > 1 and _blob_len(data) >= self._stripe_min:
             return self._put_bytes_striped(name, data)
         self._check_payload("put_bytes", data)
-        if self._lib.bf_cp_put_bytes(self._h, name.encode(), data,
-                                     len(data)) < 0:
+        r = self._lib.bf_cp_put_bytes(self._h, name.encode(), data,
+                                      len(data))
+        self._check_quorum(r, f"put_bytes '{name}'")
+        if r < 0:
             self._wire_error("control plane put_bytes failed")
 
     def bytes_len(self, name: str) -> int:

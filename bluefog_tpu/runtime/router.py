@@ -57,9 +57,10 @@ import struct
 import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from .config import knob_env
 from .logging import logger
 from .native import (ControlPlaneClient, PeerLostError,  # noqa: F401
-                     StaleIncarnationError, _MultiReply)
+                     QuorumLostError, StaleIncarnationError, _MultiReply)
 
 # Scalar key families replicated on every shard (writes via put_max
 # fan-out, reads as max over live shards). All are monotone by protocol:
@@ -269,17 +270,28 @@ class ShardRouter:
 
     def _dial(self, idx: int) -> ControlPlaneClient:
         """A fresh connection to shard ``idx``, armed with its ring
-        successor as the native failover-redirect target (N > 1): an op
-        in flight when the shard dies redials the successor on the SAME
-        client — preserving the kSeqPre identity the successor's
-        WAL-primed dedup table replays against."""
+        successor(s) as the native failover-redirect targets (N > 1): an
+        op in flight when the shard dies redials the successor on the
+        SAME client — preserving the kSeqPre identity the successor's
+        WAL-primed dedup table replays against. At quorum replication
+        (R >= 3) the redirect is a CHAIN of the R-1 ring successors in
+        walk order, so a run of consecutive dead shards (up to R-1 of
+        them — a shard AND its successor dying together) still lands on
+        a replica holding the keyspace."""
         host, port = self._st.endpoints[idx]
         cl = ControlPlaneClient(host, port, self._rank, secret=self._secret,
                                 streams=self._streams,
                                 incarnation=self.incarnation)
         n = len(self._st.endpoints)
         if n > 1:
-            cl.set_failover(*self._st.endpoints[(idx + 1) % n])
+            r = int(knob_env("BLUEFOG_CP_REPLICATION"))
+            hops = min(r - 1, n - 1) if r >= 3 else 1
+            if hops > 1:
+                cl.set_failover_chain(
+                    [self._st.endpoints[(idx + k) % n]
+                     for k in range(1, hops + 1)])
+            else:  # R <= 2: the r16 wire, single-successor redirect
+                cl.set_failover(*self._st.endpoints[(idx + 1) % n])
         return cl
 
     # -- topology ----------------------------------------------------------
@@ -594,17 +606,30 @@ class ShardRouter:
     # non-negative by protocol, so a -1 there IS the wire failure).
 
     def _repl_write(self, key: str, value: int) -> None:
-        """Fan a monotone write to every live shard (>= 1 must ack)."""
+        """Fan a monotone write to every live shard (>= 1 must ack).
+
+        A shard that answers with the quorum-lost rejection is ALIVE but
+        on the minority side of a partition — skipping it (never marking
+        it dead: its keyspace must not fail over while the process
+        serves reads) keeps membership writes flowing through the
+        majority side. Only when EVERY live shard is below quorum does
+        the typed error propagate — the writer itself is then on the
+        minority side."""
         ok = 0
+        qlost: Optional[QuorumLostError] = None
         for idx in self._live():
             try:
                 if self._clients[idx].put_max(key, int(value)) < 0:
                     raise OSError(
                         f"shard {idx}: put_max wire failure")
                 ok += 1
+            except QuorumLostError as exc:
+                qlost = exc
             except OSError as exc:
                 self._mark_dead(idx, exc)
         if not ok:
+            if qlost is not None:
+                raise qlost
             raise OSError(f"replicated write of {key!r}: no live shard")
 
     def _repl_read(self, key: str) -> int:
@@ -649,17 +674,24 @@ class ShardRouter:
     def fetch_add(self, name: str, delta: int = 1) -> int:
         if is_replicated_key(name):
             # every live copy advances; the max pre-value preserves the
-            # only contract consumers rely on (monotone, moves on change)
+            # only contract consumers rely on (monotone, moves on change).
+            # Quorum-lost shards are skipped alive (see _repl_write).
             pre: Optional[int] = None
+            qlost: Optional[QuorumLostError] = None
             for idx in self._live():
                 try:
                     v = int(self._clients[idx].fetch_add_many(
                         [name], deltas=[delta])[0])
+                except QuorumLostError as exc:
+                    qlost = exc
+                    continue
                 except OSError as exc:
                     self._mark_dead(idx, exc)
                     continue
                 pre = v if pre is None else max(pre, v)
             if pre is None:
+                if qlost is not None:
+                    raise qlost
                 raise OSError(f"replicated fetch_add of {name!r}: no live "
                               "shard")
             return pre

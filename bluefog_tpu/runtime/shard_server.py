@@ -127,6 +127,58 @@ def _parse_peers(spec: str):
     return out
 
 
+def _successor_count(nshards: int) -> int:
+    """How many ring successors this shard streams its WAL to.
+
+    ``BLUEFOG_CP_REPLICATION`` counts COPIES: 0 disables replication,
+    1 is the legacy on-switch (aliases the r16 two-copy chain), R >= 2
+    keeps R copies of every record — the primary plus min(R, nshards)-1
+    successor streams. R=2 therefore stays byte-identical to the r16
+    wire; R >= 3 arms quorum mode (commit = ack-from-majority)."""
+    r = int(knob_env("BLUEFOG_CP_REPLICATION"))
+    if r <= 0 or nshards < 2:
+        return 0
+    copies = 2 if r == 1 else min(r, nshards)
+    return copies - 1
+
+
+def _arm_partition_from_env(peers, shard_idx: int) -> None:
+    """Arm the deterministic partition injector from ``BLUEFOG_CP_FAULT``
+    (``partition=0,1|2,3[,part_after=S][,heal_after=S]``). The grammar
+    names SHARD INDICES; only here — where the peer list pins each index
+    to a port — can they resolve to the port->group map the native cut
+    enforces at the client socket layer. The server's own replicator and
+    rejoin clients inherit this side of the cut, so a minority shard
+    loses its commit quorum exactly as a real switch split would."""
+    from . import native as _native
+
+    try:
+        spec = _native.parse_fault_spec(
+            os.environ.get("BLUEFOG_CP_FAULT", ""))
+    except ValueError as exc:
+        logger.warning("shard %d: bad BLUEFOG_CP_FAULT partition spec "
+                       "(%s); injector not armed", shard_idx, exc)
+        return
+    groups = spec.get("partition")
+    if not groups:
+        return
+    port_groups = {}
+    self_group = -1
+    for g, members in enumerate(groups):
+        for m in members:
+            if 0 <= m < len(peers):
+                port_groups[peers[m][1]] = g
+            if m == shard_idx:
+                self_group = g
+    _native.partition_arm(port_groups, self_group,
+                          start_after=float(spec.get("part_after", 0.0)),
+                          heal_after=float(spec.get("heal_after", 0.0)))
+    logger.warning("shard %d: partition injector armed (side %d of %s, "
+                   "part_after=%.3gs heal_after=%.3gs)", shard_idx,
+                   self_group, groups, spec.get("part_after", 0.0),
+                   spec.get("heal_after", 0.0))
+
+
 def _published_addr(peers, idx: int, secret: str, skip: int = -1):
     """Best-effort: shard ``idx``'s CURRENT endpoint per the replicated
     ``bf.cp.shard_addr.<idx>`` key (None when never moved / no peer
@@ -153,7 +205,8 @@ def _published_addr(peers, idx: int, secret: str, skip: int = -1):
     return (dec[1], dec[2]) if dec else None
 
 
-def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
+def _rejoin_catch_up(srv, idx: int, peers, secret: str,
+                     nt: int = 1) -> None:
     """Restarted-shard catch-up, two pulls with distinct roles:
 
     1. From the ring SUCCESSOR — this shard's own keyspace, which the
@@ -171,14 +224,81 @@ def _rejoin_catch_up(srv, idx: int, peers, secret: str) -> None:
 
     For a two-shard ring both roles are the same endpoint, so one
     unfiltered receiver-flagged pull carries everything at a single cut
-    (two filtered pulls would open a gap between their cuts)."""
+    (two filtered pulls would open a gap between their cuts).
+
+    Quorum mode (``nt`` >= 2 successor streams) generalizes both roles:
+    the own-keyspace pull works from ANY surviving replica — every live
+    successor is probed and the copy whose resume fence is NEWEST wins
+    (taking the max is the gap check: resuming below a survivor's fence
+    would leave post-rejoin records silently dropped-and-acked there) —
+    and the replica role covers each of the nt ring PREDECESSORS with
+    its own receiver-flagged pull (per-source fences, per-source
+    re-arm). Dead predecessors are skipped: their streams restart fresh
+    when they themselves rejoin, and this shard's per-source fence
+    dedups the overlap."""
     n = len(peers)
     succ = (idx + 1) % n
     pred = (idx - 1) % n
     deadline = time.monotonic() + float(knob_env("BLUEFOG_CP_REJOIN_TIMEOUT"))
     last = None
+    # quorum-mode pulls identify this shard to the serving peer via the
+    # frame rank -(100+idx): the peer picks the resume fence of THIS
+    # shard's stream and re-arms exactly this receiver's target stream
+    snap_rank = -(100 + idx) if nt >= 2 else 0
+
+    def _dial_peer(j):
+        h, p = _published_addr(peers, j, secret, skip=idx) or peers[j]
+        return ControlPlaneClient(h, p, snap_rank, secret=secret, streams=1)
+
     while True:
         try:
+            if nt >= 2:
+                import struct as _struct
+
+                best_blob, best_resume, best_src = None, -1, -1
+                for k in range(1, nt + 1):
+                    s = (idx + k) % n
+                    try:
+                        cl = _dial_peer(s)
+                    except (OSError, RuntimeError):
+                        continue
+                    try:
+                        blob = cl.snapshot(n, idx)
+                    finally:
+                        cl.close()
+                    if len(blob) < 16:
+                        continue
+                    resume = _struct.unpack("<Q", blob[8:16])[0]
+                    if resume > best_resume or best_blob is None:
+                        best_blob, best_resume, best_src = blob, resume, s
+                if best_blob is None:
+                    raise OSError(
+                        f"no surviving replica of shard {idx}'s keyspace "
+                        f"answered (probed {nt} ring successors)")
+                srv.load_snapshot(best_blob, set_fence=False,
+                                  adopt_wal=True, src_idx=idx)
+                rearmed = []
+                for k in range(1, nt + 1):
+                    p_idx = (idx - k) % n
+                    if p_idx == idx:
+                        continue
+                    try:
+                        pcl = _dial_peer(p_idx)
+                    except (OSError, RuntimeError):
+                        continue  # dead predecessor: see docstring
+                    try:
+                        srv.load_snapshot(
+                            pcl.snapshot(n, p_idx, rearm=True),
+                            set_fence=True, src_idx=p_idx)
+                        rearmed.append(p_idx)
+                    finally:
+                        pcl.close()
+                logger.warning(
+                    "shard %d: quorum rejoin catch-up complete (own "
+                    "keyspace from shard %d at fence %d; re-armed "
+                    "predecessor streams %s)", idx, best_src, best_resume,
+                    rearmed or "none")
+                return
             # a ring peer may itself have moved in an earlier rejoin; its
             # published address supersedes the static peer list
             host, port = _published_addr(peers, succ, secret, skip=idx) \
@@ -258,24 +378,39 @@ def main(argv=None) -> int:
             srv.stop()
             return 2
         peers = _parse_peers(line.split(None, 1)[1])
-    if args.rejoin and not (
-            peers and len(peers) > 1
-            and int(knob_env("BLUEFOG_CP_REPLICATION"))):
+    nt = _successor_count(len(peers)) if peers else 0
+    if args.rejoin and not (peers and len(peers) > 1 and nt):
         print("shard_server: --rejoin requires a peer ring with "
               "BLUEFOG_CP_REPLICATION enabled (the gate would never "
               "open)", file=sys.stderr)
         srv.stop()
         return 2
+    if peers and len(peers) > 1:
+        _arm_partition_from_env(peers, args.shard)
     addr_val = None
-    if peers and len(peers) > 1 and int(knob_env("BLUEFOG_CP_REPLICATION")):
-        succ_idx = (args.shard + 1) % len(peers)
+    if peers and nt:
         if args.rejoin:
-            _rejoin_catch_up(srv, args.shard, peers, secret)
-        sh, sp = (_published_addr(peers, succ_idx, secret, skip=args.shard)
-                  if args.rejoin else None) or peers[succ_idx]
-        srv.set_successor(sh, sp, len(peers), args.shard)
-        logger.info("shard %d: WAL replication to ring successor %s:%d",
-                    args.shard, sh, sp)
+            _rejoin_catch_up(srv, args.shard, peers, secret, nt)
+        if nt >= 2:
+            targets = []
+            for k in range(1, nt + 1):
+                s = (args.shard + k) % len(peers)
+                th, tp = (_published_addr(peers, s, secret, skip=args.shard)
+                          if args.rejoin else None) or peers[s]
+                targets.append((s, th, tp))
+            srv.set_successors(targets, len(peers), args.shard)
+            sh, sp = targets[0][1], targets[0][2]
+            logger.info("shard %d: quorum WAL replication to %d ring "
+                        "successors %s (commit = %d acks)", args.shard, nt,
+                        [f"{t[1]}:{t[2]}" for t in targets], (nt + 2) // 2)
+        else:
+            succ_idx = (args.shard + 1) % len(peers)
+            sh, sp = (_published_addr(peers, succ_idx, secret,
+                                      skip=args.shard)
+                      if args.rejoin else None) or peers[succ_idx]
+            srv.set_successor(sh, sp, len(peers), args.shard)
+            logger.info("shard %d: WAL replication to ring successor %s:%d",
+                        args.shard, sh, sp)
         if args.rejoin:
             # Announce alive ONLY NOW — after our own WAL stream is armed.
             # Routers flip traffic back the moment they see the even
@@ -317,7 +452,7 @@ def main(argv=None) -> int:
                 cap)
 
     done = threading.Event()
-    if peers and len(peers) > 1 and int(knob_env("BLUEFOG_CP_REPLICATION")):
+    if peers and nt:
         # Alive keeper: a router whose redirect-verify dial loses a race
         # under a connect storm can FALSELY publish an odd (dead)
         # liveness generation for this perfectly live shard — and nothing
@@ -327,6 +462,18 @@ def main(argv=None) -> int:
         # the monotone put_max around the ring), so a false death claim
         # self-corrects within a poll interval; a real death stops the
         # keeper with the process.
+        #
+        # Quorum mode adds two partition rules. (1) While this server is
+        # below its commit quorum (minority side of a cut) it must NOT
+        # re-even its own flag: the majority legitimately declared it
+        # dead and failed its keyspace over — re-asserting alive from
+        # the minority would split-brain the routing. (2) Once quorum is
+        # restored, a flag still odd means routers served this shard's
+        # keyspace elsewhere during the episode, so its local copy is
+        # stale: a guarded IN-PLACE self-rejoin (reset_store + snapshot
+        # catch-up from the surviving replicas, then reopening the gate)
+        # rebuilds it exactly like a restarted process before the next
+        # even generation announces it back.
         flag = f"bf.cp.shard_dead.{args.shard}"
         addr_key = f"bf.cp.shard_addr.{args.shard}"
 
@@ -334,8 +481,14 @@ def main(argv=None) -> int:
             from .router import pack_shard_addr
 
             cl = None
+            saw_qlost = False
+            adv_host = args.advertise_host or peers[args.shard][0]
             while not done.wait(2.0):
                 try:
+                    if nt >= 2 and \
+                            (srv.stats() or {}).get("quorum_state") == 2:
+                        saw_qlost = True
+                        continue  # rule (1): never re-assert below quorum
                     if cl is None:
                         ah, ap = _published_addr(
                             peers, (args.shard + 1) % len(peers), secret,
@@ -353,22 +506,45 @@ def main(argv=None) -> int:
                         cl = None
                         continue
                     if cur % 2 == 1:
+                        if nt >= 2 and saw_qlost:
+                            # rule (2): flagged dead during a real quorum
+                            # loss — the keyspace moved; rebuild in place
+                            # before announcing alive
+                            try:
+                                srv.reset_store()
+                                _rejoin_catch_up(srv, args.shard, peers,
+                                                 secret, nt)
+                                srv.rejoin_done()
+                            except (OSError, RuntimeError) as exc:
+                                logger.error(
+                                    "shard %d: post-partition self-rejoin "
+                                    "failed (%s); staying flagged dead, "
+                                    "retrying next tick", args.shard, exc)
+                                continue
+                            saw_qlost = False
+                            logger.warning(
+                                "shard %d: post-partition self-rejoin "
+                                "complete (store rebuilt from surviving "
+                                "replicas)", args.shard)
                         cl.put_max(flag, cur + 1)
-                        if addr_val is not None:
+                        if addr_val is not None or (nt >= 2 and cur > 0):
                             # a moved shard's endpoint must outlive false
                             # death claims: restamp it at the new even gen
                             cl.put_max(addr_key,
                                        pack_shard_addr(
-                                           cur + 1,
-                                           args.advertise_host
-                                           or peers[args.shard][0],
-                                           srv.port))
+                                           cur + 1, adv_host, srv.port))
                         logger.warning(
                             "shard %d: re-asserted ALIVE (liveness "
                             "generation %d -> %d; a peer's death claim "
                             "was spurious)", args.shard, cur, cur + 1)
-                    elif addr_val is not None:
-                        cl.put_max(addr_key, addr_val)
+                    else:
+                        # quorum (if it was lost) is held again and no
+                        # router flagged us dead: streams were only
+                        # suspect-parked across the cut and resume
+                        # gap-free — no rebuild needed
+                        saw_qlost = False
+                        if addr_val is not None:
+                            cl.put_max(addr_key, addr_val)
                 except OSError:
                     if cl is not None:
                         cl.close()

@@ -310,6 +310,16 @@ constexpr int64_t kDeadHolderReply = -3;
 // non-retryable, unlike a wire failure.
 constexpr int64_t kStaleIncarnationReply = -4;
 constexpr uint32_t kStaleFrame = 0xFFFFFFFEu;
+// Quorum-lost rejection (r20 partition-aware fencing): a shard that cannot
+// currently reach a commit quorum of its replica group refuses MUTATING ops
+// instead of applying them locally (a silent local apply on the minority
+// side of a partition is exactly how split-brain state is minted). Int-reply
+// ops carry the code in-band (same convention as -3/-4); bulk-reply ops
+// (kTakeBytes) answer with the kQuorumFrame length sentinel. Python surfaces
+// either as bf.QuorumLostError — typed and non-retryable: reads still work,
+// and the caller decides whether to wait out the partition.
+constexpr int64_t kQuorumLostReply = -5;
+constexpr uint32_t kQuorumFrame = 0xFFFFFFFDu;
 
 double EnvSeconds(const char* name, double dflt) {
   const char* v = std::getenv(name);
@@ -358,6 +368,70 @@ int FaultNext() {
 void FaultDelay() {
   int ms = g_fault_delay_ms.load(std::memory_order_relaxed);
   if (ms > 0) std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
+
+// -- deterministic partition injector (BLUEFOG_CP_FAULT partition=...) ------
+//
+// Armed from Python via bf_cp_partition(): ports are assigned to groups and
+// any client whose group differs from its target port's group fails at the
+// socket layer — dials are refused and established connections are shut down
+// at the next op, in BOTH directions (each side's outgoing clients enforce
+// the cut against the other side's ports). Failures across the cut are
+// classified as PARTITION-suspect, never as definitive death: the quorum
+// layer must treat an unreachable-but-possibly-alive peer differently from
+// one whose death is evidenced (ECONNREFUSED), or a minority side could
+// count its unreachable majority as dead and keep serving — split-brain.
+// The cut engages at start_after and heals at heal_after (wall-clock,
+// matching the flight ring's time axis), so a soak can arm it from the
+// environment before fork and have it fire and heal mid-run.
+//
+// Group resolution: normal clients use the process-default group
+// (g_part_self_group, set when arming); replicator/rejoin clients override
+// per-client with their OWN server's port group, which keeps an in-process
+// multi-server ring test deterministic even though the globals are
+// process-wide.
+constexpr int kPartGroupUnset = -2000000000;  // client: use process default
+std::atomic<int> g_part_armed{0};
+std::mutex g_part_mu;  // guards the two fields below
+std::map<int, int> g_part_port_group;
+int g_part_self_group = -1;
+std::atomic<long long> g_part_start_us{0};  // 0 = cut active immediately
+std::atomic<long long> g_part_heal_us{0};   // 0 = never heals
+std::atomic<long long> g_part_cuts{0};      // connects/ops failed by the cut
+
+bool PartitionActiveNow() {
+  if (!g_part_armed.load(std::memory_order_relaxed)) return false;
+  long long now = std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::system_clock::now().time_since_epoch())
+                      .count();
+  long long s = g_part_start_us.load(std::memory_order_relaxed);
+  if (s && now < s) return false;
+  long long h = g_part_heal_us.load(std::memory_order_relaxed);
+  if (h && now >= h) return false;
+  return true;
+}
+
+int PartGroupOfPort(int port) {
+  std::lock_guard<std::mutex> g(g_part_mu);
+  auto it = g_part_port_group.find(port);
+  return it == g_part_port_group.end() ? -1 : it->second;
+}
+
+int PartSelfGroup() {
+  std::lock_guard<std::mutex> g(g_part_mu);
+  return g_part_self_group;
+}
+
+// Is the edge (my_group -> port) across an active cut? `count` distinguishes
+// enforcement sites (dials, op sends — telemetry-counted) from passive
+// quorum-state probes.
+bool PartitionCutFor(int my_group, int port, bool count = true) {
+  if (!PartitionActiveNow()) return false;
+  if (my_group < 0) return false;
+  int tg = PartGroupOfPort(port);
+  if (tg < 0 || tg == my_group) return false;
+  if (count) g_part_cuts.fetch_add(1, std::memory_order_relaxed);
+  return true;
 }
 
 // -- client telemetry counter block (r10 observability) ---------------------
@@ -674,6 +748,36 @@ inline void BoundedWaitMs(std::condition_variable& cv,
 
 struct ControlClient;  // replicator thread holds one (defined below)
 
+// One outgoing replica stream (r20 quorum mode, R >= 3): a ring successor
+// this shard streams its WAL to. All targets share the WAL deque; each
+// keeps a send cursor and an acked watermark, and the deque is trimmed at
+// the minimum acked over non-down targets. `state` encodes the evidence we
+// hold about the peer:
+//   kTgtLive    — streaming (or not yet contradicted)
+//   kTgtSuspect — unreachable with NON-definitive evidence (timeout, reset,
+//                 injected partition): the peer may be alive on the far
+//                 side of a cut, so its queue share is RETAINED and the
+//                 sender retries; it neither counts toward the commit
+//                 quorum nor reduces the requirement.
+//   kTgtDown    — definitive death evidence (ECONNREFUSED: the host is
+//                 reachable and nothing listens) or an authoritative
+//                 bf.cp.shard_dead flag: reduces the quorum requirement
+//                 and releases its queue share. Re-armed only by the
+//                 peer's own rejoin kSnapshot pull, never mid-stream.
+constexpr int kTgtLive = 0;
+constexpr int kTgtSuspect = 1;
+constexpr int kTgtDown = 2;
+struct ReplTarget {
+  int idx = -1;          // ring index of the successor shard
+  std::string host;
+  int port = 0;
+  int state = kTgtLive;  // guarded by server mu
+  int refused = 0;       // consecutive ECONNREFUSED dials (2 -> down)
+  uint64_t acked = 0;    // highest WAL seq this target acked
+  uint64_t cursor = 0;   // highest WAL seq handed to this target's sender
+  std::thread thread;
+};
+
 struct ControlServer {
   int listen_fd = -1;
   int world = 0;
@@ -743,13 +847,33 @@ struct ControlServer {
   int shard_idx = -1;               //   filter + scoped incarnation GC)
   double repl_wait_sec = 30.0;      // BLUEFOG_CP_REPL_TIMEOUT
   size_t repl_depth = 65536;        // BLUEFOG_CP_WAL_DEPTH (records)
-  std::deque<ReplRecord> repl_q;    // guarded by mu
+  // WAL deque shared by every outgoing stream. shared_ptr records: in
+  // quorum mode R-1 senders each walk the deque by cursor without copying
+  // payloads; in chain mode the single ReplLoop batches them exactly as
+  // the r16 wire did (same frames, same group-commit cut points).
+  std::deque<std::shared_ptr<const ReplRecord>> repl_q;  // guarded by mu
   uint64_t wal_seq = 0;             // last record enqueued
-  uint64_t wal_acked = 0;           // last record acked by the successor
+  uint64_t wal_acked = 0;           // last QUORUM-committed record
   uint64_t wal_dropped_below = 0;   // degrade watermark (waiter escape)
   std::atomic<long long> wal_dropped{0};
   std::thread repl_thread;
   std::condition_variable repl_cv;  // queue arrivals + ack advances
+  // -- quorum mode (r20, BLUEFOG_CP_REPLICATION >= 3) ----------------------
+  // R-1 ring successors instead of one; commit = ack from effective_needed
+  // targets where effective_needed = ceil(R/2) successor acks minus one per
+  // target with DEFINITIVE death evidence (state down, or its
+  // bf.cp.shard_dead flag odd). A suspect (partition-separated) target
+  // neither counts nor reduces: with enough of them the shard falls below
+  // quorum and the handler gate refuses mutating ops (kQuorumLostReply)
+  // BEFORE applying them — the read-only minority side of a partition.
+  // Chain mode (R = 2) keeps the r16 single-successor code path untouched.
+  bool quorum_mode = false;         // repl_targets.size() >= 2
+  int needed_base = 1;              // ceil(R/2) successor acks
+  std::vector<std::unique_ptr<ReplTarget>> repl_targets;
+  int listen_port = 0;              // own bound port (partition group key)
+  std::atomic<long long> quorum_acks{0};        // target batch acks
+  std::atomic<long long> partition_rejects{0};  // gate refusals
+  std::set<int> repl_sources;       // distinct kReplApply source idxs (mu)
   // replica side: records at or below the fence are already folded into
   // the snapshot this server was loaded from (shard rejoin catch-up).
   // The fence is ONLY meaningful against the predecessor's CURRENT WAL
@@ -762,9 +886,17 @@ struct ControlServer {
   // re-arms its stream) and THIS server loading it: records applied to
   // the still-empty store would land out of order with the snapshot's
   // contents, so they wait on the gate instead.
-  uint64_t repl_fence = 0;
+  // Keyed by SOURCE shard index (quorum mode: R-1 predecessors each stream
+  // under their own numbering; -2 is the chain-mode / legacy single-stream
+  // key, which keeps the R=2 wire and snapshot format byte-identical).
+  std::map<int, uint64_t> repl_fence;
   bool rejoin_pending = false;
   std::atomic<long long> repl_applied_n{0};
+
+  uint64_t FenceOf(int src) const {  // caller holds mu
+    auto it = repl_fence.find(src);
+    return it == repl_fence.end() ? 0 : it->second;
+  }
 
   // Keyspaces this shard currently serves as FAILOVER primary (guarded
   // by mu), recomputed from the replicated bf.cp.shard_dead.<i> liveness
@@ -801,11 +933,123 @@ struct ControlServer {
     }
   }
 
-  void ReplLoop();  // defined after ControlClient (it dials one)
+  void ReplLoop();                     // chain mode (defined below)
+  void ReplTargetLoop(ReplTarget* t);  // quorum mode, one per target
 
-  // Degrade to unreplicated (caller holds mu): drop the queue, wake every
-  // ack waiter, and count what was lost. Replication resumes only at the
-  // next kSnapshot cut.
+  bool DeadFlaggedLocked(int idx) {
+    auto it = kv.find("bf.cp.shard_dead." + std::to_string(idx));
+    return it != kv.end() && (it->second % 2) == 1;
+  }
+
+  // Current quorum requirement among non-down targets (caller holds mu):
+  // ceil(R/2) successor acks, minus one per target with definitive death
+  // evidence — a dead copy is unrecoverable mid-stream and must not be
+  // waited for (the kill-pair survivor at R=3 has BOTH targets down and a
+  // requirement of zero: it serves alone, which is the whole point).
+  int EffectiveNeededLocked() {
+    int needed = needed_base;
+    for (auto& tp : repl_targets)
+      if (tp->state == kTgtDown || DeadFlaggedLocked(tp->idx)) --needed;
+    return needed < 0 ? 0 : needed;
+  }
+
+  // Quorum-mode commit watermark: the effective_needed-th largest per-
+  // target acked seq (wal_seq itself when the requirement is zero).
+  // Monotone — a target demotion never walks a committed seq back.
+  void ReplRecomputeAckedLocked() {
+    if (!quorum_mode) return;
+    int needed = EffectiveNeededLocked();
+    uint64_t newack;
+    if (needed <= 0) {
+      newack = wal_seq;
+    } else {
+      std::vector<uint64_t> acks;
+      for (auto& tp : repl_targets)
+        if (tp->state != kTgtDown) acks.push_back(tp->acked);
+      if (static_cast<int>(acks.size()) < needed) return;
+      std::sort(acks.begin(), acks.end(), std::greater<uint64_t>());
+      newack = acks[needed - 1];
+    }
+    if (newack > wal_acked) {
+      wal_acked = newack;
+      repl_cv.notify_all();
+    }
+  }
+
+  // Drop queue entries every non-down target has acked (caller holds mu).
+  // A suspect target retains its share — it may be alive across a cut and
+  // resume from its cursor at heal. All targets down is the quorum-mode
+  // analog of chain degrade: nothing left to stream to.
+  void ReplTrimLocked() {
+    if (!quorum_mode) return;
+    bool any = false;
+    uint64_t m = ~0ull;
+    for (auto& tp : repl_targets)
+      if (tp->state != kTgtDown) {
+        any = true;
+        if (tp->acked < m) m = tp->acked;
+      }
+    if (!any) {
+      wal_dropped_below = wal_seq;
+      repl_live = false;
+      repl_q.clear();
+      repl_cv.notify_all();
+      return;
+    }
+    repl_live = true;
+    while (!repl_q.empty() && repl_q.front()->seq <= m) repl_q.pop_front();
+  }
+
+  // Definitive demotion of one target (caller holds mu): its unacked queue
+  // share is surrendered (counted in wal_dropped) and the commit
+  // requirement shrinks by one. Re-armed only by the peer's rejoin
+  // kSnapshot pull — never mid-stream with a silent gap.
+  void ReplDemoteLocked(ReplTarget* t) {
+    if (t->state == kTgtDown) return;
+    t->state = kTgtDown;
+    if (wal_seq > t->acked)
+      wal_dropped.fetch_add(static_cast<long long>(wal_seq - t->acked),
+                            std::memory_order_relaxed);
+    ReplTrimLocked();
+    ReplRecomputeAckedLocked();
+    repl_cv.notify_all();
+  }
+
+  // Can this shard currently commit a mutation? (caller holds mu; quorum
+  // mode only — chain mode keeps r16's availability-over-replication
+  // degrade.) Folds in two sensors so the verdict flips the moment the
+  // world changes rather than one send-failure later: an armed partition
+  // cut against a live target marks it suspect immediately, and an
+  // authoritative dead flag on a suspect target demotes it (the flag is
+  // the cluster's death verdict; staying suspect would pin the queue for
+  // a peer that is gone).
+  bool QuorumOkLocked() {
+    if (!quorum_mode) return true;
+    int my_group = PartGroupOfPort(listen_port);
+    int needed = needed_base;
+    int live = 0;
+    for (auto& tp : repl_targets) {
+      ReplTarget* t = tp.get();
+      if (t->state == kTgtLive &&
+          PartitionCutFor(my_group, t->port, /*count=*/false)) {
+        t->state = kTgtSuspect;
+        repl_cv.notify_all();
+      }
+      bool flagged = DeadFlaggedLocked(t->idx);
+      if (flagged && t->state == kTgtSuspect) ReplDemoteLocked(t);
+      if (t->state == kTgtDown || flagged) {
+        --needed;
+        continue;
+      }
+      if (t->state == kTgtLive) ++live;
+    }
+    if (needed < 0) needed = 0;
+    return live >= needed;
+  }
+
+  // Degrade to unreplicated (caller holds mu; chain mode): drop the queue,
+  // wake every ack waiter, and count what was lost. Replication resumes
+  // only at the next kSnapshot cut.
   void ReplDegradeLocked() {
     wal_dropped_below = wal_seq;  // waiters at or below this never ack
     if (!repl_live && repl_q.empty()) return;
@@ -828,24 +1072,42 @@ struct ControlServer {
       return 0;
     }
     if (repl_q.size() >= repl_depth) {
-      // WAL depth cap: a wedged successor must not grow this server's
-      // memory without bound — degrade instead of blocking forever
-      ReplDegradeLocked();
-      wal_dropped.fetch_add(1, std::memory_order_relaxed);
-      return 0;
+      if (!quorum_mode) {
+        // WAL depth cap: a wedged successor must not grow this server's
+        // memory without bound — degrade instead of blocking forever
+        ReplDegradeLocked();
+        wal_dropped.fetch_add(1, std::memory_order_relaxed);
+        return 0;
+      }
+      // Quorum mode: the queue is pinned by its slowest non-down target
+      // (typically partition-suspect). Depth is the durability budget for
+      // riding out a cut; past it, demote the laggard(s) — bounded memory
+      // beats an unbounded wait for a peer that may never come back.
+      while (repl_q.size() >= repl_depth) {
+        ReplTarget* worst = nullptr;
+        for (auto& tp : repl_targets)
+          if (tp->state != kTgtDown && (!worst || tp->acked < worst->acked))
+            worst = tp.get();
+        if (!worst) break;
+        ReplDemoteLocked(worst);
+      }
+      if (!repl_live) {  // every target demoted: fully degraded
+        wal_dropped.fetch_add(1, std::memory_order_relaxed);
+        return 0;
+      }
     }
-    ReplRecord r;
-    r.seq = ++wal_seq;
-    r.op = op;
-    r.record_reply = record_reply ? 1 : 0;
-    r.rank = rank;
-    r.cid = cid;
-    r.cseq = cseq;
-    r.cidx = cidx;
-    r.key = key;
-    r.arg = arg;
-    r.reply = reply;
-    r.data = std::move(data);
+    auto r = std::make_shared<ReplRecord>();
+    r->seq = ++wal_seq;
+    r->op = op;
+    r->record_reply = record_reply ? 1 : 0;
+    r->rank = rank;
+    r->cid = cid;
+    r->cseq = cseq;
+    r->cidx = cidx;
+    r->key = key;
+    r->arg = arg;
+    r->reply = reply;
+    r->data = std::move(data);
     repl_q.push_back(std::move(r));
     repl_cv.notify_all();
     return wal_seq;
@@ -868,7 +1130,17 @@ struct ControlServer {
     while (repl_live && wal_acked < seq && seq > wal_dropped_below &&
            !stopping.load()) {
       if (std::chrono::steady_clock::now() >= deadline) {
-        ReplDegradeLocked();
+        if (quorum_mode) {
+          // The commit quorum did not form in time (e.g. the partition
+          // hit between the gate check and this wait). The op is already
+          // applied locally and still queued for every surviving target,
+          // so degrade-and-drop would be strictly worse — release the
+          // reply under-replicated (counted) and let the streams catch
+          // up, or the gate reject the next mutation.
+          wal_dropped.fetch_add(1, std::memory_order_relaxed);
+        } else {
+          ReplDegradeLocked();
+        }
         break;
       }
       BoundedWaitMs(repl_cv, lk, 200);
@@ -890,8 +1162,11 @@ struct ControlServer {
   // in-process owner) and the kStats wire op (external per-shard view
   // mergers). Takes `mu` itself — callers must NOT hold it.
   // Slots [43..47] are the WAL-replication view (`bfrun --status
-  // --strict` reports a degraded shard as under-replicated off them).
-  static constexpr int kStatSlots = 32 + 16;
+  // --strict` reports a degraded shard as under-replicated off them);
+  // [48..52] the r20 quorum view: quorum_acks, partition_rejects,
+  // replica_sources (distinct predecessors streaming in), quorum_state
+  // (0 n/a, 1 held, 2 lost), repl_targets_live (outgoing live streams).
+  static constexpr int kStatSlots = 32 + 21;
 
   int FillCounters(long long* out, int n) {
     if (!out || n < kStatSlots) return -1;
@@ -900,6 +1175,7 @@ struct ControlServer {
     long long recs = 0, rec_bytes = 0, held = 0, slots = 0, slot_bytes = 0;
     long long conns, kvn;
     long long wal_n, wal_ack, repl_st;
+    long long srcs, q_st, tgt_live = 0;
     {
       std::lock_guard<std::mutex> lk(mu);
       conns = static_cast<long long>(handler_fds.size());
@@ -915,7 +1191,19 @@ struct ControlServer {
       }
       wal_n = static_cast<long long>(wal_seq);
       wal_ack = static_cast<long long>(wal_acked);
-      repl_st = !repl_cfg ? 0 : (repl_live ? 1 : 2);
+      if (quorum_mode) {
+        bool all_live = true;
+        for (const auto& tp : repl_targets) {
+          if (tp->state == kTgtLive) ++tgt_live;
+          else all_live = false;
+        }
+        repl_st = all_live ? 1 : 2;
+      } else {
+        repl_st = !repl_cfg ? 0 : (repl_live ? 1 : 2);
+        if (repl_cfg && repl_live) tgt_live = 1;
+      }
+      srcs = static_cast<long long>(repl_sources.size());
+      q_st = !quorum_mode ? 0 : (QuorumOkLocked() ? 1 : 2);
     }
     out[32] = conns;
     out[33] = recs;
@@ -933,6 +1221,11 @@ struct ControlServer {
     out[45] = wal_dropped.load(std::memory_order_relaxed);
     out[46] = repl_st;  // 0 = off, 1 = live, 2 = degraded (under-replicated)
     out[47] = repl_applied_n.load(std::memory_order_relaxed);
+    out[48] = quorum_acks.load(std::memory_order_relaxed);
+    out[49] = partition_rejects.load(std::memory_order_relaxed);
+    out[50] = srcs;
+    out[51] = q_st;
+    out[52] = tgt_live;
     return kStatSlots;
   }
 
@@ -1298,6 +1591,68 @@ struct ControlServer {
           ++ded_idx;
           --ded_left;
           if (!ok) return;
+          continue;
+        }
+      }
+
+      // Partition-aware fence (r20, quorum mode only): a shard that cannot
+      // reach its commit quorum refuses every MUTATING client op with a
+      // typed rejection BEFORE applying it — never a silent local apply.
+      // Reads keep working (the minority side is read-only, not dead), and
+      // kReplApply is exempt: incoming WAL streams are the replication
+      // mechanism itself, already serialized by their primary, and the
+      // majority side must stay able to propagate dead flags through them.
+      // Dead-flag writes themselves are NOT exempt: a minority shard that
+      // could flag its unreachable peers dead would mint exactly the
+      // split-brain this fence exists to prevent (on the majority side the
+      // flag write passes because definitive down-evidence has already
+      // reduced the requirement).
+      bool is_gated_mut = false;
+      switch (op) {
+        case kPut: case kPutMax: case kFetchAdd: case kLock: case kUnlock:
+        case kAppendBytes: case kAppendBytesTagged: case kTakeBytes:
+        case kPutBytes: case kPutBytesPart:
+          is_gated_mut = true;
+          break;
+        default:
+          break;
+      }
+      if (is_gated_mut) {
+        bool rejected = false;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          if (quorum_mode && !QuorumOkLocked()) {
+            rejected = true;
+            partition_rejects.fetch_add(1, std::memory_order_relaxed);
+          }
+        }
+        if (rejected) {
+          if (op == kTakeBytes) {
+            // bulk-reply op: answer with the length sentinel; the armed
+            // dedup slot is aborted (not recorded), so a post-heal retry
+            // re-executes rather than replaying the rejection.
+            uint32_t f = kQuorumFrame;
+            bool ok = WriteAll(fd, &f, 4);
+            if (ded) {
+              ded_abort();
+              ++ded_idx;
+              --ded_left;
+            }
+            if (!ok) return;
+            continue;
+          }
+          reply = kQuorumLostReply;
+          if (ded) {
+            ded_record(reply, nullptr);
+            ded_recorded = true;
+            ++ded_idx;
+            --ded_left;
+          }
+          uint32_t rlen = 8;
+          char outb[12];
+          std::memcpy(outb, &rlen, 4);
+          std::memcpy(outb + 4, &reply, 8);
+          if (!WriteAll(fd, outb, 12)) return;
           continue;
         }
       }
@@ -1797,7 +2152,14 @@ struct ControlServer {
           const size_t pn = dlen - kReplHdr - 2 - rklen;
           std::lock_guard<std::mutex> lk(mu);
           const uint64_t rseq = static_cast<uint64_t>(arg);
-          if (rseq <= repl_fence) {  // already folded into our snapshot
+          // Source identity rides the frame rank: a quorum-mode (R >= 3)
+          // replicator dials with rank -(100 + source_shard_idx) so R-1
+          // incoming streams keep independent fences under independent
+          // WAL numberings; the chain-mode replicator's -2 is the legacy
+          // single-stream key (R=2 wire byte-identical).
+          const int rsrc = rank <= -100 ? (-rank - 100) : -2;
+          repl_sources.insert(rsrc);
+          if (rseq <= FenceOf(rsrc)) {  // already folded into our snapshot
             reply = 1;
             break;
           }
@@ -1981,7 +2343,12 @@ struct ControlServer {
             };
             uint64_t fence = wal_seq;
             blob.append(reinterpret_cast<const char*>(&fence), 8);
-            uint64_t resume = repl_fence;
+            // The resume position is per SOURCE stream: a quorum-mode
+            // rejoiner identifies itself via its frame rank (-(100+idx))
+            // and gets the fence of ITS stream into us; legacy pulls get
+            // the single chain-stream fence (key -2).
+            const int snap_src = rank <= -100 ? (-rank - 100) : -2;
+            uint64_t resume = FenceOf(snap_src);
             blob.append(reinterpret_cast<const char*>(&resume), 8);
             for (const auto& it : kv)
               if (want(it.first))
@@ -2018,9 +2385,25 @@ struct ControlServer {
             // exactly the silent mid-stream gap degrade exists to
             // prevent. The flag rides the pull itself (not a separate
             // op) so cut and re-arm stay atomic under one mutex hold.
-            if (rearm && repl_cfg && !repl_live) {
-              repl_live = true;  // resync point: stream resumes from here
-              repl_cv.notify_all();
+            if (rearm && repl_cfg) {
+              if (quorum_mode) {
+                // Re-arm exactly the requester's target stream: it loads
+                // this very cut, so cut + resumed records are gap-free
+                // for THAT copy; the other streams are untouched.
+                for (auto& tp : repl_targets) {
+                  if (tp->idx != snap_src) continue;
+                  tp->state = kTgtLive;
+                  tp->refused = 0;
+                  tp->acked = wal_seq;   // the cut carries everything prior
+                  tp->cursor = wal_seq;  // resume with the next record
+                  ReplTrimLocked();
+                  ReplRecomputeAckedLocked();
+                  repl_cv.notify_all();
+                }
+              } else if (!repl_live) {
+                repl_live = true;  // resync point: stream resumes from here
+                repl_cv.notify_all();
+              }
             }
           }
           uint32_t rlen = static_cast<uint32_t>(blob.size());
@@ -2171,9 +2554,27 @@ struct ControlClient {
   // replays the recorded reply instead of double-applying. fo_active is
   // read lock-free by the router's health probe (it must not contend
   // with a blocking op holding `mu`).
+  // (r20) The failover CHAIN generalizes the single successor: when R-1
+  // successors hold the dead primary's keyspace, a redial failure walks
+  // the chain PAST runs of consecutive dead shards — still on the same
+  // ControlClient, so the same (cid, seq) reaches whichever live replica
+  // answers, and its WAL-primed dedup table keeps the retry exactly-once.
+  // fo_active holds 0 (primary) or 1 + index of the chain entry stuck to.
   std::string fo_host;
   int fo_port = 0;
+  std::vector<std::pair<std::string, int>> fo_chain;  // guarded by mu
   std::atomic<int> fo_active{0};
+  // Partition-injector group: INT_MIN = resolve to the process default at
+  // call time (normal clients); replicator/rejoin clients pin their OWN
+  // server's port group so an in-process multi-server ring partitions
+  // deterministically. cur_port tracks the endpoint `fd` currently points
+  // at (primary or a chain entry) — the cut is evaluated per edge.
+  int part_group = kPartGroupUnset;
+  int cur_port = 0;
+
+  int EffGroup() {
+    return part_group == kPartGroupUnset ? PartSelfGroup() : part_group;
+  }
 
   // Register (rank, incarnation) on the CURRENT connection (caller holds
   // mu). Returns 1 on success, kStaleIncarnationReply when superseded
@@ -2232,6 +2633,15 @@ struct ControlClient {
   // but loses the reply. Both surface as a wire failure to the caller, so
   // the reconnect + dedup path is exercised exactly as by a real drop.
   bool SendFault(const std::vector<char>& buf, int fault) {
+    // Partition cut on an ESTABLISHED connection: every op funnels through
+    // here, so shutting the socket down at the next use cuts both
+    // directions lazily (the far side's own clients do the same against
+    // our ports). Surfaces as a wire failure — and the redial fails at
+    // DialAndHandshake's cut check, classified partition-suspect.
+    if (PartitionCutFor(EffGroup(), cur_port ? cur_port : port)) {
+      ::shutdown(fd, SHUT_RDWR);
+      return false;
+    }
     if (fault == 1) {
       if (g_fault_trunc.load(std::memory_order_relaxed) && buf.size() > 8)
         ControlServer::WriteAll(fd, buf.data(), buf.size() / 2);
@@ -2304,6 +2714,12 @@ struct ControlClient {
       *reply = kStaleIncarnationReply;
       return true;
     }
+    if (rlen == kQuorumFrame) {
+      // below-quorum rejection of a bulk-reply op: typed, not latched —
+      // the shard recovers when the partition heals.
+      *reply = kQuorumLostReply;
+      return true;
+    }
     if (rlen != 8) return false;
     return ControlServer::ReadAll(fd, reply, 8);
   }
@@ -2358,6 +2774,7 @@ struct ControlClient {
       FlightRec(kFlightStaleFrame, 0, 0);
           return kStaleIncarnationReply;
         }
+        if (got && rlen == kQuorumFrame) return kQuorumLostReply;
         if (got && rlen <= kMaxMsg) {
           char* payload = static_cast<char*>(std::malloc(rlen ? rlen : 1));
           if (!payload) return -1;
@@ -2531,6 +2948,7 @@ struct ControlClient {
     std::lock_guard<std::mutex> lk(mu);
     if (stale) return kStaleIncarnationReply;
     const uint64_t seq = AllocSeq(op);  // multi-take: batch-level dedup
+    bool qlost = false;
     auto attempt = [&](int fault) -> bool {
       std::vector<char> buf;
       if (seq) EncodePre(&buf, seq, static_cast<uint32_t>(n));
@@ -2565,6 +2983,15 @@ struct ControlClient {
           std::free(payload);
           return false;
         }
+        if (rlen == kQuorumFrame) {
+          // below-quorum mid-batch: fail typed, no retry. While a shard
+          // is below quorum EVERY gated op rejects, so there is no mixed
+          // partial-drain to lose — the batch keys all route to the same
+          // shard and reject together.
+          qlost = true;
+          std::free(payload);
+          return false;
+        }
         if (rlen > kMaxMsg) {
           std::free(payload);
           return false;
@@ -2595,6 +3022,7 @@ struct ControlClient {
     };
     for (int a = 0;; ++a) {
       if (attempt(FaultNext())) return n;
+      if (qlost) return kQuorumLostReply;
       if (stale || a >= retries)
         return stale ? kStaleIncarnationReply : -1;
       if (!Reconnect(a) && stale) return kStaleIncarnationReply;
@@ -2655,8 +3083,22 @@ namespace {
 // Dial + TCP_NODELAY + mutual HMAC handshake; -1 on any failure. The one
 // connect path shared by first connects and transparent reconnects, so a
 // rebuilt stream is exactly as authenticated as the original.
+// Dial-failure classification (r20): the quorum layer must distinguish
+// DEFINITIVE death evidence from can't-tell unreachability — they move a
+// replica target to different states (down vs suspect; see ReplTarget).
+constexpr int kDialOk = 0;
+constexpr int kDialRefused = 1;    // ECONNREFUSED: host up, listener gone
+constexpr int kDialPartition = 2;  // injected cut (or unreachable route)
+constexpr int kDialOther = 3;
+
 int DialAndHandshake(const std::string& host, int port,
-                     const std::string& secret, int sockbuf) {
+                     const std::string& secret, int sockbuf,
+                     int part_group = -1, int* why = nullptr) {
+  if (why) *why = kDialOther;
+  if (PartitionCutFor(part_group, port)) {
+    if (why) *why = kDialPartition;
+    return -1;
+  }
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
   SetSockBuf(fd, sockbuf);
@@ -2665,6 +3107,13 @@ int DialAndHandshake(const std::string& host, int port,
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1 ||
       ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    if (why)
+      *why = errno == ECONNREFUSED
+                 ? kDialRefused
+                 : (errno == EHOSTUNREACH || errno == ENETUNREACH ||
+                            errno == ETIMEDOUT
+                        ? kDialPartition
+                        : kDialOther);
     ::close(fd);
     return -1;
   }
@@ -2674,6 +3123,7 @@ int DialAndHandshake(const std::string& host, int port,
     ::close(fd);
     return -1;
   }
+  if (why) *why = kDialOk;
   return fd;
 }
 
@@ -2700,17 +3150,40 @@ bool ControlClient::Reconnect(int attempt) {
   // revived shard, so a redirected client never flaps back mid-stream
   // (flapping would tear the kSeqPre dedup continuity that keeps
   // failover retries exactly-once).
-  bool via_fo = fo_active.load(std::memory_order_relaxed) != 0;
-  int nfd = via_fo ? DialAndHandshake(fo_host, fo_port, secret, sockbuf)
-                   : DialAndHandshake(host, port, secret, sockbuf);
-  if (nfd < 0 && !via_fo && !fo_host.empty() && attempt >= 1) {
-    nfd = DialAndHandshake(fo_host, fo_port, secret, sockbuf);
-    via_fo = nfd >= 0;
+  // (r20) fo_chain generalizes the single successor to the R-1 replicas
+  // of the primary's keyspace, in ring order: the walk starts at the
+  // sticky position and only ever moves FORWARD past dead replicas (a
+  // walk-back would tear the kSeqPre dedup continuity exactly like
+  // flapping to a revived primary would).
+  const int g = EffGroup();
+  int cur = fo_active.load(std::memory_order_relaxed);  // 0 = primary
+  int nfd = -1;
+  int landed = cur;
+  int landed_port = 0;
+  if (cur == 0) {
+    nfd = DialAndHandshake(host, port, secret, sockbuf, g);
+    landed_port = port;
+  } else if (cur <= static_cast<int>(fo_chain.size())) {
+    nfd = DialAndHandshake(fo_chain[cur - 1].first, fo_chain[cur - 1].second,
+                           secret, sockbuf, g);
+    landed_port = fo_chain[cur - 1].second;
+  }
+  if (nfd < 0 && attempt >= 1) {
+    for (int k = cur == 0 ? 1 : cur + 1;
+         k <= static_cast<int>(fo_chain.size()) && nfd < 0; ++k) {
+      nfd = DialAndHandshake(fo_chain[k - 1].first, fo_chain[k - 1].second,
+                             secret, sockbuf, g);
+      if (nfd >= 0) {
+        landed = k;
+        landed_port = fo_chain[k - 1].second;
+      }
+    }
   }
   if (nfd < 0) return false;
   fd = nfd;
-  if (via_fo && !fo_active.load(std::memory_order_relaxed)) {
-    fo_active.store(1, std::memory_order_relaxed);
+  cur_port = landed_port;
+  if (landed != cur) {
+    fo_active.store(landed, std::memory_order_relaxed);
     FlightRec(kFlightFailover, attempt, 0);
   }
   g_cl_redials.fetch_add(1, std::memory_order_relaxed);
@@ -2734,9 +3207,80 @@ bool ControlClient::Reconnect(int attempt) {
 // wire drops cannot double-apply a record. A send failure degrades the
 // plane (records dropped, waiters woken) until the next kSnapshot cut
 // re-arms it — never a silent mid-stream gap.
+// Shared by both replicator modes: build the kReplApply batch frames for
+// `batch` and ship them over `cl`. Returns true when every record acked.
+static bool ShipReplBatch(
+    ControlClient* cl,
+    const std::vector<std::shared_ptr<const ReplRecord>>& batch) {
+  const int n = static_cast<int>(batch.size());
+  std::string keys;
+  std::vector<std::string> bodies(static_cast<size_t>(n));
+  std::vector<const void*> ptrs(static_cast<size_t>(n));
+  std::vector<int64_t> lens(static_cast<size_t>(n));
+  std::vector<int64_t> args(static_cast<size_t>(n));
+  std::vector<int64_t> out(static_cast<size_t>(n));
+  for (int i = 0; i < n; ++i) {
+    const ReplRecord& r = *batch[static_cast<size_t>(i)];
+    // The frame keys stay EMPTY ('\n' separators only): the record
+    // key rides the body, length-prefixed, because the multi-op key
+    // string splits on '\n' and control-plane keys embed
+    // user-derived names that may contain one — a newline key would
+    // shift every later record in the batch onto the wrong key.
+    if (i) keys.push_back('\n');
+    std::string& b = bodies[static_cast<size_t>(i)];
+    b.reserve(kReplHdr + 2 + r.key.size() + r.data.size());
+    b.push_back(static_cast<char>(r.op));
+    b.push_back(static_cast<char>(r.record_reply));
+    b.append(reinterpret_cast<const char*>(&r.rank), 4);
+    b.append(reinterpret_cast<const char*>(&r.cid), 8);
+    b.append(reinterpret_cast<const char*>(&r.cseq), 8);
+    b.append(reinterpret_cast<const char*>(&r.cidx), 4);
+    b.append(reinterpret_cast<const char*>(&r.arg), 8);
+    b.append(reinterpret_cast<const char*>(&r.reply), 8);
+    uint16_t kl = static_cast<uint16_t>(r.key.size());
+    b.append(reinterpret_cast<const char*>(&kl), 2);
+    b.append(r.key);
+    b.append(r.data);
+    ptrs[static_cast<size_t>(i)] = b.data();
+    lens[static_cast<size_t>(i)] = static_cast<int64_t>(b.size());
+    args[static_cast<size_t>(i)] = static_cast<int64_t>(r.seq);
+  }
+  return cl->CallBytesMultiOutV(kReplApply, keys.c_str(), ptrs.data(),
+                                lens.data(), args.data(), out.data(),
+                                n) == n;
+}
+
+// Build a replicator client around an already-dialed fd. `rank` identifies
+// the SOURCE stream to the receiver (-2 chain mode; -(100+idx) quorum
+// mode) and `group` pins the partition group of the OWNING server.
+static ControlClient* MakeReplClient(int nfd, const std::string& host,
+                                     int port, const std::string& secret,
+                                     int rank, int group) {
+  auto* cl = new ControlClient();
+  cl->fd = nfd;
+  cl->rank = rank;
+  cl->host = host;
+  cl->port = port;
+  cl->cur_port = port;
+  cl->part_group = group;
+  cl->secret = secret;
+  cl->retries = static_cast<int>(EnvInt("BLUEFOG_CP_RETRIES", 3));
+  if (cl->retries < 0) cl->retries = 0;
+  cl->backoff_ms = static_cast<int>(EnvInt("BLUEFOG_CP_BACKOFF_MS", 50));
+  if (cl->backoff_ms < 0) cl->backoff_ms = 0;
+  uint8_t idb[8];
+  if (RandomBytes(idb, 8)) {
+    std::memcpy(&cl->cid, idb, 8);
+  } else {
+    static std::atomic<uint64_t> ctr{1};
+    cl->cid = (static_cast<uint64_t>(::getpid()) << 32) ^ ctr.fetch_add(1);
+  }
+  return cl;
+}
+
 void ControlServer::ReplLoop() {
   ControlClient* cl = nullptr;
-  std::vector<ReplRecord> batch;
+  std::vector<std::shared_ptr<const ReplRecord>> batch;
   for (;;) {
     batch.clear();
     {
@@ -2744,77 +3288,21 @@ void ControlServer::ReplLoop() {
       while (!stopping.load() && repl_q.empty())
         BoundedWaitMs(repl_cv, lk, 200);
       if (stopping.load()) break;
-      batch.assign(std::make_move_iterator(repl_q.begin()),
-                   std::make_move_iterator(repl_q.end()));
+      batch.assign(repl_q.begin(), repl_q.end());
       repl_q.clear();
     }
     if (cl == nullptr) {
-      int nfd = DialAndHandshake(repl_host, repl_port, secret, 0);
-      if (nfd >= 0) {
-        cl = new ControlClient();
-        cl->fd = nfd;
-        cl->rank = -2;  // not a controller rank; kReplApply ignores it
-        cl->host = repl_host;
-        cl->port = repl_port;
-        cl->secret = secret;
-        cl->retries = static_cast<int>(EnvInt("BLUEFOG_CP_RETRIES", 3));
-        if (cl->retries < 0) cl->retries = 0;
-        cl->backoff_ms =
-            static_cast<int>(EnvInt("BLUEFOG_CP_BACKOFF_MS", 50));
-        if (cl->backoff_ms < 0) cl->backoff_ms = 0;
-        uint8_t idb[8];
-        if (RandomBytes(idb, 8)) {
-          std::memcpy(&cl->cid, idb, 8);
-        } else {
-          static std::atomic<uint64_t> ctr{1};
-          cl->cid = (static_cast<uint64_t>(::getpid()) << 32) ^
-                    ctr.fetch_add(1);
-        }
-      }
+      int nfd = DialAndHandshake(repl_host, repl_port, secret, 0,
+                                 PartGroupOfPort(listen_port));
+      if (nfd >= 0)
+        cl = MakeReplClient(nfd, repl_host, repl_port, secret, -2,
+                            PartGroupOfPort(listen_port));
     }
-    bool ok = cl != nullptr;
-    if (ok) {
-      const int n = static_cast<int>(batch.size());
-      std::string keys;
-      std::vector<std::string> bodies(static_cast<size_t>(n));
-      std::vector<const void*> ptrs(static_cast<size_t>(n));
-      std::vector<int64_t> lens(static_cast<size_t>(n));
-      std::vector<int64_t> args(static_cast<size_t>(n));
-      std::vector<int64_t> out(static_cast<size_t>(n));
-      for (int i = 0; i < n; ++i) {
-        const ReplRecord& r = batch[static_cast<size_t>(i)];
-        // The frame keys stay EMPTY ('\n' separators only): the record
-        // key rides the body, length-prefixed, because the multi-op key
-        // string splits on '\n' and control-plane keys embed
-        // user-derived names that may contain one — a newline key would
-        // shift every later record in the batch onto the wrong key.
-        if (i) keys.push_back('\n');
-        std::string& b = bodies[static_cast<size_t>(i)];
-        b.reserve(kReplHdr + 2 + r.key.size() + r.data.size());
-        b.push_back(static_cast<char>(r.op));
-        b.push_back(static_cast<char>(r.record_reply));
-        b.append(reinterpret_cast<const char*>(&r.rank), 4);
-        b.append(reinterpret_cast<const char*>(&r.cid), 8);
-        b.append(reinterpret_cast<const char*>(&r.cseq), 8);
-        b.append(reinterpret_cast<const char*>(&r.cidx), 4);
-        b.append(reinterpret_cast<const char*>(&r.arg), 8);
-        b.append(reinterpret_cast<const char*>(&r.reply), 8);
-        uint16_t kl = static_cast<uint16_t>(r.key.size());
-        b.append(reinterpret_cast<const char*>(&kl), 2);
-        b.append(r.key);
-        b.append(r.data);
-        ptrs[static_cast<size_t>(i)] = b.data();
-        lens[static_cast<size_t>(i)] = static_cast<int64_t>(b.size());
-        args[static_cast<size_t>(i)] = static_cast<int64_t>(r.seq);
-      }
-      ok = cl->CallBytesMultiOutV(kReplApply, keys.c_str(), ptrs.data(),
-                                  lens.data(), args.data(), out.data(),
-                                  n) == n;
-    }
+    bool ok = cl != nullptr && ShipReplBatch(cl, batch);
     {
       std::lock_guard<std::mutex> lk(mu);
       if (ok) {
-        wal_acked = batch.back().seq;
+        wal_acked = batch.back()->seq;
       } else {
         wal_dropped.fetch_add(static_cast<long long>(batch.size()),
                               std::memory_order_relaxed);
@@ -2831,6 +3319,131 @@ void ControlServer::ReplLoop() {
   if (cl != nullptr) {
     ::close(cl->fd);
     delete cl;
+  }
+}
+
+// Quorum-mode sender: one per target, all draining the shared WAL deque by
+// per-target cursor. Group commit is preserved per stream (a batch is
+// whatever accumulated since the last send), and wal_acked — the QUORUM
+// watermark — advances via ReplRecomputeAckedLocked as per-target acks
+// land, so concurrent handlers' commit waits overlap one inter-shard
+// round-trip exactly as in chain mode. Failure classification drives the
+// state machine: refused dials demote (definitive), partition/timeout
+// failures suspend-and-retry with the queue share retained (the peer may
+// be alive across the cut; heal resumes the stream from the cursor with
+// no gap and no rejoin).
+void ControlServer::ReplTargetLoop(ReplTarget* t) {
+  ControlClient* cl = nullptr;
+  auto drop_cl = [&] {
+    if (cl) {
+      ::close(cl->fd);
+      delete cl;
+      cl = nullptr;
+    }
+  };
+  std::vector<std::shared_ptr<const ReplRecord>> batch;
+  for (;;) {
+    batch.clear();
+    bool probe = false;  // suspect + idle: dial to detect heal
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      for (;;) {
+        if (stopping.load()) {
+          lk.unlock();
+          drop_cl();
+          return;
+        }
+        if (t->state == kTgtDown) {
+          // parked until the peer's rejoin kSnapshot pull re-arms us
+          if (cl) {
+            lk.unlock();
+            drop_cl();
+            lk.lock();
+            continue;
+          }
+          BoundedWaitMs(repl_cv, lk, 200);
+          continue;
+        }
+        if (t->state == kTgtSuspect && cl != nullptr) {
+          // the gate's partition sensing marked us suspect while the old
+          // connection still stands — drop it (the cut would sever it at
+          // next use anyway) so the probe dial below owns heal detection
+          lk.unlock();
+          drop_cl();
+          lk.lock();
+          continue;
+        }
+        if (!repl_q.empty() && repl_q.back()->seq > t->cursor) {
+          for (const auto& r : repl_q)
+            if (r->seq > t->cursor) batch.push_back(r);
+          t->cursor = repl_q.back()->seq;
+          break;
+        }
+        if (t->state == kTgtSuspect && cl == nullptr) {
+          probe = true;
+          break;
+        }
+        BoundedWaitMs(repl_cv, lk, 200);
+      }
+    }
+    if (cl == nullptr) {
+      const int group = PartGroupOfPort(listen_port);
+      int why = kDialOther;
+      int nfd = DialAndHandshake(t->host, t->port, secret, 0, group, &why);
+      if (nfd >= 0) {
+        cl = MakeReplClient(nfd, t->host, t->port, secret,
+                            -(100 + shard_idx), group);
+        std::lock_guard<std::mutex> lk(mu);
+        t->refused = 0;
+        if (t->state == kTgtSuspect) {
+          t->state = kTgtLive;  // healed: stream resumes from the cursor
+          repl_cv.notify_all();
+        }
+      } else {
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          if (!batch.empty())
+            t->cursor = batch.front()->seq - 1;  // resend after recovery
+          if (why == kDialRefused && ++t->refused >= 2) {
+            // two refused dials spanning a backoff: the listener is gone
+            ReplDemoteLocked(t);
+          } else if (t->state == kTgtLive) {
+            t->state = kTgtSuspect;
+            ReplRecomputeAckedLocked();
+            repl_cv.notify_all();
+          }
+        }
+        // pace the redial; bounded so stop() joins promptly
+        std::this_thread::sleep_for(std::chrono::milliseconds(200));
+        continue;
+      }
+    }
+    if (probe || batch.empty()) continue;
+    bool ok = ShipReplBatch(cl, batch);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (ok) {
+        if (batch.back()->seq > t->acked) t->acked = batch.back()->seq;
+        t->refused = 0;
+        quorum_acks.fetch_add(1, std::memory_order_relaxed);
+        if (t->state == kTgtSuspect) t->state = kTgtLive;
+        ReplRecomputeAckedLocked();
+        ReplTrimLocked();
+        repl_cv.notify_all();
+      } else {
+        // Established-connection failure: reset/timeout/injected cut —
+        // non-definitive. Rewind the cursor (the un-acked batch is still
+        // in the deque: trim only advances past ALL non-down acks) and
+        // let the dial path classify on the next pass.
+        t->cursor = batch.front()->seq - 1;
+        if (t->state == kTgtLive) {
+          t->state = kTgtSuspect;
+          ReplRecomputeAckedLocked();
+        }
+        repl_cv.notify_all();
+      }
+    }
+    if (!ok) drop_cl();
   }
 }
 
@@ -2853,6 +3466,81 @@ void bf_cp_fault(long long drop_after, int delay_ms, int trunc,
 
 long long bf_cp_fault_drops(void) { return g_fault_drops.load(); }
 long long bf_cp_fault_ops(void) { return g_fault_ops.load(); }
+
+// Arm the deterministic partition injector (BLUEFOG_CP_FAULT partition=
+// grammar; see runtime/native.py). port_groups maps listener ports to
+// sides: "port:group,port:group,...". self_group is the side THIS
+// process's ordinary clients sit on (-1 = ungrouped: only server-side
+// gates and group-bound replicator clients enforce the cut). The cut
+// activates start_after_s seconds from now (<= 0: immediately) and heals
+// itself heal_after_s seconds after activation (<= 0: only on an explicit
+// heal/disarm). Re-arming resets the cut counter.
+void bf_cp_partition(int self_group, const char* port_groups,
+                     double start_after_s, double heal_after_s) {
+  long long now = std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::system_clock::now().time_since_epoch())
+                      .count();
+  long long base =
+      now + (start_after_s > 0
+                 ? static_cast<long long>(start_after_s * 1e6)
+                 : 0);
+  {
+    std::lock_guard<std::mutex> g(g_part_mu);
+    g_part_port_group.clear();
+    std::string s = port_groups ? port_groups : "";
+    size_t pos = 0;
+    while (pos < s.size()) {
+      size_t end = s.find(',', pos);
+      if (end == std::string::npos) end = s.size();
+      std::string part = s.substr(pos, end - pos);
+      pos = end + 1;
+      if (part.empty()) continue;
+      size_t c = part.find(':');
+      if (c == std::string::npos) continue;
+      int port = std::atoi(part.substr(0, c).c_str());
+      int grp = std::atoi(part.substr(c + 1).c_str());
+      if (port > 0) g_part_port_group[port] = grp;
+    }
+    g_part_self_group = self_group;
+  }
+  g_part_start_us.store(start_after_s > 0 ? base : 0);
+  g_part_heal_us.store(
+      heal_after_s > 0 ? base + static_cast<long long>(heal_after_s * 1e6)
+                       : 0);
+  g_part_cuts.store(0);
+  g_part_armed.store(1);
+}
+
+// Heal the armed partition now (idempotent; the arm stays so the cut
+// counter and the healed state remain observable).
+void bf_cp_partition_heal(void) {
+  if (!g_part_armed.load()) return;
+  long long now = std::chrono::duration_cast<std::chrono::microseconds>(
+                      std::chrono::system_clock::now().time_since_epoch())
+                      .count();
+  g_part_heal_us.store(now);
+}
+
+void bf_cp_partition_disarm(void) {
+  g_part_armed.store(0);
+  g_part_start_us.store(0);
+  g_part_heal_us.store(0);
+  std::lock_guard<std::mutex> g(g_part_mu);
+  g_part_port_group.clear();
+  g_part_self_group = -1;
+}
+
+int bf_cp_partition_active(void) { return PartitionActiveNow() ? 1 : 0; }
+long long bf_cp_partition_cuts(void) { return g_part_cuts.load(); }
+
+// Bind one CLIENT handle to a partition side, overriding the process
+// default — an in-process multi-server test (or the soak's worker pool)
+// places each client on the side of the shard it represents.
+void bf_cp_client_set_group(void* h, int group) {
+  auto* cl = static_cast<ControlClient*>(h);
+  std::lock_guard<std::mutex> lk(cl->mu);
+  cl->part_group = group;
+}
 
 // rejoin_pending != 0 arms the rejoin gate ATOMICALLY with the bind: the
 // accept loop runs from construction, and a restarted shard must not
@@ -2881,6 +3569,15 @@ void* bf_cp_serve_auth3(int port, int world, const char* secret,
   }
   auto* srv = new ControlServer();
   srv->listen_fd = fd;
+  // The bound port (resolved for port 0) keys this server's partition
+  // group: QuorumOkLocked and the replicator threads look it up to decide
+  // which side of an armed cut this server sits on.
+  {
+    sockaddr_in bound{};
+    socklen_t blen = sizeof(bound);
+    if (::getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen) == 0)
+      srv->listen_port = ntohs(bound.sin_port);
+  }
   srv->world = world;
   srv->secret = secret ? secret : "";
   srv->max_box_bytes = max_mailbox_bytes;
@@ -2928,6 +3625,10 @@ void bf_cp_server_stop(void* handle) {
   ::close(srv->listen_fd);
   srv->accept_thread.join();
   if (srv->repl_thread.joinable()) srv->repl_thread.join();
+  // Quorum-mode per-target streams: the vector is append-only after
+  // set_successors, so iterating without the mutex is safe here.
+  for (auto& t : srv->repl_targets)
+    if (t->thread.joinable()) t->thread.join();
   // Wake every blocked handler (recv returns 0 after shutdown; cv waiters
   // see `stopping`), then wait for the detached handlers to drain so the
   // server is quiescent when stop() returns. Freeing is NOT done here:
@@ -2967,6 +3668,7 @@ void* bf_cp_connect_auth2(const char* host, int port, int rank,
   cl->rank = rank;
   cl->host = h;
   cl->port = port;
+  cl->cur_port = port;
   cl->secret = s;
   cl->sockbuf = sockbuf_bytes;
   cl->retries = static_cast<int>(EnvInt("BLUEFOG_CP_RETRIES", 3));
@@ -3055,6 +3757,77 @@ int bf_cp_server_set_successor(void* h, const char* host, int port,
   return 0;
 }
 
+// Quorum generalization (R >= 3): spec is "sidx:host:port;sidx:host:port;..."
+// naming this shard's R-1 ring successors. One entry degenerates to the
+// legacy chain above (same thread, same wire — R=2 stays byte-identical).
+// Two or more arm quorum mode: a dedicated stream thread per target, and
+// the commit rule becomes ack-from-ceil(R/2) replicas (self included)
+// before the primary replies — see ReplRecomputeAckedLocked.
+int bf_cp_server_set_successors(void* h, const char* spec, int nshards,
+                                int idx) {
+  // Parse outside the server lock; reject malformed specs before arming.
+  struct Tgt {
+    int idx;
+    std::string host;
+    int port;
+  };
+  std::vector<Tgt> tgts;
+  std::string s = spec ? spec : "";
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(';', pos);
+    if (end == std::string::npos) end = s.size();
+    std::string part = s.substr(pos, end - pos);
+    pos = end + 1;
+    if (part.empty()) continue;
+    size_t c1 = part.find(':');
+    size_t c2 = part.rfind(':');
+    if (c1 == std::string::npos || c2 == c1) return -2;
+    Tgt t;
+    t.idx = std::atoi(part.substr(0, c1).c_str());
+    t.host = part.substr(c1 + 1, c2 - c1 - 1);
+    t.port = std::atoi(part.substr(c2 + 1).c_str());
+    if (t.host.empty() || t.port <= 0 || t.idx < 0) return -2;
+    tgts.push_back(std::move(t));
+  }
+  if (tgts.empty()) return -2;
+  if (tgts.size() == 1)
+    return bf_cp_server_set_successor(h, tgts[0].host.c_str(), tgts[0].port,
+                                      nshards, idx);
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  if (srv->repl_cfg) return -1;
+  srv->shard_count = nshards;
+  srv->shard_idx = idx;
+  srv->repl_wait_sec = EnvSeconds("BLUEFOG_CP_REPL_TIMEOUT", 30.0);
+  long long depth = EnvInt("BLUEFOG_CP_WAL_DEPTH", 65536);
+  srv->repl_depth = depth > 0 ? static_cast<size_t>(depth) : 65536;
+  srv->quorum_mode = true;
+  // R = targets + 1 copies (self is one). Commit waits for ceil(R/2)
+  // REMOTE acks: at R=3 that is both successors, which is what makes an
+  // even split (2|2 at n=4) leave BOTH sides below quorum instead of
+  // minting two primaries. Definitive target deaths (down/dead-flagged)
+  // subtract from this at commit time — see EffectiveNeededLocked.
+  const int r = static_cast<int>(tgts.size()) + 1;
+  srv->needed_base = (r + 1) / 2;
+  if (srv->needed_base < 1) srv->needed_base = 1;
+  srv->repl_cfg = true;
+  srv->repl_live = true;
+  srv->rejoin_pending = false;
+  srv->RecomputeFoKeyspacesLocked();
+  srv->cv.notify_all();
+  for (const Tgt& t : tgts) {
+    auto rt = std::make_unique<ReplTarget>();
+    rt->idx = t.idx;
+    rt->host = t.host;
+    rt->port = t.port;
+    ReplTarget* raw = rt.get();
+    srv->repl_targets.push_back(std::move(rt));
+    raw->thread = std::thread([srv, raw] { srv->ReplTargetLoop(raw); });
+  }
+  return 0;
+}
+
 // Arm the rejoin gate: incoming kReplApply records park until the
 // catch-up completes (bf_cp_server_set_successor opens it). Call BEFORE
 // pulling the snapshots — the ring predecessor re-arms its stream the
@@ -3064,6 +3837,45 @@ void bf_cp_server_set_rejoin_pending(void* h) {
   auto* srv = static_cast<ControlServer*>(h);
   std::lock_guard<std::mutex> lk(srv->mu);
   srv->rejoin_pending = true;
+}
+
+// Drop the whole store and re-arm the rejoin gate — the guarded in-place
+// self-rejoin a shard performs after surviving on the minority side of a
+// healed partition: its local state may have diverged from the quorum
+// (acked ops the majority re-routed and re-decided), so it rebuilds from
+// replica snapshots exactly like a restarted process would, without
+// losing its listener or its clients' TCP endpoints. Barrier state is
+// deliberately kept: live waiters hold handler threads, and barrier
+// generations are not part of the replicated keyspace.
+void bf_cp_server_reset_store(void* h) {
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  srv->kv.clear();
+  srv->mailbox.clear();
+  srv->mailbox_origin.clear();
+  srv->box_bytes.clear();
+  srv->bytes_kv.clear();
+  srv->put_staging.clear();
+  srv->locks.clear();
+  srv->dedup.clear();
+  srv->rank_cids.clear();
+  srv->incarnations.clear();
+  srv->repl_fence.clear();
+  srv->fo_keyspaces.clear();
+  srv->rejoin_pending = true;
+  srv->cv.notify_all();
+}
+
+// Reopen the rejoin gate after an IN-PLACE self-rejoin (reset_store +
+// snapshot catch-up on a server whose successor streams were already
+// armed): set_successor(s) is one-shot, so the legacy gate-open path
+// never runs again for this process.
+void bf_cp_server_rejoin_done(void* h) {
+  auto* srv = static_cast<ControlServer*>(h);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  srv->rejoin_pending = false;
+  srv->RecomputeFoKeyspacesLocked();
+  srv->cv.notify_all();
 }
 
 // Pull a point-in-time snapshot over a CLIENT handle (kSnapshot). filter
@@ -3092,9 +3904,14 @@ int64_t bf_cp_snapshot(void* h, int64_t filter, void** out,
 // zero would put every post-rejoin record at or below the receiver's
 // stale fence, silently dropped-and-acked — lost on our next death.
 // Returns the number of records applied, or -1 on a malformed blob.
-long long bf_cp_server_load_snapshot(void* h, const void* data,
-                                     int64_t len, int set_fence,
-                                     int adopt_wal) {
+// src_idx names WHICH incoming stream the blob's fence belongs to: the
+// serving shard's ring index under quorum replication (its stream frames
+// carry rank -(100+src_idx)), or -2 for the legacy chain stream. The
+// repl_fence map is keyed the same way, so a rejoining shard can load one
+// snapshot per predecessor and fence each stream independently.
+long long bf_cp_server_load_snapshot2(void* h, const void* data,
+                                      int64_t len, int set_fence,
+                                      int adopt_wal, int src_idx) {
   auto* srv = static_cast<ControlServer*>(h);
   const char* p = static_cast<const char*>(data);
   if (len < 16) return -1;
@@ -3153,7 +3970,10 @@ long long bf_cp_server_load_snapshot(void* h, const void* data,
     off += pl;
     ++applied;
   }
-  if (set_fence) srv->repl_fence = fence;
+  if (set_fence) {
+    uint64_t& f = srv->repl_fence[src_idx];
+    if (fence > f) f = fence;  // newest fence wins across multi-source loads
+  }
   if (adopt_wal) {
     srv->wal_seq = resume;
     srv->wal_acked = resume;
@@ -3172,6 +3992,12 @@ long long bf_cp_server_load_snapshot(void* h, const void* data,
   return applied;
 }
 
+long long bf_cp_server_load_snapshot(void* h, const void* data,
+                                     int64_t len, int set_fence,
+                                     int adopt_wal) {
+  return bf_cp_server_load_snapshot2(h, data, len, set_fence, adopt_wal, -2);
+}
+
 // Client-side failover redirect: name the ring successor this client may
 // stick to when its primary stops answering (see ControlClient::Reconnect).
 void bf_cp_set_failover(void* h, const char* host, int port) {
@@ -3179,6 +4005,36 @@ void bf_cp_set_failover(void* h, const char* host, int port) {
   std::lock_guard<std::mutex> lk(cl->mu);
   cl->fo_host = host ? host : "";
   cl->fo_port = port;
+  cl->fo_chain.clear();
+  cl->fo_chain.emplace_back(host ? host : "", port);
+}
+
+// Multi-hop failover chain (quorum replication, R >= 3): spec is
+// "host:port,host:port,..." naming the ring successors in walk order.
+// Reconnect advances past runs of consecutive dead shards, sticking to
+// the first chain entry that answers (see ControlClient::Reconnect).
+void bf_cp_set_failover2(void* h, const char* spec) {
+  auto* cl = static_cast<ControlClient*>(h);
+  std::lock_guard<std::mutex> lk(cl->mu);
+  cl->fo_chain.clear();
+  std::string s = spec ? spec : "";
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t end = s.find(',', pos);
+    if (end == std::string::npos) end = s.size();
+    std::string part = s.substr(pos, end - pos);
+    pos = end + 1;
+    if (part.empty()) continue;
+    size_t c = part.rfind(':');
+    if (c == std::string::npos) continue;
+    std::string host = part.substr(0, c);
+    int port = std::atoi(part.substr(c + 1).c_str());
+    if (!host.empty() && port > 0) cl->fo_chain.emplace_back(host, port);
+  }
+  if (!cl->fo_chain.empty()) {
+    cl->fo_host = cl->fo_chain[0].first;
+    cl->fo_port = cl->fo_chain[0].second;
+  }
 }
 
 // 1 once this client permanently redirected to its failover target — the

@@ -32,6 +32,11 @@ Four analyzers over the repository (run all via ``python scripts/bfcheck``,
     Minimal pyflakes-style fallback (unused imports, duplicate
     definitions) used by ``make lint`` when ``ruff`` is not installed.
 
+``litter``
+    Runtime-debris guard: flight-recorder dumps (``bf_flight_*.json``)
+    sitting in the repository root are flagged — dumps belong under
+    ``BLUEFOG_FLIGHT_DIR``, never committed at the root.
+
 A finding can be waived at its line with ``# bfcheck: ok-<check-id>`` plus
 a justification; waivers are themselves flagged when they stop matching
 anything. Analyzer self-tests (seeded violations) live in
@@ -77,8 +82,8 @@ def repo_root(start: str = __file__) -> str:
 def _analyzers() -> Dict[str, Callable[[str], List[Diagnostic]]]:
     # imported lazily so ``import bfcheck`` stays cheap and fixture tests
     # can import individual analyzers directly
-    from . import (knob_check, lint_check, lock_check, metrics_check,
-                   protocol_check)
+    from . import (knob_check, lint_check, litter_check, lock_check,
+                   metrics_check, protocol_check)
 
     return {
         "protocol": protocol_check.check,
@@ -86,10 +91,11 @@ def _analyzers() -> Dict[str, Callable[[str], List[Diagnostic]]]:
         "locks": lock_check.check,
         "metrics": metrics_check.check,
         "lint": lint_check.check,
+        "litter": litter_check.check,
     }
 
 
-ANALYZERS = ("protocol", "knobs", "locks", "metrics", "lint")
+ANALYZERS = ("protocol", "knobs", "locks", "metrics", "lint", "litter")
 
 
 def run(name: str, root: str) -> List[Diagnostic]:

@@ -1,0 +1,46 @@
+"""Runtime-debris guard: flight dumps must never land in the repo root.
+
+A flight-recorder postmortem dump (``bf_flight_<rank>.json``) defaults to
+the process cwd when ``BLUEFOG_FLIGHT_DIR`` is unset, so any crashing or
+deliberately-dumping process launched from the repository root litters the
+tree — and the litter then gets committed and shipped. The test suite's
+conftest redirects its dumps to a throwaway temp dir; this analyzer
+backstops every OTHER entry point (benches, smokes, ad-hoc runs) by
+failing ``make check`` while a dump sits at the root, the same way a
+stray ``core`` file would be flagged in a C tree.
+
+Only the repository root is scanned: dumps under a temp dir, an
+explicitly configured ``BLUEFOG_FLIGHT_DIR``, or a test fixture tree are
+exactly where dumps belong.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from typing import List
+
+from . import Diagnostic
+
+# Patterns of per-process runtime dump files (see runtime/flight.py's
+# dump(): bf_flight_<rank>.json; bfrun --dump merges to bf_flight_all.json)
+LITTER_PATTERNS = ("bf_flight_*.json",)
+
+
+def check(root: str) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    try:
+        entries = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for fn in entries:
+        if not os.path.isfile(os.path.join(root, fn)):
+            continue
+        if any(fnmatch.fnmatch(fn, pat) for pat in LITTER_PATTERNS):
+            out.append(Diagnostic(
+                "litter", fn, 1,
+                "flight-recorder dump littering the repository root — "
+                "delete it (dumps belong under BLUEFOG_FLIGHT_DIR; a "
+                "process launched from the repo root with the default "
+                "config wrote it here)"))
+    return out

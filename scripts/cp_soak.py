@@ -85,6 +85,20 @@ def parse_args(argv=None):
                    help="restart the killed shard in place mid-run "
                         "(snapshot catch-up + even liveness generation) "
                         "and assert the ring converges back")
+    p.add_argument("--kill-pairs", action="store_true",
+                   help="correlated-failure mode (quorum replication, "
+                        "R=3): SIGKILL a shard AND its ring successor "
+                        "SIMULTANEOUSLY mid-run — zero deposit loss and "
+                        "counter continuity are still required (any R-1 "
+                        "deaths lose nothing)")
+    p.add_argument("--partition", action="store_true",
+                   help="partition mode (R=3): arm the deterministic "
+                        "network cut (first half | second half of the "
+                        "ring) mid-run; shards below their commit quorum "
+                        "reject mutating ops with the typed "
+                        "QuorumLostError until the cut heals, workers "
+                        "tolerate the rejections, and the mass/counter "
+                        "ledgers must still balance exactly")
     p.add_argument("--no-replication", action="store_true",
                    help="r14 mode: no WAL replication (restores the "
                         "documented one-cycle loss allowance)")
@@ -108,8 +122,19 @@ def parse_args(argv=None):
         args.clients = min(args.clients, 64)
         args.duration = min(args.duration, 18.0)
         args.churn = True
+    if args.kill_pairs:
+        if args.rejoin:
+            p.error("--kill-pairs and --rejoin are separate scenarios")
+        args.shards = max(args.shards, 3)  # a pair death needs a survivor
+        args.churn = False  # tolerant workers keep one attachment
+    if args.partition:
+        # 2|2 is the canonical symmetric cut; churn reattaches racing the
+        # window would make giveups nondeterministic, so partition mode
+        # runs without churn
+        args.shards = max(args.shards, 4)
+        args.churn = False
     if args.kill_shard is None:
-        args.kill_shard = args.shards - 1
+        args.kill_shard = -1 if args.partition else args.shards - 1
     return args
 
 
@@ -128,10 +153,12 @@ def raise_nofile(need: int) -> None:
 
 
 def spawn_shard(index: int, world: int, replicate: bool, port: int = 0,
-                rejoin: bool = False):
+                rejoin: bool = False, env: dict = None):
     """One shard server process. With replication the start is two-phase
     (PORT line -> peers over stdin -> READY line); the caller finishes it
-    with :func:`finish_shard_spawn` once every shard's port is known."""
+    with :func:`finish_shard_spawn` once every shard's port is known.
+    ``env`` overrides the inherited environment (partition mode arms the
+    cut on the SERVERS only — the workers stay ungrouped clients)."""
     cmd = [sys.executable, SHARD_SERVER, "--port", str(port), "--world",
            str(world), "--shard", str(index)]
     if replicate:
@@ -140,7 +167,7 @@ def spawn_shard(index: int, world: int, replicate: bool, port: int = 0,
         cmd.append("--rejoin")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stdin=subprocess.PIPE if replicate else None,
-                            text=True)
+                            text=True, env=env)
     marker = "BF_SHARD_PORT" if replicate else "BF_SHARD_READY"
     line = proc.stdout.readline()
     if not line.startswith(marker):
@@ -177,13 +204,15 @@ class Worker(threading.Thread):
     """One raw client: heartbeat + counter + lock + deposit/drain loop."""
 
     def __init__(self, wid: int, endpoints, deadline: float, churn: bool,
-                 record_bytes: int, replicated: bool) -> None:
+                 record_bytes: int, replicated: bool,
+                 quorum_tolerant: bool = False) -> None:
         super().__init__(daemon=True, name=f"soak-{wid}")
         self.wid = wid
         self.endpoints = endpoints
         self.deadline = deadline  # wall-clock (time.time) epoch
         self.churn = churn
         self.replicated = replicated
+        self.quorum_tolerant = quorum_tolerant
         self.rng = random.Random(1000 + wid)
         self.record_bytes = max(64, record_bytes)
         self.inc = 0
@@ -201,6 +230,9 @@ class Worker(threading.Thread):
         self.dead_seen: set = set()
         self.counter_eras = 1
         self.counter_acks = 0
+        self.quorum_rejects = 0   # typed QuorumLostError rejections seen
+        self.outstanding = 0      # deposited-not-yet-drained bytes
+        self.expected = None      # tolerant-mode exactly-once cursor
         self._trail: list = []  # last few (op, owner, pre, dead) probes
 
     def _attach(self) -> ShardRouter:
@@ -229,8 +261,89 @@ class Worker(threading.Thread):
             "giveups": self.reattach_giveups,
             "last_hb": self.last_hb, "dead_seen": sorted(self.dead_seen),
             "eras": self.counter_eras, "acks": self.counter_acks,
+            "qrejects": self.quorum_rejects,
             "alive": self.is_alive(),
         }
+
+    def _cycle_tolerant(self, r, ckey: str, box: str, hb: str) -> None:
+        """One load cycle under a possible partition window: any mutating
+        op may come back as the typed QuorumLostError (counted; nothing
+        is consumed — the server gate fires BEFORE apply, so a rejected
+        fetch_add keeps the exactly-once cursor intact and a rejected
+        append leaves no record behind). Deposits go one record at a time
+        (a rejected batch could hide a partial apply) and the mass ledger
+        runs on an OUTSTANDING model, because a drain may legitimately
+        trail its deposits across the cut window."""
+        from bluefog_tpu.runtime.native import QuorumLostError
+
+        try:
+            r.put(hb, self.last_hb + 1)
+            self.last_hb += 1
+        except QuorumLostError:
+            self.quorum_rejects += 1
+        try:
+            pre = r.fetch_add(ckey, 1)
+            self.counter_acks += 1
+            if self.expected is not None and pre != self.expected:
+                self.errors.append(
+                    f"counter continuity violation across the partition: "
+                    f"pre={pre} expected={self.expected} op={self.ops} "
+                    f"qrejects={self.quorum_rejects}")
+            self.expected = pre + 1
+        except QuorumLostError:
+            self.quorum_rejects += 1
+        for _ in range(self.rng.randint(1, 4)):
+            blob = bytes([self.rng.randint(0, 255)]) * \
+                self.rng.randint(64, self.record_bytes)
+            try:
+                if r.append_bytes(box, blob) >= 1:
+                    self.acked_bytes += len(blob)
+                    self.outstanding += len(blob)
+            except QuorumLostError:
+                self.quorum_rejects += 1
+                break
+        try:
+            drained = sum(len(x) for x in r.take_bytes(box))
+        except QuorumLostError:
+            self.quorum_rejects += 1
+            return
+        self.drained_bytes += drained
+        if drained > self.outstanding:
+            self.errors.append(
+                f"drained {drained} B > outstanding {self.outstanding} B "
+                "(duplicated deposit records)")
+            self.outstanding = 0
+        else:
+            self.outstanding -= drained
+
+    def _reconcile_outstanding(self, r, box: str) -> None:
+        """Post-deadline settle: the cut has healed (or should have) —
+        drain until every acked byte is accounted for; whatever stays
+        outstanding is genuinely lost and fails the soak."""
+        from bluefog_tpu.runtime.native import QuorumLostError
+
+        deadline = time.monotonic() + 20.0
+        while self.outstanding > 0 and time.monotonic() < deadline:
+            try:
+                drained = sum(len(x) for x in r.take_bytes(box))
+            except QuorumLostError:
+                time.sleep(0.3)
+                continue
+            if drained > self.outstanding:
+                self.errors.append(
+                    f"reconcile drained {drained} B > outstanding "
+                    f"{self.outstanding} B (duplicated deposit records)")
+                self.drained_bytes += drained
+                self.outstanding = 0
+                return
+            self.drained_bytes += drained
+            self.outstanding -= drained
+            if drained == 0 and self.outstanding > 0:
+                time.sleep(0.2)
+        if self.outstanding:
+            self.lost_bytes += self.outstanding
+            self.lost_cycles += 1
+            self.outstanding = 0
 
     def run(self) -> None:  # noqa: C901 — the soak loop is one scenario
         ckey = f"soak.ctr.{self.wid}"
@@ -246,6 +359,32 @@ class Worker(threading.Thread):
             return
         except Exception as exc:  # noqa: BLE001 — recorded, fails the soak
             self.errors.append(f"attach: {exc!r}")
+            return
+        if self.quorum_tolerant:
+            # partition / pair-kill mode: same load shape, but any
+            # mutating op may come back as the typed QuorumLostError
+            # while a cut is engaged or the survivor is still
+            # classifying its dead replica targets — tolerate, count,
+            # and settle the mass ledger after the deadline
+            next_poll = time.monotonic() + self.rng.uniform(0.5, 1.5)
+            try:
+                while time.time() < self.deadline:
+                    self.ops += 1
+                    self._cycle_tolerant(r, ckey, box, hb)
+                    if time.monotonic() >= next_poll:
+                        self.dead_seen |= r.poll_shard_health()
+                        next_poll = time.monotonic() + \
+                            self.rng.uniform(0.5, 1.5)
+                self._reconcile_outstanding(r, box)
+                self.dead_seen |= r.poll_shard_health()
+            except Exception as exc:  # noqa: BLE001 — fails the soak
+                self.errors.append(
+                    f"tolerant loop died at op {self.ops}: {exc!r}")
+            finally:
+                try:
+                    r.close()
+                except Exception:  # noqa: BLE001 — teardown
+                    pass
             return
         expected = None
         cur_owner = r.owner_of(ckey)
@@ -363,8 +502,10 @@ def run_workers(args, endpoints, deadline_wall: float,
     if args.worker_slice:
         base, count = (int(x) for x in args.worker_slice.split(":"))
     raise_nofile(8 * count + 512)
+    tolerant = args.partition or args.kill_pairs
     workers = [Worker(base + i, endpoints, deadline_wall, args.churn,
-                      args.record_bytes, replicated)
+                      args.record_bytes, replicated,
+                      quorum_tolerant=tolerant)
                for i in range(count)]
     for w in workers:
         w.start()
@@ -399,7 +540,31 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
     procs = args.procs or max(1, min(16, args.clients // 512))
     raise_nofile(8 * args.clients + 1024)
 
-    servers = [spawn_shard(i, 1, replicate) for i in range(args.shards)]
+    if args.kill_pairs or args.partition:
+        if not replicate:
+            print("cp_soak: --kill-pairs/--partition require replication",
+                  file=sys.stderr)
+            return 1
+        # quorum replication: every shard keeps R=3 copies (primary +
+        # BOTH ring successors), so a correlated pair death loses
+        # nothing and a symmetric cut demotes shards below quorum
+        # instead of minting two primaries
+        os.environ.setdefault("BLUEFOG_CP_REPLICATION", "3")
+    server_env = None
+    if args.partition:
+        half = args.shards // 2
+        spec = ("partition="
+                + ",".join(str(i) for i in range(half)) + "|"
+                + ",".join(str(i) for i in range(half, args.shards))
+                + f",part_after={0.35 * args.duration:.1f}"
+                + f",heal_after={0.3 * args.duration:.1f}")
+        # servers only: the workers stay ungrouped clients and can reach
+        # both sides of the cut — what they see is the typed rejection
+        server_env = dict(os.environ, BLUEFOG_CP_FAULT=spec)
+        print(f"cp_soak: partition injector armed on servers: {spec}")
+
+    servers = [spawn_shard(i, 1, replicate, env=server_env)
+               for i in range(args.shards)]
     finish_shard_spawn(servers, replicate)
     endpoints = [("127.0.0.1", port) for _, port in servers]
     print(f"cp_soak: {args.shards} shard(s) up "
@@ -407,9 +572,15 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
           f"{args.clients} client(s) over {procs} proc(es), "
           f"{args.duration:.0f}s"
           + (", churn" if args.churn else "")
-          + (", WAL replication" if replicate else ", NO replication")
-          + (f", SIGKILL shard {args.kill_shard} mid-run"
-             if args.kill_shard >= 0 else "")
+          + ((", quorum replication R="
+              + os.environ["BLUEFOG_CP_REPLICATION"])
+             if (args.kill_pairs or args.partition)
+             else (", WAL replication" if replicate else ", NO replication"))
+          + (f", SIGKILL pair {args.kill_shard}+"
+             f"{(args.kill_shard + 1) % args.shards} mid-run"
+             if args.kill_pairs else
+             (f", SIGKILL shard {args.kill_shard} mid-run"
+              if args.kill_shard >= 0 else ""))
           + (", rejoin mid-run" if args.rejoin else ""))
 
     deadline_wall = time.time() + args.duration
@@ -434,6 +605,10 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
                 cmd.append("--churn")
             if args.no_replication:
                 cmd.append("--no-replication")
+            if args.partition:
+                cmd.append("--partition")
+            if args.kill_pairs:
+                cmd.append("--kill-pairs")
             children.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                              text=True))
     else:
@@ -444,6 +619,7 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
 
     # --- shard kill / rejoin schedule (parent drives it) -------------------
     killed = None
+    killed_set: set = set()
     rejoined = False
 
     def rejoin_shard(idx: int, at_frac: float) -> bool:
@@ -469,12 +645,27 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
     if 0 <= args.kill_shard < args.shards:
         time.sleep(max(0.0, deadline_wall - time.time()
                        - 0.65 * args.duration))
-        victim, _ = servers[args.kill_shard]
-        victim.send_signal(signal.SIGKILL)
-        victim.wait()
-        killed = args.kill_shard
-        print(f"cp_soak: SIGKILLed shard {killed} at "
-              f"t+{0.35 * args.duration:.0f}s")
+        if args.kill_pairs:
+            # correlated failure: a shard AND its ring successor die in
+            # the same instant, mailboxes undrained — with R=3 the
+            # second successor still holds every acked byte
+            mate = (args.kill_shard + 1) % args.shards
+            for idx in (args.kill_shard, mate):
+                servers[idx][0].send_signal(signal.SIGKILL)
+            for idx in (args.kill_shard, mate):
+                servers[idx][0].wait()
+            killed = args.kill_shard
+            killed_set = {args.kill_shard, mate}
+            print(f"cp_soak: SIGKILLed shard pair {sorted(killed_set)} "
+                  f"SIMULTANEOUSLY at t+{0.35 * args.duration:.0f}s")
+        else:
+            victim, _ = servers[args.kill_shard]
+            victim.send_signal(signal.SIGKILL)
+            victim.wait()
+            killed = args.kill_shard
+            killed_set = {killed}
+            print(f"cp_soak: SIGKILLed shard {killed} at "
+                  f"t+{0.35 * args.duration:.0f}s")
         if args.rejoin:
             if not rejoin_shard(killed, 0.6):
                 return 1
@@ -517,7 +708,7 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
     if stuck:
         failures.append(f"{len(stuck)} client(s) never finished: "
                         f"{stuck[:10]}")
-    lossy_allowance = 0 if replicate else (1 if killed is not None else 0)
+    lossy_allowance = 0 if replicate else (1 if killed_set else 0)
     for w in ledgers:
         for e in w["errors"]:
             failures.append(f"client {w['wid']}: {e}")
@@ -531,11 +722,12 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
             failures.append(
                 f"client {w['wid']}: mass leak — acked {w['acked']} != "
                 f"drained {w['drained']} + lost {w['lost']}")
-        if killed is not None and not rejoined and not w["alive"] and \
-                not w["giveups"] and killed not in w["dead_seen"]:
+        if killed_set and not rejoined and not w["alive"] and \
+                not w["giveups"] and \
+                not killed_set <= set(w["dead_seen"]):
             failures.append(
-                f"client {w['wid']}: never converged on dead shard "
-                f"{killed} (saw {w['dead_seen']})")
+                f"client {w['wid']}: never converged on dead shard(s) "
+                f"{sorted(killed_set)} (saw {w['dead_seen']})")
     giveups = sum(w.get("giveups", 0) for w in ledgers)
     if giveups > max(1, args.clients // 200):
         failures.append(
@@ -548,14 +740,15 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
     deadline = time.monotonic() + 15.0
     while time.monotonic() < deadline:
         dead = probe.poll_shard_health()
-        want = set() if (killed is None or rejoined) else {killed}
+        want = set() if (not killed_set or rejoined) else killed_set
         if dead == want:
             break
         time.sleep(0.3)
-    if killed is not None and not rejoined and \
-            killed not in probe.dead_shards():
+    if killed_set and not rejoined and \
+            not killed_set <= probe.dead_shards():
         failures.append(
-            f"probe router did not converge on dead shard {killed}")
+            f"probe router did not converge on dead shard(s) "
+            f"{sorted(killed_set)} (saw {sorted(probe.dead_shards())})")
     if rejoined and probe.dead_shards():
         failures.append(
             f"ring did not converge back after rejoin (probe still sees "
@@ -574,6 +767,23 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
                     f"{name} repl={st['repl_status']} "
                     f"lag={st['wal_enqueued'] - st['wal_acked']} "
                     f"dropped={st['wal_dropped']}")
+    qrejects = sum(w.get("qrejects", 0) for w in ledgers)
+    if args.partition:
+        srv_rejects = 0
+        below_quorum = []
+        for name, st in probe.server_stats_all():
+            if st:
+                srv_rejects += int(st.get("partition_rejects", 0))
+                if st.get("quorum_state") == 2:
+                    below_quorum.append(name)
+        if not qrejects and not srv_rejects:
+            failures.append(
+                "partition mode: the cut never engaged — no typed "
+                "QuorumLostError anywhere (injector misarmed?)")
+        if below_quorum:
+            failures.append(
+                "partition did not heal: shard(s) still below commit "
+                f"quorum: {below_quorum}")
     probe.close()
 
     rss = {i: vm_rss_mb(proc.pid) for i, (proc, _) in enumerate(servers)
@@ -595,6 +805,7 @@ def main(argv=None) -> int:  # noqa: C901 — one scenario, one driver
           f"{sum(w['reattaches'] for w in ledgers)} churn reattaches "
           f"({giveups} giveups), "
           f"{sum(w['peer_lost'] for w in ledgers)} typed PeerLost, "
+          f"{qrejects} typed QuorumLost, "
           f"survivor RSS {max(rss.values()):.0f} MB, "
           f"wall {time.time() - t0:.1f}s")
     if repl_views:

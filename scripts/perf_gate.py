@@ -15,13 +15,16 @@ copy, a lost overlap) costs 2-10x.
 Only the *stable* quick-mode series gate: the hosted window ops
 (win_put / win_accumulate / win_update / win_get MB/s), the optimizer
 step rates, the ``hybrid.*`` plane-sweep rates (gating since r15), the
-``codec.*`` compressed-wire window-op rates (gating since r18), and —
-since r19, two stable rounds after r17 introduced them — the
-``sharded.*`` sharded-window series, including the counter-delta
-``wire_reduction_x`` ratios (deterministic byte accounting, the least
-noisy rows in the gate). Sub-millisecond raw-socket probes and the codec
-wire-leg probes (``drain_stream``: 2x run-to-run jitter) are reported in
-the JSON but never gate.
+``codec.*`` compressed-wire window-op rates (gating since r18), the
+``sharded.*`` sharded-window series (gating since r19), including the
+counter-delta ``wire_reduction_x`` ratios (deterministic byte
+accounting, the least noisy rows in the gate), and — since r20, two
+stable rounds after r18 introduced the serving plane — the ``serve.*``
+snapshot-pull throughput / scaling / int8-wire-ratio rows.
+Sub-millisecond raw-socket probes, the codec wire-leg probes
+(``drain_stream``: 2x run-to-run jitter), and the lower-better serving
+latency rows (``serve.p50_ms``/``p99_ms``) are reported in the JSON but
+never gate.
 
 Exit codes: 0 pass, 1 regression (or a bench failed), 2 usage/baseline
 problems.
@@ -136,12 +139,11 @@ def collect_once() -> dict:
                 f"{row.get('overlap')} failed: {row['error']}")
         out[f"hybrid.{row['mode']}.{row['plane']}.ov{row['overlap']}"
             ".img_per_sec"] = row["img_per_sec"]
-    # serving plane: `serve.*` is INFO-ONLY — kept out of the baseline
-    # (gating() drops it on --update-baseline) so every row renders as
-    # info, per the stable-series rule new series follow before they
-    # graduate. The latency rows are LOWER-better: they must be inverted
-    # (or replaced by a rate) before ever gating under compare()'s
-    # higher-is-better band.
+    # serving plane: `serve.*` GATES since r20 (two stable rounds after
+    # r18 introduced it, per the stable-series rule) — the pull
+    # throughput rows, the net scaling ratio, and the counter-delta int8
+    # wire ratio; the lower-better latency rows (p50/p99 ms) stay info
+    # (see gating()).
     text = _run([sys.executable, "scripts/serve_bench.py", "--quick"],
                 timeout=900)
     for line in text.splitlines():
@@ -182,6 +184,19 @@ def gating(metrics: dict) -> dict:
             # codec.* GATES since r18 (two stable rounds elapsed since
             # r15), but only its stable window-op series — the wire-leg
             # probes (drain_stream) jitter 2x run to run and stay info
+            continue
+        if name.startswith("serve."):
+            # serve.* GATES since r20 (two stable rounds elapsed since
+            # r18 introduced the serving plane, per the stable-series
+            # rule): the snapshot-pull throughput rows, the sharded
+            # net scaling ratio, and the counter-delta int8 wire ratio.
+            # The LATENCY rows (p50/p99 ms) stay info-only: they are
+            # lower-better, and compare()'s band is higher-is-better —
+            # they would need inverting (or replacing with a rate)
+            # before they could ever gate.
+            if name.endswith("_ms"):
+                continue
+            keep[name] = v
             continue
         if name.startswith("opt.") or name.startswith("hybrid.") or \
                 name.startswith("codec.") or \
@@ -237,7 +252,8 @@ def bench_doc(metrics: dict, repeats: int, band: float) -> dict:
                           "opt_matrix_bench --quick --modes "
                           + " ".join(_OPT_MODES),
                           "opt_matrix_bench --quick --hybrid",
-                          "serve_bench --quick (serve.* INFO-ONLY)"],
+                          "serve_bench --quick (serve.* gating since "
+                          "r20; latency rows info-only)"],
             "note": "quick-mode numbers: gate-relative only, meaningless "
                     "as absolute throughput (see PERF.md for real runs)",
         },

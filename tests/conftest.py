@@ -55,6 +55,34 @@ def cpu_devices(n=8):
     return devs[:n]
 
 
+# The BLUEFOG_FLIGHT_DIR redirect above keeps this process's dumps out of
+# the tree, but subprocess-spawning tests that scrub or rebuild their env
+# could still let a crashing child dump into its cwd — the repo root. Any
+# new bf_flight_*.json at the root after the run is a harness regression
+# (and `make check`'s litter analyzer would flag the file as debris), so
+# fail loudly here with the responsible pattern named.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _root_flight_dumps():
+    import glob
+
+    return set(glob.glob(os.path.join(_REPO_ROOT, "bf_flight_*.json")))
+
+
+_flight_dumps_before = _root_flight_dumps()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    leaked = _root_flight_dumps() - _flight_dumps_before
+    if leaked:
+        raise pytest.UsageError(
+            "test run littered the repository root with flight-recorder "
+            f"dump(s): {sorted(os.path.basename(p) for p in leaked)} — "
+            "point the responsible test's BLUEFOG_FLIGHT_DIR at a temp "
+            "dir (see conftest.py)")
+
+
 @pytest.fixture()
 def bf8():
     """bluefog_tpu initialized over 8 virtual devices, default Expo-2 topo."""

@@ -18,8 +18,8 @@ import pytest
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
 
 import bfcheck  # noqa: E402
-from bfcheck import (knob_check, lint_check, lock_check,  # noqa: E402
-                     metrics_check, protocol_check)
+from bfcheck import (knob_check, lint_check, litter_check,  # noqa: E402
+                     lock_check, metrics_check, protocol_check)
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -611,3 +611,32 @@ def test_metrics_rate_suffix_resolves_only_rate_series(tmp_path):
     diags = metrics_check.check(root)
     assert len(diags) == 1
     assert "alert rule 'gone'" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# litter analyzer fixtures
+# ---------------------------------------------------------------------------
+
+def test_litter_clean_fixture(tmp_path):
+    (tmp_path / "bluefog_tpu").mkdir()
+    (tmp_path / "csrc").mkdir()
+    assert litter_check.check(str(tmp_path)) == []
+
+
+def test_litter_flags_flight_dump_at_root(tmp_path):
+    (tmp_path / "bluefog_tpu").mkdir()
+    (tmp_path / "csrc").mkdir()
+    (tmp_path / "bf_flight_0.json").write_text("{}")
+    diags = litter_check.check(str(tmp_path))
+    assert len(diags) == 1
+    assert diags[0].path == "bf_flight_0.json"
+    assert "BLUEFOG_FLIGHT_DIR" in diags[0].message
+
+
+def test_litter_ignores_dumps_below_root(tmp_path):
+    # dumps inside a subdirectory (a configured flight dir, a fixture
+    # tree) are exactly where dumps belong — only the root is litter
+    (tmp_path / "bluefog_tpu").mkdir()
+    (tmp_path / "dumps").mkdir()
+    (tmp_path / "dumps" / "bf_flight_3.json").write_text("{}")
+    assert litter_check.check(str(tmp_path)) == []

@@ -81,13 +81,19 @@ def test_fault_layer_off_by_default(server):
     cl.close()
 
 
+_SPEC_DEFAULTS = {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0,
+                  "delay_edges": {}, "partition": None, "part_after": 0.0,
+                  "heal_after": 0.0}
+
+
+def _spec(**over):
+    return {**_SPEC_DEFAULTS, **over}
+
+
 def test_parse_fault_spec_grammar():
     assert native.parse_fault_spec("drop_after=37,delay_ms=50,trunc=1,seed=7") \
-        == {"drop_after": 37, "delay_ms": 50, "trunc": 1, "seed": 7,
-            "delay_edges": {}}
-    assert native.parse_fault_spec("drop_after=5") == \
-        {"drop_after": 5, "delay_ms": 0, "trunc": 0, "seed": 0,
-         "delay_edges": {}}
+        == _spec(drop_after=37, delay_ms=50, trunc=1, seed=7)
+    assert native.parse_fault_spec("drop_after=5") == _spec(drop_after=5)
     assert native.parse_fault_spec("")["drop_after"] == 0
     with pytest.raises(ValueError):
         native.parse_fault_spec("drop_every=5")
@@ -95,13 +101,36 @@ def test_parse_fault_spec_grammar():
         native.parse_fault_spec("drop_after")
 
 
+def test_parse_fault_spec_partition():
+    """ISSUE r20: the partition clause — `|` sides with bare comma
+    continuation, part_after/heal_after floats, composition with the
+    scalar knobs — and the malformed-spec red paths."""
+    cfg = native.parse_fault_spec("partition=0,1|2,3")
+    assert cfg["partition"] == [[0, 1], [2, 3]]
+    assert cfg["part_after"] == 0.0 and cfg["heal_after"] == 0.0
+    cfg = native.parse_fault_spec(
+        "partition=0,1,2|3,heal_after=2.5,part_after=1")
+    assert cfg["partition"] == [[0, 1, 2], [3]]
+    assert cfg["part_after"] == 1.0 and cfg["heal_after"] == 2.5
+    # composes with the scalar knobs in either order
+    cfg = native.parse_fault_spec("drop_after=4,partition=0|1,seed=9")
+    assert cfg["drop_after"] == 4 and cfg["seed"] == 9
+    assert cfg["partition"] == [[0], [1]]
+    for bad in ("partition=0,1", "partition=0,1|1,2", "partition=a|b",
+                "heal_after=2.5"):  # heal without a partition spec is fine
+        if bad == "heal_after=2.5":
+            assert native.parse_fault_spec(bad)["heal_after"] == 2.5
+            continue
+        with pytest.raises(ValueError):
+            native.parse_fault_spec(bad)
+
+
 def test_parse_fault_spec_delay_edges():
     """ISSUE r16: the per-edge asymmetric-delay clause — `;`/`|`
     separators, comma continuation after the clause, composition with
     the scalar knobs — and the malformed-term red path."""
     assert native.parse_fault_spec("delay_edges=0>1:80") == \
-        {"drop_after": 0, "delay_ms": 0, "trunc": 0, "seed": 0,
-         "delay_edges": {(0, 1): 80}}
+        _spec(delay_edges={(0, 1): 80})
     # multi-edge: `;` and `|` separators, plus bare comma continuation
     assert native.parse_fault_spec("delay_edges=0>1:80;2>3:40") \
         ["delay_edges"] == {(0, 1): 80, (2, 3): 40}
@@ -1500,15 +1529,18 @@ def test_shard_kill_mid_gossip_run_completes(monkeypatch):
 # ---------------------------------------------------------------------------
 
 def _spawn_shard_repl(i: int, port: int = 0, rejoin: bool = False,
-                      world: int = 1):
+                      world: int = 1, env=None):
     """Phase 1 of a replicated shard spawn: returns (proc, port) after the
-    BF_SHARD_PORT line; finish with :func:`_finish_repl_spawn`."""
+    BF_SHARD_PORT line; finish with :func:`_finish_repl_spawn`. ``env``
+    replaces the child environment (server-only knobs like a
+    ``BLUEFOG_CP_FAULT`` partition spec that must NOT leak into the test
+    process); None inherits."""
     cmd = [sys.executable, str(SHARD_SERVER), "--port", str(port),
            "--world", str(world), "--shard", str(i), "--expect-peers"]
     if rejoin:
         cmd.append("--rejoin")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stdin=subprocess.PIPE, text=True)
+                            stdin=subprocess.PIPE, text=True, env=env)
     line = proc.stdout.readline()
     assert line.startswith("BF_SHARD_PORT"), f"shard {i}: {line!r}"
     return proc, int(line.split()[1])
@@ -2050,6 +2082,370 @@ def test_repl_kill_with_undrained_mailboxes_mid_optimizer(monkeypatch):
         bf.shutdown()
         cp.reset_for_test()
         _stop_shards(servers)
+
+
+# ---------------------------------------------------------------------------
+# quorum durability (r20): replication factor R, correlated-failure
+# survival, partition-aware fencing
+# ---------------------------------------------------------------------------
+
+def _quorum_warm(r, deadline_s: float = 25.0) -> None:
+    """Drive writes until the survivor re-admits them. After a correlated
+    R-1 kill the survivor's WAL targets pass through SUSPECT before the
+    definitive socket errors classify them DOWN; mutating ops in that
+    window are rejected typed, and only the DOWN verdicts shrink the
+    effective quorum back under what is still standing."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            r.put("bf.t.quorum.warm", 1)
+            return
+        except native.QuorumLostError:
+            assert time.monotonic() < deadline, \
+                "survivor never re-admitted writes after the correlated kill"
+            time.sleep(0.05)
+
+
+@pytest.fixture()
+def quorum_trio(monkeypatch):
+    """Three real shard server PROCESSES at ``BLUEFOG_CP_REPLICATION=3``
+    (SIGKILL-able): every acked record is committed on ALL three shards
+    (quorum = 2 remote acks), so ANY two simultaneous deaths lose
+    nothing. The env is set before the spawn so the children arm their
+    quorum WAL streams AND the test process's routers walk the R-aware
+    failover chain (two hops past a run of consecutive dead shards)."""
+    monkeypatch.setenv("BLUEFOG_CP_BACKOFF_MS", "20")
+    monkeypatch.setenv("BLUEFOG_CP_REPLICATION", "3")
+    servers = [_spawn_shard_repl(i) for i in range(3)]
+    _finish_repl_spawn(servers)
+    yield servers
+    native.fault_disarm()
+    _stop_shards(servers)
+
+
+def test_quorum_pair_kill_zero_loss(quorum_trio):
+    """THE r20 tentpole acceptance: SIGKILL a shard AND its ring
+    successor in the same instant — the r16 chain's unsurvivable case
+    (both copies of the dead shard's keyspace gone). At R=3 the acked
+    state lives on all three shards, so the single survivor serves every
+    undrained deposit byte for byte and continues the counter with
+    exactly-once semantics across BOTH deaths; the router walks the
+    two-hop failover chain past the run of consecutive dead shards."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    r = ShardRouter(_endpoints(quorum_trio), 0, streams=1)
+    rng = np.random.default_rng(_seed(53))
+    # an undrained mailbox on EACH doomed shard + a counter on shard 1
+    boxes = {s: next(f"qp.box.{j}" for j in range(64)
+                     if r.shard_of(f"qp.box.{j}") == s) for s in (1, 2)}
+    ctr = next(f"qp.ctr.{j}" for j in range(64)
+               if r.shard_of(f"qp.ctr.{j}") == 1)
+    blobs = {s: [bytes(rng.integers(0, 256,
+                                    size=int(rng.integers(200, 4000)),
+                                    dtype=np.uint8)) for _ in range(8)]
+             for s in (1, 2)}
+    for s in (1, 2):
+        assert all(rep >= 1 for rep in
+                   r.append_bytes_many([boxes[s]] * 8, blobs[s]))
+    assert [r.fetch_add(ctr, 1) for _ in range(20)] == list(range(20))
+    p1, _ = quorum_trio[1]
+    p2, _ = quorum_trio[2]
+    p1.send_signal(signal.SIGKILL)   # the shard AND its ring successor,
+    p2.send_signal(signal.SIGKILL)   # dying with full mailboxes
+    p1.wait()
+    p2.wait()
+    _quorum_warm(r)
+    assert [r.fetch_add(ctr, 1) for _ in range(20)] == list(range(20, 40)), \
+        "counter not exactly-once across the correlated pair kill"
+    for s in (1, 2):
+        drained = [bytes(x) for lst in r.take_bytes_many([boxes[s]])
+                   for x in lst]
+        assert drained == blobs[s], (
+            f"shard {s}: lost deposits across the pair kill — "
+            f"{len(drained)}/{len(blobs[s])} records survived")
+    assert r.dead_shards() == {1, 2}
+    r.close()
+
+
+def test_quorum_kill_pair_mid_optimizer_oracle_exact(monkeypatch):
+    """Chaos demo (acceptance): a hosted-window job over THREE quorum-
+    replicated shards (R=3) loses a shard and its ring successor in the
+    same instant while every deposit mailbox is NON-EMPTY — win_put
+    queued deposits across all three shards, nothing drained. win_update
+    must drain everything from the single survivor: the all-rank result
+    matches the fault-free numpy oracle EXACTLY (a lost record would
+    break the uniform average), and both deaths are reported typed."""
+    import bluefog_tpu as bf
+    import jax.numpy as jnp
+
+    from conftest import cpu_devices
+
+    monkeypatch.setenv("BLUEFOG_CP_REPLICATION", "3")
+    monkeypatch.setenv("BLUEFOG_CP_BACKOFF_MS", "20")
+    servers = [_spawn_shard_repl(i) for i in range(3)]
+    _finish_repl_spawn(servers)
+    try:
+        eps = ",".join(f"127.0.0.1:{p}" for _, p in servers)
+        for k, v in {
+            "BLUEFOG_CP_HOSTS": eps,
+            "BLUEFOG_CP_WORLD": "1",
+            "BLUEFOG_CP_RANK": "0",
+            "BLUEFOG_WIN_PLANE": "hosted",
+            "BLUEFOG_WIN_HOST_PLANE": "1",
+        }.items():
+            monkeypatch.setenv(k, v)
+        cp.reset_for_test()
+        bf.init(devices=cpu_devices(8))
+        assert cp.active()
+        assert getattr(cp.client(), "shard_count", 1) == 3
+        xs = (np.arange(16, dtype=np.float64) ** 2).reshape(8, 2)
+        x = jnp.asarray(xs, jnp.float32)
+        assert bf.win_create(x, "r20.demo")
+        try:
+            bf.win_put(x, "r20.demo")   # deposits queued, NOT drained
+            for s in (1, 2):
+                doomed, _ = servers[s]
+                doomed.send_signal(signal.SIGKILL)
+            for s in (1, 2):
+                servers[s][0].wait()
+            _quorum_warm(cp.client())
+            got = np.asarray(bf.win_update("r20.demo"))
+            topo = bf.load_topology()
+            want = np.zeros_like(xs)
+            for rk in range(8):
+                nbrs = bf.topology_util.in_neighbor_ranks(topo, rk)
+                want[rk] = (xs[rk] + sum(xs[s] for s in nbrs)) / (
+                    len(nbrs) + 1)
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+            assert cp.client().dead_shards() == {1, 2}
+        finally:
+            bf.win_free("r20.demo")
+    finally:
+        bf.shutdown()
+        cp.reset_for_test()
+        _stop_shards(servers)
+
+
+def test_quorum_partition_minority_typed_rejection():
+    """Partition fencing, in-process: four quorum-replicated servers
+    (R=3) under an asymmetric 3|1 cut. Ring geometry decides survival —
+    only shard 0 keeps BOTH ring successors (1, 2) on its side; shards 1
+    and 2 each lose one WAL stream across the cut and shard 3 (the true
+    minority) loses both, so all three fall below the 2-ack commit
+    quorum and degrade to READ-ONLY with the typed error while shard 0
+    serves uninterrupted. A cut classifies targets SUSPECT, never DOWN
+    (the relaxation that would split-brain a symmetric cut), so the
+    quorum requirement never shrinks while the cut stands. Healing lets
+    the idle-probe dials reconnect the streams and every shard
+    re-admits writes, with the cut trail preserved in the cumulative
+    ``partition_rejects`` counter."""
+    servers = [native.ControlPlaneServer(1, _free_port())
+               for _ in range(4)]
+    cls = []
+    try:
+        ports = [s.port for s in servers]
+        for i, s in enumerate(servers):
+            s.set_successors(
+                [((i + k) % 4, "127.0.0.1", ports[(i + k) % 4])
+                 for k in (1, 2)], 4, i)
+        cls = [native.ControlPlaneClient("127.0.0.1", p, 0, streams=1)
+               for p in ports]
+        for i, cl in enumerate(cls):
+            cl.put(f"mn.seed.{i}", i + 10)
+        for i, s in enumerate(servers):
+            assert s.stats()["quorum_state"] == 1, f"shard {i} not at quorum"
+        native.partition_arm({ports[0]: 0, ports[1]: 0, ports[2]: 0,
+                              ports[3]: 1})
+        assert native.partition_active()
+
+        def drive_until_fenced(i: int) -> str:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    cls[i].put(f"mn.k{i}", 1)
+                    time.sleep(0.05)
+                except native.QuorumLostError as exc:
+                    return str(exc)
+            raise AssertionError(f"shard {i} never fenced its writes")
+
+        msg = drive_until_fenced(3)
+        assert "quorum" in msg
+        # below quorum is READ-ONLY, not dead: reads stay served
+        assert cls[3].get("mn.seed.3") == 13
+        for i in (1, 2):
+            drive_until_fenced(i)
+        # shard 0 never notices: both its streams are on-side
+        for n in range(20):
+            cls[0].put("mn.k0", n)
+        assert cls[0].get("mn.k0") == 19
+        st = [s.stats() for s in servers]
+        assert st[0]["quorum_state"] == 1
+        assert [st[i]["quorum_state"] for i in (1, 2, 3)] == [2, 2, 2]
+        assert sum(s["partition_rejects"] for s in st) >= 3
+        assert native.partition_cuts() > 0
+        native.partition_heal()
+        assert not native.partition_active()
+        for i in (1, 2, 3):
+            deadline = time.monotonic() + 20
+            while True:
+                try:
+                    cls[i].put(f"mn.heal{i}", i)
+                    break
+                except native.QuorumLostError:
+                    assert time.monotonic() < deadline, \
+                        f"shard {i} never re-admitted writes after the heal"
+                    time.sleep(0.05)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+                s.stats()["quorum_state"] != 1 for s in servers):
+            time.sleep(0.05)
+        assert all(s.stats()["quorum_state"] == 1 for s in servers), \
+            "a shard stayed below quorum after the heal"
+        # the reject counter is a cumulative trail, not live state
+        # (bfrun --status --strict keys off quorum_state, not this)
+        assert sum(s.stats()["partition_rejects"] for s in servers) >= 3
+    finally:
+        native.partition_disarm()
+        for cl in cls:
+            cl.close()
+        for s in servers:
+            s.stop()
+
+
+def test_quorum_partition_heal_exactly_once_counter(monkeypatch):
+    """End-to-end partition-then-heal over real shard PROCESSES: four
+    shards at R=3 arm the deterministic injector from a server-only
+    ``BLUEFOG_CP_FAULT`` partition spec (the cp_soak --partition wire).
+    At a symmetric 2|2 cut EVERY shard has a successor on each side, so
+    all four fall below quorum — the client sees typed rejections, and
+    because the gate fires BEFORE the mutation, a rejection consumes
+    NOTHING: once the cut self-heals, the fetch_add cursor continues
+    exactly where the successes left off. The full success sequence must
+    be one contiguous range — a gap is a rejected-but-applied op, a
+    repeat is a lost apply."""
+    from bluefog_tpu.runtime.router import ShardRouter
+
+    monkeypatch.setenv("BLUEFOG_CP_BACKOFF_MS", "20")
+    monkeypatch.setenv("BLUEFOG_CP_REPLICATION", "3")
+    # a WIDE cut window (10 s): a sanitizer build under full-suite load
+    # can take several seconds to spawn + attach, and the cut clock
+    # starts at server arming — the window must comfortably outlive it
+    fault = "partition=0,1|2,3,part_after=2,heal_after=10"
+    env = dict(os.environ, BLUEFOG_CP_FAULT=fault)
+    servers = [_spawn_shard_repl(i, env=env) for i in range(4)]
+    _finish_repl_spawn(servers)
+    try:
+        r = ShardRouter(_endpoints(servers), 0, streams=1)
+        vals, rejects, post = [], 0, 0
+        # healthy phase: drive the counter until the cut engages. The
+        # replicator's idle-probe dials flip quorum_state server-side
+        # without any client help, so an attach slow enough to miss the
+        # whole pre-cut phase still synchronizes here instead of racing
+        # the heal clock.
+        engaged = False
+        deadline = time.monotonic() + 90
+        while not engaged and time.monotonic() < deadline:
+            try:
+                vals.append(r.fetch_add("ph.ctr", 1))
+            except native.QuorumLostError:
+                rejects += 1
+                break
+            engaged = any(st is not None and st["quorum_state"] == 2
+                          for _, st in r.server_stats_all())
+        assert engaged or rejects, "the injected cut never engaged"
+        # fenced phase through the self-heal: rejections consume nothing
+        deadline = time.monotonic() + 90
+        while post < 25 and time.monotonic() < deadline:
+            try:
+                vals.append(r.fetch_add("ph.ctr", 1))
+                if rejects:
+                    post += 1
+            except native.QuorumLostError:
+                rejects += 1
+                time.sleep(0.05)
+        assert rejects, "no typed rejection while below quorum"
+        assert post >= 25, "writes never resumed after the self-heal"
+        assert vals == list(range(len(vals))), \
+            "fetch_add not exactly-once across the partition episode"
+        # the episode left a server-side trail; the cluster healed above
+        # quorum (drive a little traffic while the streams re-arm)
+        deadline = time.monotonic() + 20
+        healed = False
+        while time.monotonic() < deadline and not healed:
+            try:
+                r.put("ph.tick", 1)
+            except native.QuorumLostError:
+                pass  # a sibling shard's streams may re-arm a beat later
+            stats = [st for _, st in r.server_stats_all() if st is not None]
+            healed = (len(stats) == 4 and
+                      all(st["quorum_state"] == 1 for st in stats))
+            time.sleep(0.1)
+        assert healed, "a shard stayed below quorum after the heal"
+        assert sum(st["partition_rejects"] for _, st in r.server_stats_all()
+                   if st is not None) > 0
+        assert r.dead_shards() == set()
+        r.close()
+    finally:
+        native.fault_disarm()
+        _stop_shards(servers)
+
+
+def test_quorum_r2_single_target_wire_identical_to_chain():
+    """R=2 regression pin: ``set_successors`` with ONE target (what
+    shard_server issues at the default ``BLUEFOG_CP_REPLICATION=2``) IS
+    the r16 chain — same wire, quorum machinery disarmed. Drive an
+    identical deterministic op sequence through a legacy
+    ``set_successor`` ring and a single-target ``set_successors`` ring:
+    the server telemetry must be IDENTICAL (every op/WAL counter, with
+    ``quorum_state`` 0 and zero quorum acks on both) and the replica's
+    snapshot blob byte-identical — any divergence means the quorum
+    generalization changed the default wire."""
+    def drive(wire):
+        s0 = native.ControlPlaneServer(1, _free_port())
+        s1 = native.ControlPlaneServer(1, _free_port())
+        try:
+            if wire == "chain":
+                s0.set_successor("127.0.0.1", s1.port, 2, 0)
+            else:
+                s0.set_successors([(1, "127.0.0.1", s1.port)], 2, 0)
+            cl = native.ControlPlaneClient("127.0.0.1", s0.port, 0,
+                                           streams=1)
+            for i in range(30):
+                cl.put(f"pin.k{i}", i * 7)
+            assert [cl.fetch_add("pin.ctr", 3) for _ in range(10)] == \
+                [3 * i for i in range(10)]
+            assert cl.put_max("pin.gen", 8) == 8
+            assert cl.append_bytes("pin.box", b"record-" + bytes(64)) == 1
+            cl.put_bytes("pin.row", b"\x01\x02" * 512)
+            cl.close()
+            # chain commit: client replies already waited for the ack.
+            # Only the close itself is async — wait for the connection
+            # reap so live_connections compares deterministically.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    s0.stats()["live_connections"]:
+                time.sleep(0.02)
+            st0, st1 = s0.stats(), s1.stats()
+            assert st0["wal_acked"] == st0["wal_enqueued"] > 0
+            assert st0["repl_status"] == 1
+            assert st0["quorum_state"] == st1["quorum_state"] == 0, \
+                "quorum machinery armed on the default R=2 wire"
+            assert st0["quorum_acks"] == 0
+            rep = native.ControlPlaneClient("127.0.0.1", s1.port, 1,
+                                            streams=1)
+            blob = bytes(rep.snapshot())
+            rep.close()
+            return st0, blob
+        finally:
+            s1.stop()
+            s0.stop()
+
+    chain_stats, chain_blob = drive("chain")
+    quorum_stats, quorum_blob = drive("single-target")
+    assert quorum_stats == chain_stats, \
+        "R=2 single-target telemetry diverged from the legacy chain"
+    assert quorum_blob == chain_blob, \
+        "R=2 single-target replica snapshot diverged from the legacy chain"
 
 
 # ---------------------------------------------------------------------------

@@ -90,6 +90,14 @@ def test_gating_filter_keeps_stable_series_only():
         # counter-delta wire_reduction_x ratios
         "sharded.f32.sharded_s2.win_put.mbps": 1.0,
         "sharded.f32.s4.wire_reduction_x": 4.0,
+        # r18 serving plane: GATING since r20 — throughput / scaling /
+        # wire-ratio rows gate; the lower-better latency rows stay info
+        # (compare()'s band is higher-is-better)
+        "serve.pull_mbps_4shard_net": 900.0,
+        "serve.pull_scaling_x_net": 3.0,
+        "serve.int8_wire_ratio": 4.0,
+        "serve.p50_ms": 6.0,                 # latency: out
+        "serve.p99_ms": 500.0,               # latency: out
     }
     kept = pg.gating(metrics)
     assert set(kept) == {"win.f32.win_put.mbps", "win.f32.win_update.mbps",
@@ -99,7 +107,10 @@ def test_gating_filter_keeps_stable_series_only():
                          "codec.int8.f32.win_put.mbps",
                          "codec.topk:0.01.f32.win_update.mbps",
                          "sharded.f32.sharded_s2.win_put.mbps",
-                         "sharded.f32.s4.wire_reduction_x"}
+                         "sharded.f32.s4.wire_reduction_x",
+                         "serve.pull_mbps_4shard_net",
+                         "serve.pull_scaling_x_net",
+                         "serve.int8_wire_ratio"}
 
 
 # ---------------------------------------------------------------------------
@@ -132,6 +143,14 @@ def test_committed_baseline_is_sound():
                for k in metrics)
     assert any(k.startswith("sharded.") and k.endswith(".wire_reduction_x")
                for k in metrics)
+    # serve.* graduated to gating in r20: measured pull-throughput,
+    # scaling, and wire-ratio rows committed; NO latency (lower-better)
+    # row may ever be baked in under the higher-is-better band
+    assert any(k.startswith("serve.pull_mbps_") for k in metrics)
+    assert "serve.pull_scaling_x_net" in metrics
+    assert "serve.int8_wire_ratio" in metrics
+    assert not any(k.startswith("serve.") and k.endswith("_ms")
+                   for k in metrics)
 
 
 # ---------------------------------------------------------------------------
