@@ -8,7 +8,8 @@ MESH_ENV    = JAX_PLATFORMS='' XLA_FLAGS=--xla_force_host_platform_device_count=
 .PHONY: test test_fast test_ops test_win_ops test_optimizers test_parallel \
         test_launcher test_models bench chaos dryrun native scaling \
         lm_bench metrics-smoke flight-smoke soak-smoke obs-smoke \
-        tune-smoke serve-smoke perf-gate lint bfcheck check tsan asan
+        tune-smoke serve-smoke slo-smoke perf-gate lint bfcheck check \
+        tsan asan
 
 # Test files replayed under the sanitizers: the chaos suite (reconnect /
 # dedup / fencing churn) plus the striped-transport + hosted-window stress
@@ -83,6 +84,18 @@ serve-smoke:     ## serving-plane acceptance: 2-rank trainer publishing
                  ## attaching from a separate process (docs/serving.md)
 	JAX_PLATFORMS=cpu python scripts/serve_smoke.py
 
+slo-smoke:       ## request-path tracing + SLO-engine acceptance
+                 ## (docs/slo.md): < 2 µs per-request trace record gate,
+                 ## a publisher child + traced serve client where a
+                 ## fault-injected pull delay fires the staleness
+                 ## burn-rate alert (bfrun --top shows the SLO section,
+                 ## --status --strict exits 2 on budget exhaustion) and
+                 ## recovery clears it; the client+publisher flight
+                 ## rings merge into ONE chrome trace with a cross-
+                 ## process stripe flow pair and the snapshot lineage
+                 ## resolving to its exact train step
+	JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+
 soak-smoke:      ## durable sharded-control-plane churn soak, quick mode
                  ## (<= 4 min): WAL-replicated shard server processes,
                  ## ~64 raw clients with incarnation churn, one injected
@@ -145,7 +158,7 @@ asan:            ## AddressSanitizer build of csrc + the same replay.
 	    ASAN_OPTIONS="detect_leaks=0 exitcode=66" \
 	    JAX_PLATFORMS=cpu $(PYTEST) $(SANITIZE_TESTS) -q -m "not slow"
 
-chaos: check metrics-smoke flight-smoke obs-smoke tune-smoke serve-smoke soak-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
+chaos: check metrics-smoke flight-smoke obs-smoke tune-smoke serve-smoke slo-smoke soak-smoke perf-gate  ## tier-1 chaos subset, fault injection replayed at TWO
                  ## seed offsets (BLUEFOG_CHAOS_SEED shifts every armed drop
                  ## point, so reconnect/dedup/fencing — and the telemetry
                  ## counters asserted against them — face different drop sites)

@@ -735,6 +735,38 @@ def _shard_drift_findings(cl, world: int) -> List[str]:
     return findings
 
 
+def _slo_budget_findings(cl) -> List[str]:
+    """Exhausted serving error budgets for ``--status --strict``: any
+    live serve client whose published ``slo.budget.<kind>`` gauge is at
+    or below zero has burned its whole window budget (docs/slo.md)."""
+    from .runtime import timeseries as _ts
+    from .serving import snapshot as _snap
+
+    findings: List[str] = []
+    try:
+        cids = _snap.live_client_ids(cl)
+    except (OSError, RuntimeError):
+        return findings
+    acc = _ts.HistoryAccumulator()
+    for cid in cids:
+        r = _ts.SERVE_TS_RANK_BASE + cid
+        doc = _ts.read_rank(cl, r)
+        if doc is None:
+            continue
+        acc.update(r, doc)
+        for (rank, name) in sorted(acc.series):
+            if rank != r or not name.startswith("slo.budget."):
+                continue
+            v = acc.latest(r, name)
+            if v is not None and v <= 0.0:
+                kind = name[len("slo.budget."):]
+                findings.append(
+                    f"serve client {cid}: {kind} SLO error budget "
+                    f"exhausted ({v * 100:.1f}% remaining over the slow "
+                    "burn window — docs/slo.md)")
+    return findings
+
+
 def _status(args) -> int:
     """``bfrun --status``: the cluster-health view from outside the job.
 
@@ -810,6 +842,7 @@ def _status(args) -> int:
             findings = _strict_findings(health)
             findings.extend(
                 _shard_drift_findings(cl, health["world"]))
+            findings.extend(_slo_budget_findings(cl))
             if serve_st is not None:
                 lag = serve_st.get("publish_lag_s")
                 stale_s = float(knob_env("BLUEFOG_SERVE_STALE_S"))
@@ -1008,6 +1041,119 @@ def _format_tune_section(cl, world: int) -> str:
     return "\n".join(lines)
 
 
+def _format_slo_section(acc, cids) -> str:
+    """Render the serving SLO view for the ``--top`` frame: per-client
+    error-budget gauges, fast/slow burn rates, and per-phase request
+    latency percentiles from the serve clients' published streams
+    (``bf.ts.<SERVE_TS_RANK_BASE + cid>``). Empty string when no client
+    declared SLOs or enabled tracing (BLUEFOG_SLO/BLUEFOG_TRACE_SERVE
+    unset — the common case)."""
+    from .runtime import flight as _flight
+    from .runtime import timeseries as _ts
+
+    lines: List[str] = []
+    for cid in cids:
+        r = _ts.SERVE_TS_RANK_BASE + cid
+        budgets = sorted(
+            name for (rank, name) in acc.series
+            if rank == r and name.startswith("slo.budget."))
+        p50 = acc.latest(r, "slo.request_p50_us")
+        p99 = acc.latest(r, "slo.request_p99_us")
+        if not budgets and p99 is None:
+            continue
+        active = {a.get("name") for a in acc.alerts.get(r, [])
+                  if str(a.get("name", "")).startswith("slo.")}
+        rate = acc.latest(r, "slo.requests.rate")
+        shed = acc.latest(r, "slo.shed.rate")
+        head = f"    client {cid}:"
+        if rate is not None:
+            head += f" {rate:.1f} req/s"
+            if shed:
+                head += f" ({shed:.1f} shed/s)"
+        if p99 is not None:
+            head += (f" | req p50/p99 {p50 or 0:.0f}/{p99:.0f} us")
+        stale = acc.latest(r, "slo.staleness_p99_ver")
+        if stale is not None:
+            head += f" | staleness p99 {stale:.0f} ver"
+        lines.append(head)
+        for name in budgets:
+            kind = name[len("slo.budget."):]
+            budget = acc.latest(r, name)
+            fast = acc.latest(r, f"slo.burn.{kind}.fast") or 0.0
+            slow = acc.latest(r, f"slo.burn.{kind}.slow") or 0.0
+            if budget is None:
+                continue
+            if budget <= 0.0:
+                flag = "EXHAUSTED"
+            elif f"slo.{kind}" in active:
+                flag = "BURNING"
+            else:
+                flag = "ok"
+            lines.append(
+                f"      {kind}: budget {budget * 100:6.1f}%  "
+                f"burn {fast:.2f}x fast / {slow:.2f}x slow  [{flag}]")
+        phases = []
+        for p in _flight.SERVE_PHASES:
+            pp50 = acc.latest(r, f"slo.phase.{p}.p50_us")
+            pp99 = acc.latest(r, f"slo.phase.{p}.p99_us")
+            if pp99 is not None:
+                phases.append(f"{p} {pp50 or 0:.0f}/{pp99:.0f}")
+        if phases:
+            lines.append("      phases p50/p99 us: " + "  ".join(phases))
+    if not lines:
+        return ""
+    return "\n".join(["  SERVING SLO (docs/slo.md)"] + lines)
+
+
+def _format_quorum_section(cl, episodes: dict) -> str:
+    """The ``--top`` QUORUM line (r20 durability plane): per-shard
+    commit-quorum state from ``server_stats_all`` with partition-episode
+    start/heal wall-clock timestamps tracked across frames in
+    ``episodes`` (shard name -> mutable record). Empty string when the
+    plane is unsharded or replication is off (quorum n/a everywhere)."""
+    if not hasattr(cl, "server_stats_all"):
+        return ""
+    try:
+        stats = list(cl.server_stats_all())
+    except (OSError, RuntimeError):
+        return ""
+    now = time.time()
+
+    def _hms(t):
+        return time.strftime("%H:%M:%S", time.localtime(t))
+
+    held = lost = 0
+    terms: List[str] = []
+    for name, st in stats:
+        ep = episodes.setdefault(
+            name, {"state": 0, "since": None, "last": None, "count": 0})
+        q = 0 if st is None else int(st.get("quorum_state", 0))
+        if q == 2 and ep["state"] != 2:
+            ep["since"] = now
+            ep["count"] += 1
+        elif q != 2 and ep["state"] == 2 and ep["since"] is not None:
+            ep["last"] = (ep["since"], now)
+            ep["since"] = None
+        ep["state"] = q
+        if q == 1:
+            held += 1
+        elif q == 2:
+            lost += 1
+            rejects = int(st.get("partition_rejects", 0)) if st else 0
+            since = _hms(ep["since"]) if ep["since"] else "?"
+            terms.append(f"{name}: LOST since {since} "
+                         f"({rejects} partition reject(s))")
+        if q != 2 and ep["last"] is not None:
+            t0, t1 = ep["last"]
+            terms.append(f"{name}: healed {_hms(t0)}->{_hms(t1)}")
+    if held + lost == 0:
+        return ""  # replication off: no quorum plane to report
+    line = f"  QUORUM: {held}/{held + lost} shard(s) held"
+    if terms:
+        line += " | " + " | ".join(terms)
+    return line
+
+
 def _top(args) -> int:
     """``bfrun --top``: the live cluster dashboard.
 
@@ -1030,6 +1176,7 @@ def _top(args) -> int:
     if cl is None:
         return 1
     acc = _ts.HistoryAccumulator()
+    quorum_eps: dict = {}
     try:
         while True:
             world = args.world or _discover_world(cl)
@@ -1041,6 +1188,21 @@ def _top(args) -> int:
             tune = _format_tune_section(cl, world)
             if tune:
                 frame += "\n" + tune
+            from .serving import snapshot as _snap
+            try:
+                cids = _snap.live_client_ids(cl)
+            except (OSError, RuntimeError):
+                cids = []
+            for cid in cids:
+                doc = _ts.read_rank(cl, _ts.SERVE_TS_RANK_BASE + cid)
+                if doc is not None:
+                    acc.update(_ts.SERVE_TS_RANK_BASE + cid, doc)
+            slo = _format_slo_section(acc, cids)
+            if slo:
+                frame += "\n" + slo
+            quorum = _format_quorum_section(cl, quorum_eps)
+            if quorum:
+                frame += "\n" + quorum
             dead = _report_dead_shards(cl, "--top") \
                 if hasattr(cl, "dead_shard_endpoints") else []
             if dead:

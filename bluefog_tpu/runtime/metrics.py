@@ -516,6 +516,16 @@ _HELP_EXACT: Dict[str, str] = {
     "cp.client.striped_transfers": "whole striped put/get transfers",
     "cp.fault.ops": "client ops seen by the fault injector since arm",
     "cp.fault.drops": "connections killed by the fault injector since arm",
+    "slo.requests": "serve requests submitted (admitted + shed) — the "
+                    "burn-rate denominator (docs/slo.md)",
+    "slo.shed": "serve requests refused by the admission gate — the "
+                "availability-SLO error numerator",
+    "slo.request_us": "end-to-end serve request latency (microseconds, "
+                      "submit to reply)",
+    "slo.staleness_ver": "snapshot versions between the fence and the "
+                         "version that answered each request",
+    "trace.requests": "serve requests traced into the flight ring "
+                      "(BLUEFOG_TRACE_SERVE; docs/slo.md)",
 }
 
 _HELP_PREFIX = (
@@ -527,6 +537,16 @@ _HELP_PREFIX = (
     ("cp.server.ops.", "control-plane server dispatches, by op class"),
     ("cp.server.", "control-plane server state/event counter"),
     ("win.", "hosted window data-plane op latency (seconds)"),
+    ("slo.breach.", "serve requests that violated this SLO kind's "
+                    "target, by objective (docs/slo.md)"),
+    ("slo.burn.", "SLO error-budget burn rate over the fast/slow window, "
+                  "by objective (docs/slo.md)"),
+    ("slo.budget.", "fraction of the slow-window SLO error budget "
+                    "remaining, by objective (<= 0 = exhausted)"),
+    ("slo.phase.", "per-phase serve request latency percentile from the "
+                   "trace analyzer (microseconds)"),
+    ("slo.", "serving-plane SLO series (docs/slo.md)"),
+    ("trace.", "serve request-path tracing series (docs/slo.md)"),
 )
 
 # Instrument-name prefix families the tree may create (first dotted
@@ -534,7 +554,7 @@ _HELP_PREFIX = (
 # resolution for every creation site in the package — a new family must
 # be added here (with curated HELP coverage) before it can ship.
 _PREFIX_FAMILIES = ("alert", "cp", "hb", "membership", "opt", "pushsum",
-                    "serve", "tune", "watchdog", "win")
+                    "serve", "slo", "trace", "tune", "watchdog", "win")
 
 
 def help_for(name: str) -> str:

@@ -63,6 +63,12 @@ from .logging import logger
 TS_KEY_FMT = "bf.ts.{rank}"
 ALERTS_KEY_FMT = "bf.alerts.{rank}"
 
+# Serve clients publish their own time-series docs in a rank band far
+# above any trainer world size (``bf.ts.<4096 + cid>``), so client and
+# trainer publications never collide and a consumer can tell the planes
+# apart by rank alone.
+SERVE_TS_RANK_BASE = 4096
+
 _PACK_MAGIC = b"BFT1"
 
 # (resolution seconds, ring slots): ~4 min at 1 s, 1 h at 10 s, 6 h at
@@ -93,6 +99,30 @@ TS_BINDINGS: Tuple[Tuple[str, str, str], ...] = (
     ("cp.under_replicated", "gauge", "max"),
     ("cp.server.mailbox_records", "gauge", "max"),
     ("cp.server.mailbox_bytes", "gauge", "max"),
+    # serving-plane SLO series (docs/slo.md) — recorded by ServeClient,
+    # absent (and silently skipped) in processes that never serve
+    ("slo.requests", "counter", "last"),
+    ("slo.shed", "counter", "last"),
+    ("slo.breach.serve_p50", "counter", "last"),
+    ("slo.breach.serve_p99", "counter", "last"),
+    ("slo.breach.serve_avail", "counter", "last"),
+    ("slo.breach.serve_staleness", "counter", "last"),
+    ("slo.request_p50_us", "gauge", "last"),
+    ("slo.request_p99_us", "gauge", "max"),
+    ("slo.staleness_p99_ver", "gauge", "max"),
+    ("slo.phase.admit.p50_us", "gauge", "last"),
+    ("slo.phase.admit.p99_us", "gauge", "last"),
+    ("slo.phase.queue.p50_us", "gauge", "last"),
+    ("slo.phase.queue.p99_us", "gauge", "last"),
+    ("slo.phase.swap_blocked.p50_us", "gauge", "last"),
+    ("slo.phase.swap_blocked.p99_us", "gauge", "last"),
+    ("slo.phase.linger.p50_us", "gauge", "last"),
+    ("slo.phase.linger.p99_us", "gauge", "last"),
+    ("slo.phase.decode.p50_us", "gauge", "last"),
+    ("slo.phase.decode.p99_us", "gauge", "last"),
+    ("slo.phase.reply.p50_us", "gauge", "last"),
+    ("slo.phase.reply.p99_us", "gauge", "last"),
+    ("trace.requests", "counter", "last"),
 )
 
 # Series the sampler computes itself (no registry instrument behind
@@ -111,6 +141,8 @@ RATE_SERIES: Tuple[str, ...] = (
     "win.deposits_sent",
     "win.deposits_drained",
     "win.drain_bytes",
+    "slo.requests",
+    "slo.shed",
 )
 
 
@@ -378,6 +410,86 @@ class _RuleState:
         self.value = 0.0
 
 
+# -- SLO objectives (docs/slo.md) --------------------------------------------
+
+# The closed kind vocabulary keeps every derived series name static, so
+# the bfcheck [metrics] analyzer can resolve the whole namespace.
+SLO_KINDS = ("serve_p50", "serve_p99", "serve_avail", "serve_staleness")
+
+# slow burn window = fast window x this (the classic 5m/1h pairing)
+SLO_SLOW_FACTOR = 12.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One declarative serving objective.
+
+    ``target`` is in the kind's native unit: microseconds for the
+    latency kinds, snapshot versions for staleness, percent for
+    availability. ``budget`` is the allowed error fraction the burn rate
+    is measured against (p99 -> 1%, p50 -> 50%, staleness -> 1%,
+    availability -> 1 - target)."""
+
+    name: str
+    target: float
+    window_s: float
+    budget: float
+
+
+def _parse_duration_s(tok: str) -> float:
+    tok = tok.strip().lower()
+    for suf, mult in (("ms", 1e-3), ("us", 1e-6), ("h", 3600.0),
+                      ("m", 60.0), ("s", 1.0)):
+        if tok.endswith(suf):
+            return float(tok[:-len(suf)]) * mult
+    return float(tok)
+
+
+def parse_slos(spec) -> Tuple[SLO, ...]:
+    """``BLUEFOG_SLO`` grammar — comma-separated ``kind:target@window``:
+
+      ``serve_p99:50ms@5m``       at most 1% of requests slower than
+                                  50 ms, burn measured over a 5 m fast
+                                  window (and a 12x slow window)
+      ``serve_p50:2ms@1m``        at most 50% slower than 2 ms
+      ``serve_avail:99.9@1h``     at least 99.9% of requests admitted
+      ``serve_staleness:3ver@5m`` at most 1% answered more than 3
+                                  snapshot versions behind the fence
+
+    A malformed term is warned about and skipped (telemetry config must
+    never take a job down); the window defaults to 5 m when omitted."""
+    out: List[SLO] = []
+    if not spec:
+        return tuple(out)
+    for term in str(spec).split(","):
+        term = term.strip()
+        if not term:
+            continue
+        try:
+            kind, _, rest = term.partition(":")
+            kind = kind.strip()
+            if kind not in SLO_KINDS or not rest:
+                raise ValueError(f"unknown SLO kind {kind!r}")
+            tgt, _, win = rest.partition("@")
+            window_s = max(1.0, _parse_duration_s(win)) if win else 300.0
+            tgt = tgt.strip().lower()
+            if kind == "serve_avail":
+                pct = float(tgt.rstrip("%"))
+                target, budget = pct, max(1e-6, 1.0 - pct / 100.0)
+            elif kind == "serve_staleness":
+                target = float(tgt[:-3]) if tgt.endswith("ver") \
+                    else float(tgt)
+                budget = 0.01
+            else:
+                target = _parse_duration_s(tgt) * 1e6  # -> microseconds
+                budget = 0.5 if kind == "serve_p50" else 0.01
+            out.append(SLO(kind, target, window_s, budget))
+        except (ValueError, IndexError) as exc:
+            logger.warning("BLUEFOG_SLO: skipping malformed term %r (%s)",
+                           term, exc)
+    return tuple(out)
+
+
 # -- the store ---------------------------------------------------------------
 
 _PENDING_FLOWS_CAP = 4096     # unmatched starts retained for matching
@@ -405,6 +517,9 @@ class TimeSeriesStore:
         self._seq = 0
         self._rules = parse_rules(knob_env("BLUEFOG_ALERT_RULES"))
         self._rule_state = {r.name: _RuleState() for r in self._rules}
+        self._slos = parse_slos(knob_env("BLUEFOG_SLO"))
+        self._slo_state = {o.name: _RuleState() for o in self._slos}
+        self._slo_burn = float(knob_env("BLUEFOG_SLO_BURN"))
         # raw (t, v) consensus samples for the mixing-rate fit: the 1 s
         # tier collapses several same-second samples into one slot, and
         # the fit wants every point
@@ -463,6 +578,7 @@ class TimeSeriesStore:
         self._scan_flows(now)
         self._derive(now)
         self._evaluate_rules(now)
+        self._evaluate_slos(now)
         self._last_sample = now
 
     def _record_rate(self, name: str, now: float, v: float) -> None:
@@ -593,12 +709,99 @@ class TimeSeriesStore:
                 st.breach_since = None
                 st.active = False
 
+    def _window_delta(self, name: str, span: float) -> Optional[float]:
+        s = self._series.get(name)
+        if s is None:
+            return None
+        t, v = s.window(span)
+        if len(t) < 2:
+            return None
+        return float(v[-1] - v[0])
+
+    def _evaluate_slos(self, now: float) -> None:
+        """Multi-window burn-rate evaluation (docs/slo.md): for each
+        objective, the error fraction over the fast (declared) window
+        and a ``SLO_SLOW_FACTOR``x slow window, each divided by the
+        error budget. ``alert.slo.<kind>`` fires when BOTH burn rates
+        exceed ``BLUEFOG_SLO_BURN`` — a fast-only spike doesn't page, a
+        long-gone burst aging through the slow window alone doesn't
+        either — and clears as soon as the fast window recovers. The
+        windows do the sustaining, so there is no ``for_sec`` here."""
+        if not self._slos:
+            return
+        from . import flight as _flight
+        from . import metrics as _metrics
+
+        for obj in self._slos:
+            err_series = "slo.shed" if obj.name == "serve_avail" \
+                else f"slo.breach.{obj.name}"
+            burns = {}
+            for tag, win in (("fast", obj.window_s),
+                             ("slow", obj.window_s * SLO_SLOW_FACTOR)):
+                dreq = self._window_delta("slo.requests", win)
+                derr = self._window_delta(err_series, win)
+                err = (derr / dreq) if dreq and derr is not None else 0.0
+                burns[tag] = err / obj.budget
+                self.series(f"slo.burn.{obj.name}.{tag}", "gauge",
+                            "last").add(now, burns[tag])
+            # error budget left in the slow window; <= 0 is exhaustion
+            # (the --status --strict exit-2 signal)
+            self.series(f"slo.budget.{obj.name}", "gauge", "last").add(
+                now, 1.0 - burns["slow"])
+            st = self._slo_state[obj.name]
+            st.value = burns["fast"]
+            if burns["fast"] >= self._slo_burn and \
+                    burns["slow"] >= self._slo_burn:
+                if st.breach_since is None:
+                    st.breach_since = now
+                if not st.active:
+                    st.active = True
+                    _metrics.counter("alert.fired").inc()
+                    _flight.recorder().instant(f"alert.slo.{obj.name}",
+                                               a=burns["fast"])
+                    logger.warning(
+                        "SLO alert slo.%s: burn rate fast %.2f / slow "
+                        "%.2f over threshold %.2f (budget %.4f) — "
+                        "docs/slo.md", obj.name, burns["fast"],
+                        burns["slow"], self._slo_burn, obj.budget)
+            elif burns["fast"] < self._slo_burn:
+                if st.active:
+                    _flight.recorder().instant(
+                        f"alert.slo.{obj.name}.clear", a=burns["fast"])
+                st.breach_since = None
+                st.active = False
+
+    def slo_status(self) -> List[dict]:
+        """Per-objective burn/budget snapshot (``--top``'s SLO section
+        and the ``--status --strict`` budget-exhaustion finding)."""
+        out = []
+        for obj in self._slos:
+            def _last(name):
+                s = self._series.get(name)
+                return s.last_v if s is not None and s.last_t else None
+
+            out.append({
+                "name": obj.name, "target": obj.target,
+                "window_s": obj.window_s, "budget": obj.budget,
+                "burn_fast": _last(f"slo.burn.{obj.name}.fast"),
+                "burn_slow": _last(f"slo.burn.{obj.name}.slow"),
+                "budget_remaining": _last(f"slo.budget.{obj.name}"),
+                "active": self._slo_state[obj.name].active,
+            })
+        return out
+
     def active_alerts(self) -> List[dict]:
         out = []
         for rule in self._rules:
             st = self._rule_state[rule.name]
             if st.active:
                 out.append({"name": rule.name, "series": rule.series,
+                            "since": st.breach_since, "value": st.value})
+        for obj in self._slos:
+            st = self._slo_state[obj.name]
+            if st.active:
+                out.append({"name": f"slo.{obj.name}",
+                            "series": f"slo.burn.{obj.name}.fast",
                             "since": st.breach_since, "value": st.value})
         return out
 

@@ -27,6 +27,9 @@ path — and runs three concerns on top of it:
 
 from __future__ import annotations
 
+import itertools
+import json
+import os
 import queue as _queue
 import threading
 import time
@@ -36,10 +39,25 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..runtime import flight as _flight
+from ..runtime import metrics as _metrics
 from ..runtime.config import knob_env
 from ..runtime.logging import logger
 from ..runtime.router import _fnv64
 from . import snapshot as _snap
+
+# Sliding window for the tick-time latency/staleness percentile gauges
+# (slotted numpy ring — the per-request store is two array writes).
+_PCT_RING = 512
+
+# admission verdict codes carried in the serve.admit span-end `a` column
+_ADMIT_CODE = {"accept": 0.0, "queue": 1.0, "shed": 2.0}
+
+# names the tracer pre-interns at attach so the per-request hot path is
+# pure rec() calls (no dict hashing beyond one lookup per span edge)
+_TRACE_NAMES = ("serve.req", "serve.admit", "serve.queue", "serve.linger",
+                "serve.decode", "serve.pull", "serve.pull.ep",
+                "serve.failover", "serve.snap")
 
 
 class RequestShed(RuntimeError):
@@ -109,6 +127,48 @@ class ServeClient:
                        "accepted": 0, "queued": 0, "shed": 0,
                        "requests": 0, "batches": 0}
 
+        # -- request-path tracing + SLO recording (docs/slo.md) -----------
+        # Both are opt-in; with the knobs unset NOTHING below records,
+        # publishes, or changes the wire (the zero-touch pin).
+        from ..runtime.timeseries import parse_slos
+
+        self._trace = bool(knob_env("BLUEFOG_TRACE_SERVE"))
+        self._slos = parse_slos(knob_env("BLUEFOG_SLO"))
+        self._fence_ver = 0      # latest fence the poller saw (staleness)
+        self._failover_open = False
+        self._rec = None
+        self._nid: Dict[str, int] = {}
+        if self._trace:
+            r = _flight.recorder()
+            self._rec = r
+            self._nid = {n: r.intern(n) for n in _TRACE_NAMES}
+            # 63-bit trace ids: random high bits per client, low bits a
+            # GIL-atomic counter — collision-free enough for a merge
+            self._tid_base = (int.from_bytes(os.urandom(6), "little")
+                              << 16) & 0x7FFFFFFFFFFFFFFF
+            self._tid_iter = itertools.count(1)
+            self._m_traced = _metrics.counter("trace.requests")
+        self._ts = None
+        if self._slos:
+            from ..runtime.timeseries import TimeSeriesStore
+
+            self._ts = TimeSeriesStore()
+            self._m_req = _metrics.counter("slo.requests")
+            self._m_shed = _metrics.counter("slo.shed")
+            self._m_lat_h = _metrics.histogram(
+                "slo.request_us",
+                bounds=(100, 250, 500, 1000, 2500, 5000, 10000, 25000,
+                        50000, 100000, 250000, 1000000))
+            self._m_stal_h = _metrics.histogram(
+                "slo.staleness_ver", bounds=(0, 1, 2, 3, 5, 8, 13, 21, 34))
+            self._m_breach = {o.name: _metrics.counter("slo.breach."
+                                                       + o.name)
+                              for o in self._slos}
+            self._lat_ring = np.zeros(_PCT_RING, np.float64)
+            self._lat_n = 0
+            self._stal_ring = np.zeros(_PCT_RING, np.float64)
+            self._stal_n = 0
+
         qmax = int(knob_env("BLUEFOG_SERVE_QUEUE_MAX"))
         soft = int(knob_env("BLUEFOG_SERVE_QUEUE_SOFT")) or max(1, qmax // 2)
         self._qmax, self._qsoft = qmax, min(soft, qmax)
@@ -121,10 +181,9 @@ class ServeClient:
 
         self._cid = -1
         if register:
-            try:
-                self._cid = int(self._cl.fetch_add(_snap.CLIENTS_KEY, 1))
-            except (OSError, RuntimeError):
-                pass  # registration is observability, not correctness
+            # reuses expired heartbeat slots so bf.serve.client.<cid>
+            # keys stay bounded by the peak concurrent client count
+            self._cid = _snap.claim_client_slot(self._cl)
 
         self._threads: List[threading.Thread] = []
         if start:
@@ -152,12 +211,14 @@ class ServeClient:
         self._threads = []
         while True:  # fail anything still parked in the queue
             try:
-                _, fut = self._q.get_nowait()
+                item = self._q.get_nowait()
             except _queue.Empty:
                 break
+            fut = item[1]
             if not fut.done():
                 fut.set_exception(RequestShed("serve client closed",
                                               gate="closed"))
+        _snap.release_client_slot(self._cl, self._cid)
         with self._bulk_mu:
             for cl in self._bulk.values():
                 try:
@@ -237,6 +298,10 @@ class ServeClient:
 
         def pull_group(idx: int, positions: List[int]) -> None:
             t0 = time.perf_counter()
+            rec = self._rec
+            if rec is not None:
+                rec.rec(_flight.SPAN_B, self._nid["serve.pull.ep"],
+                        0.0, idx)
             try:
                 blobs = self._bulk_client(idx).get_bytes_many(
                     [keys[p] for p in positions])
@@ -249,7 +314,20 @@ class ServeClient:
                     nbytes = sum(len(b) for b in blobs if b)
                     time.sleep(max(0.0, nbytes / (self._pace_mbps * 1e6)
                                    - (time.perf_counter() - t0)))
+                if rec is not None:
+                    rec.rec(_flight.SPAN_E, self._nid["serve.pull.ep"],
+                            float(sum(len(b) for b in blobs if b)), idx)
+                    # flow finishes pair with the publisher's starts by
+                    # the key-derived id: the cross-process arrow
+                    for p, b in zip(positions, blobs):
+                        if b is not None and len(b):
+                            rec.rec(_flight.FLOW_F,
+                                    self._nid["serve.snap"], float(len(b)),
+                                    _snap.trace_flow_id(keys[p]))
             except (OSError, RuntimeError) as exc:
+                if rec is not None:
+                    rec.rec(_flight.SPAN_E, self._nid["serve.pull.ep"],
+                            -1.0, idx)
                 self._drop_bulk_client(idx)
                 errs.append(f"{self._endpoints[idx][0]}:"
                             f"{self._endpoints[idx][1]}: {exc}")
@@ -273,6 +351,8 @@ class ServeClient:
 
     def _maybe_pull(self) -> None:
         ver = _snap.current_version(self._cl)
+        if ver > self._fence_ver:
+            self._fence_ver = ver   # staleness baseline, even when caught up
         if ver <= self._version or ver == 0:
             return
         if self._meta is None:
@@ -280,14 +360,27 @@ class ServeClient:
             if self._meta is None:
                 return  # fence moved but meta not visible yet; next poll
         t0 = time.perf_counter()
+        rec = self._rec
+        if rec is not None:
+            rec.rec(_flight.SPAN_B, self._nid["serve.pull"], 0.0, ver)
         try:
             got = _snap.fetch_snapshot(self._cl, meta=self._meta,
                                        pull=self.pull_blobs)
         except (OSError, RuntimeError) as exc:
             self._stats["pull_failures"] += 1
+            if rec is not None:
+                rec.rec(_flight.SPAN_E, self._nid["serve.pull"], -1.0, ver)
+                if not self._failover_open:
+                    # opened on the first failed attempt, closed when a
+                    # successor answers: the trace's failover span
+                    self._failover_open = True
+                    rec.rec(_flight.SPAN_B, self._nid["serve.failover"],
+                            0.0, ver)
             logger.warning("serve client: snapshot pull failed (%s); "
                            "keeping version %d", exc, self._version)
             return
+        if rec is not None:
+            rec.rec(_flight.SPAN_E, self._nid["serve.pull"], 1.0, ver)
         if got is None:
             return
         leaves, got_ver, wire = got
@@ -301,6 +394,10 @@ class ServeClient:
             self._stats["pulls"] += 1
             self._stats["wire_bytes"] += wire
             self._stats["pull_mbps"] = wire / dt / 1e6
+        if rec is not None and self._failover_open:
+            self._failover_open = False
+            rec.rec(_flight.SPAN_E, self._nid["serve.failover"],
+                    0.0, got_ver)
         self._ready.set()
 
     # -- poller ------------------------------------------------------------
@@ -316,7 +413,66 @@ class ServeClient:
                 self._update_health()
             except (OSError, RuntimeError):
                 pass
+            if self._slos or self._trace:
+                try:
+                    self._slo_tick()
+                except Exception as exc:  # noqa: BLE001 — telemetry only
+                    logger.debug("serve client: slo tick failed (%s)", exc)
             self._stop.wait(self._poll_s)
+
+    def _slo_tick(self) -> None:
+        """Per-poll SLO/trace bookkeeping: refresh the latency/staleness
+        percentile gauges and the per-phase breakdown gauges, run one
+        sampling pass of this client's own time-series store (burn-rate
+        evaluation lives there), and publish it under the serve-client
+        rank band so the trainer's ``bf.ts.<rank>`` keys stay untouched."""
+        if self._slos:
+            n = min(self._lat_n, _PCT_RING)
+            if n:
+                w = self._lat_ring[:n]
+                _metrics.gauge("slo.request_p50_us").set(
+                    float(np.percentile(w, 50)))
+                _metrics.gauge("slo.request_p99_us").set(
+                    float(np.percentile(w, 99)))
+            m = min(self._stal_n, _PCT_RING)
+            if m:
+                _metrics.gauge("slo.staleness_p99_ver").set(
+                    float(np.percentile(self._stal_ring[:m], 99)))
+        if self._trace:
+            rep = _flight.serve_report()
+            if rep:
+                for p, st in rep["phases"].items():
+                    _metrics.gauge("slo.phase." + p + ".p50_us").set(
+                        st["p50_us"])
+                    _metrics.gauge("slo.phase." + p + ".p99_us").set(
+                        st["p99_us"])
+        if self._ts is not None:
+            self._publish_ts()
+
+    def _publish_ts(self) -> None:
+        from ..runtime import timeseries as _ts
+
+        now = time.time()
+        if now - self._ts._last_sample < 0.9:
+            return
+        self._ts.sample(now)
+        interval = max(1.0, self._poll_s)
+        if now - self._ts._last_publish < interval:
+            return
+        rank = _ts.SERVE_TS_RANK_BASE + max(0, self._cid)
+        doc = self._ts.build_doc(rank, 0, now, interval)
+        try:
+            self._cl.put_bytes(_ts.TS_KEY_FMT.format(rank=rank),
+                               _ts.pack_doc(doc))
+            # unlike the trainer band, an empty blob is written on clear
+            # so a consumer can see the alert lifecycle end
+            self._cl.put_bytes(
+                _ts.ALERTS_KEY_FMT.format(rank=rank),
+                zlib.compress(json.dumps(doc["alerts"]).encode())
+                if doc["alerts"] else b"")
+            self._ts._last_publish = now
+        except (OSError, RuntimeError):
+            pass
 
     def _update_health(self) -> None:
         if hasattr(self._cl, "poll_shard_health"):
@@ -412,26 +568,81 @@ class ServeClient:
         verdict still admits (counted in ``stats()['queued']``)."""
         if self._model_fn is None:
             raise RuntimeError("ServeClient was built without a model_fn")
+        rec, tid, t0 = self._rec, 0, time.perf_counter()
+        if rec is not None:
+            tid = (self._tid_base
+                   + next(self._tid_iter)) & 0x7FFFFFFFFFFFFFFF
+            self._m_traced.inc()
+            rec.rec(_flight.SPAN_B, self._nid["serve.req"], 0.0, tid)
+            rec.rec(_flight.SPAN_B, self._nid["serve.admit"], 0.0, tid)
         verdict, reason = self.admission()
+        if rec is not None:
+            rec.rec(_flight.SPAN_E, self._nid["serve.admit"],
+                    _ADMIT_CODE.get(verdict, -1.0), tid)
         if verdict == "shed":
             self._stats["shed"] += 1
+            self._slo_shed()
+            if rec is not None:
+                rec.rec(_flight.SPAN_E, self._nid["serve.req"], -1.0, tid)
             raise RequestShed(
                 f"request shed by admission control ({reason})", reason)
         fut: Future = Future()
+        if rec is not None:
+            rec.rec(_flight.SPAN_B, self._nid["serve.queue"], 0.0, tid)
         try:
-            self._q.put_nowait((np.asarray(example), fut))
+            self._q.put_nowait((np.asarray(example), fut, tid, t0))
         except _queue.Full:
             self._stats["shed"] += 1
+            self._slo_shed()
+            if rec is not None:
+                rec.rec(_flight.SPAN_E, self._nid["serve.queue"], 0.0, tid)
+                rec.rec(_flight.SPAN_E, self._nid["serve.req"], -1.0, tid)
             raise RequestShed("request shed by admission control "
                               "(queue_full)", "queue_full") from None
         self._stats["queued" if verdict == "queue" else "accepted"] += 1
         self._stats["requests"] += 1
         return fut
 
+    def _slo_shed(self) -> None:
+        if not self._slos:
+            return
+        self._m_req.inc()
+        self._m_shed.inc()
+        b = self._m_breach.get("serve_avail")
+        if b is not None:
+            b.inc()
+
+    def _slo_done(self, t0: float, ver: int) -> None:
+        if not self._slos:
+            return
+        lat_us = (time.perf_counter() - t0) * 1e6
+        stale = float(max(0, self._fence_ver - ver))
+        self._lat_ring[self._lat_n % _PCT_RING] = lat_us
+        self._lat_n += 1
+        self._stal_ring[self._stal_n % _PCT_RING] = stale
+        self._stal_n += 1
+        self._m_req.inc()
+        self._m_lat_h.observe(lat_us)
+        self._m_stal_h.observe(stale)
+        for o in self._slos:
+            if o.name in ("serve_p50", "serve_p99"):
+                if lat_us > o.target:
+                    self._m_breach[o.name].inc()
+            elif o.name == "serve_staleness" and stale > o.target:
+                self._m_breach[o.name].inc()
+
     def infer(self, example: np.ndarray,
               timeout: Optional[float] = None) -> np.ndarray:
         """``submit`` + block on the result."""
         return self.submit(example).result(timeout)
+
+    def _trace_dequeue(self, item) -> None:
+        rec = self._rec
+        if rec is None:
+            return
+        tid = item[2]
+        rec.rec(_flight.SPAN_E, self._nid["serve.queue"], 0.0, tid)
+        rec.rec(_flight.SPAN_B, self._nid["serve.linger"], 0.0, tid)
 
     def _batch_loop(self) -> None:
         while not self._stop.is_set():
@@ -441,30 +652,56 @@ class ServeClient:
                 first = self._q.get(timeout=self._poll_s)
             except _queue.Empty:
                 continue
+            self._trace_dequeue(first)
             batch = [first]
             deadline = time.monotonic() + self._linger_s
             while len(batch) < self._batch_max:
                 left = deadline - time.monotonic()
                 try:
-                    batch.append(self._q.get(
-                        timeout=max(0.0, left)) if left > 0
-                        else self._q.get_nowait())
+                    item = self._q.get(
+                        timeout=max(0.0, left)) if left > 0 \
+                        else self._q.get_nowait()
                 except _queue.Empty:
                     break
+                self._trace_dequeue(item)
+                batch.append(item)
             with self._mu:
                 params = self._params
-            xs = np.stack([x for x, _ in batch])
+                served_ver = self._version
+            rec = self._rec
+            if rec is not None:
+                for _, _, tid, _ in batch:
+                    rec.rec(_flight.SPAN_E, self._nid["serve.linger"],
+                            0.0, tid)
+                    rec.rec(_flight.SPAN_B, self._nid["serve.decode"],
+                            0.0, tid)
+            xs = np.stack([x for x, _, _, _ in batch])
             try:
                 ys = self._model_fn(params, xs)
             except Exception as exc:  # noqa: BLE001 — fail the futures
-                for _, fut in batch:
+                for _, fut, tid, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
+                    if rec is not None:
+                        rec.rec(_flight.SPAN_E, self._nid["serve.decode"],
+                                -1.0, tid)
+                        rec.rec(_flight.SPAN_E, self._nid["serve.req"],
+                                -1.0, tid)
                 continue
+            if rec is not None:
+                for _, _, tid, _ in batch:
+                    rec.rec(_flight.SPAN_E, self._nid["serve.decode"],
+                            0.0, tid)
             self._stats["batches"] += 1
-            for i, (_, fut) in enumerate(batch):
+            for i, (_, fut, tid, t0) in enumerate(batch):
                 if not fut.done():
                     fut.set_result(np.asarray(ys)[i])
+                if rec is not None:
+                    # span-end `a` = the answering snapshot version: the
+                    # lineage link every consumer resolves through
+                    rec.rec(_flight.SPAN_E, self._nid["serve.req"],
+                            float(served_ver), tid)
+                self._slo_done(t0, served_ver)
 
     # -- observability -----------------------------------------------------
 
@@ -473,6 +710,7 @@ class ServeClient:
         out["version"] = self.version()
         out["queue_depth"] = self._q.qsize()
         out["publish_lag_s"] = self._health.get("publish_lag_s")
+        out["staleness_ver"] = max(0, self._fence_ver - out["version"])
         return out
 
 

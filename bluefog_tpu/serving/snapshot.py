@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops import codec as _codec
+from ..runtime import flight as _flight
 from ..runtime.config import knob_env
 from ..runtime.logging import logger
 
@@ -50,9 +51,14 @@ PUB_STEP_KEY = "bf.serve.pub_step"
 GC_FLOOR_KEY = "bf.serve.gc_floor"
 CLIENTS_KEY = "bf.serve.clients"
 CLIENT_HB_FMT = "bf.serve.client.{cid}"
+LINEAGE_KEY_FMT = "bf.serve.lineage.{ver}"
 
 _MAGIC = 0x56734642  # "BFsV" little-endian
 _HDR = struct.Struct("<IBBHQQ")
+
+# header flags bit: a lineage record rides this version's KV sidecar
+# (decode ignores flags, so pre-tracing readers interoperate unchanged)
+FLAG_LINEAGE = 0x1
 
 
 class SnapshotGone(RuntimeError):
@@ -164,7 +170,7 @@ def flatten_leaves(arrays: Sequence[np.ndarray]) -> np.ndarray:
 
 
 def encode_shard(flat: np.ndarray, meta: SnapshotMeta, shard: int,
-                 ver: int, codec=None) -> bytes:
+                 ver: int, codec=None, flags: int = 0) -> bytes:
     lo, hi = meta.segment(shard)
     seg = np.ascontiguousarray(flat[lo:hi], np.float32)
     if codec is None:
@@ -175,7 +181,7 @@ def encode_shard(flat: np.ndarray, meta: SnapshotMeta, shard: int,
         cid = codec.cid
     out = np.empty(_HDR.size + payload.nbytes, np.uint8)
     out[:_HDR.size] = np.frombuffer(
-        _HDR.pack(_MAGIC, cid, 0, shard, ver, hi - lo), np.uint8)
+        _HDR.pack(_MAGIC, cid, flags & 0xFF, shard, ver, hi - lo), np.uint8)
     out[_HDR.size:] = payload.reshape(-1)
     return out.tobytes()
 
@@ -232,6 +238,36 @@ def decode_shard(blob, meta: SnapshotMeta, shard: int,
 def current_version(cl) -> int:
     """The committed snapshot version (0 = nothing published yet)."""
     return max(0, int(cl.get(VER_KEY)))
+
+
+def trace_flow_id(key: str) -> int:
+    """Stable 63-bit flow id for a snapshot shard key. The publisher's
+    FLOW_S and the puller's FLOW_F derive the same id from the key alone —
+    that shared id is what binds the two ring records into one chrome flow
+    arrow when per-process dumps are merged."""
+    h = 0xCBF29CE484222325
+    for ch in key.encode():
+        h = ((h ^ ch) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h & 0x7FFFFFFFFFFFFFFF
+
+
+def read_lineage(cl, ver: int) -> Optional[dict]:
+    """The publisher-stamped lineage record for a committed version:
+    ``{"fmt", "ver", "step", "rank", "codec", "wall_us"}`` — which
+    training step (on which rank, through which codec, at what wall
+    clock) produced the bytes that answered a request. None when absent
+    (tracing off at the publisher, pre-tracing publisher, or GC'd)."""
+    try:
+        blob = cl.get_bytes(LINEAGE_KEY_FMT.format(ver=int(ver)))
+    except (OSError, RuntimeError):
+        return None
+    if not blob:
+        return None
+    try:
+        doc = json.loads(bytes(blob).decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return doc if doc.get("fmt") == 1 else None
 
 
 def fetch_meta(cl) -> Optional[SnapshotMeta]:
@@ -308,6 +344,11 @@ class SnapshotPublisher:
         self._meta: Optional[SnapshotMeta] = None
         self._committed: List[int] = []
         self._last_ver = 0
+        # request-path tracing (BLUEFOG_TRACE_SERVE): when on, every
+        # publish stamps a lineage record and records publish spans +
+        # per-shard flow starts; when off, nothing new touches the wire
+        # or the ring (the zero-touch pin).
+        self._trace = bool(knob_env("BLUEFOG_TRACE_SERVE"))
         # test-only: sleep between shard writes so a chaos harness can
         # SIGKILL this process deterministically mid-publish
         self._inter_shard_sleep = 0.0
@@ -338,16 +379,41 @@ class SnapshotPublisher:
                 f"{self._meta.total} — model structure changed under a "
                 "live publisher")
         keys = snap_keys(self._meta, ver)
-        blobs = [encode_shard(flat, self._meta, s, ver, self._codec)
+        flags = FLAG_LINEAGE if self._trace else 0
+        blobs = [encode_shard(flat, self._meta, s, ver, self._codec,
+                              flags=flags)
                  for s in range(self._meta.shards)]
+        rec = _flight.recorder() if self._trace else None
+        if rec is not None:
+            rec.begin("serve.publish", float(flat.nbytes), ver)
         if self._inter_shard_sleep > 0:
             for k, b in zip(keys, blobs):
                 self._cl.put_bytes(k, b)
+                if rec is not None:
+                    rec.rec(_flight.FLOW_S, rec.intern("serve.snap"),
+                            float(len(b)), trace_flow_id(k))
                 time.sleep(self._inter_shard_sleep)
         else:
             self._cl.put_bytes_many(keys, blobs)
+            if rec is not None:
+                for k, b in zip(keys, blobs):
+                    rec.rec(_flight.FLOW_S, rec.intern("serve.snap"),
+                            float(len(b)), trace_flow_id(k))
+        if self._trace:
+            # lineage lands BEFORE the fence so a reader that saw the
+            # fence move can always resolve the producing step
+            lineage = {"fmt": 1, "ver": ver,
+                       "step": int(step) if step is not None else -1,
+                       "rank": self._lineage_rank(),
+                       "codec": (self._codec.cid if self._codec
+                                 else _codec.CODEC_NONE),
+                       "wall_us": time.time_ns() // 1000}
+            self._cl.put_bytes(LINEAGE_KEY_FMT.format(ver=ver),
+                               json.dumps(lineage, sort_keys=True).encode())
         # every shard is on the wire: move the fence, then the gauges
         self._cl.put_max(VER_KEY, ver)
+        if rec is not None:
+            rec.end("serve.publish", float(flat.nbytes), ver)
         self._last_ver = ver
         _put_float(self._cl, PUB_TS_KEY, time.time())
         if step is not None:
@@ -358,25 +424,75 @@ class SnapshotPublisher:
                 "wire_bytes": float(sum(len(b) for b in blobs)),
                 "seconds": time.perf_counter() - t0}
 
+    def _lineage_rank(self) -> int:
+        try:
+            from ..runtime import metrics as _metrics
+
+            return int(_metrics._process_index())
+        except Exception:  # noqa: BLE001 — lineage is telemetry
+            return 0
+
     def _gc(self) -> None:
         """Overwrite versions beyond the keep window with empty bytes
         (the KV has no delete op; an empty slot frees the payload and
         reads as absent). The floor moves BEFORE the bytes vanish so a
-        reader can always classify a miss."""
+        reader can always classify a miss. Lineage sidecars are GC'd with
+        their version (only when tracing stamped them — an untraced run
+        never creates, nor clears, the keys)."""
         while len(self._committed) > self._keep:
             old = self._committed.pop(0)
             floor = self._committed[0]
+            gc_keys = snap_keys(self._meta, old)
+            if self._trace:
+                gc_keys = gc_keys + [LINEAGE_KEY_FMT.format(ver=old)]
             try:
                 self._cl.put_max(GC_FLOOR_KEY, floor)
-                self._cl.put_bytes_many(
-                    snap_keys(self._meta, old),
-                    [b""] * self._meta.shards)
+                self._cl.put_bytes_many(gc_keys, [b""] * len(gc_keys))
             except (OSError, RuntimeError) as exc:
                 logger.warning(
                     "serve publisher: GC of snapshot version %d failed "
                     "(%s); the slot stays until the next publish", old,
                     exc)
                 return
+
+
+def claim_client_slot(cl) -> int:
+    """Register a serve client: reuse the first EXPIRED heartbeat slot
+    (no beat for longer than ``BLUEFOG_SERVE_CLIENT_TTL_S``, or zeroed by
+    a clean close) before growing ``bf.serve.clients`` — so the
+    ``bf.serve.client.<cid>`` key set, the ``--status``/``--top`` client
+    tables fed by it, and the admission gate's client count stay bounded
+    by the PEAK concurrent client count instead of growing forever.
+
+    Two clients registering at once can double-claim a slot; client
+    identity is observability, not correctness (the same trade the
+    heartbeat itself makes), and the loser's next beat simply keeps the
+    shared slot warm. Returns -1 when the KV is unreachable."""
+    ttl = float(knob_env("BLUEFOG_SERVE_CLIENT_TTL_S"))
+    now = time.time()
+    try:
+        total = max(0, int(cl.get(CLIENTS_KEY)))
+        for cid in range(min(total, 256)):
+            ts = _get_float(cl, CLIENT_HB_FMT.format(cid=cid))
+            if ts <= 0 or (ttl > 0 and now - ts > ttl):
+                _put_float(cl, CLIENT_HB_FMT.format(cid=cid), now)
+                return cid
+        cid = int(cl.fetch_add(CLIENTS_KEY, 1))
+        _put_float(cl, CLIENT_HB_FMT.format(cid=cid), now)
+        return cid
+    except (OSError, RuntimeError):
+        return -1
+
+
+def release_client_slot(cl, cid: int) -> None:
+    """Zero the heartbeat on clean close so the slot reads as free
+    immediately (a crashed client's slot frees via the TTL instead)."""
+    if cid < 0:
+        return
+    try:
+        _put_float(cl, CLIENT_HB_FMT.format(cid=cid), 0.0)
+    except (OSError, RuntimeError):
+        pass
 
 
 def read_serve_status(cl, hb_window_s: Optional[float] = None
@@ -411,3 +527,25 @@ def read_serve_status(cl, hb_window_s: Optional[float] = None
         "clients_total": total,
         "clients_live": live,
     }
+
+
+def live_client_ids(cl, hb_window_s: Optional[float] = None) -> List[int]:
+    """Client ids with a live heartbeat — the ``--top``/``--status``
+    scan over ``bf.serve.client.<id>`` (bounded by the same 256-slot
+    window as :func:`read_serve_status`)."""
+    if hb_window_s is None:
+        hb_window_s = 6.0 * float(knob_env("BLUEFOG_SERVE_POLL_S"))
+    try:
+        total = max(0, int(cl.get(CLIENTS_KEY)))
+    except (OSError, RuntimeError):
+        return []
+    out: List[int] = []
+    now = time.time()
+    for cid in range(min(total, 256)):
+        try:
+            ts = _get_float(cl, CLIENT_HB_FMT.format(cid=cid))
+        except (OSError, RuntimeError):
+            continue
+        if ts > 0 and now - ts <= hb_window_s:
+            out.append(cid)
+    return out

@@ -156,6 +156,16 @@ def collect_once() -> dict:
                     "p50_ms", "p99_ms"):
             if row.get(key) is not None:
                 out[f"serve.{key}"] = row[key]
+        # r21 request-path attribution rows: serve.trace.* (phase
+        # percentiles + traced-request count) and slo.* (SLO engine
+        # counters) — collected INFO-ONLY, excluded in gating()
+        for key, v in row.items():
+            if not isinstance(v, (int, float)):
+                continue
+            if key.startswith("trace."):
+                out[f"serve.{key}"] = v
+            elif key.startswith("slo."):
+                out[key] = v
     return out
 
 
@@ -185,6 +195,13 @@ def gating(metrics: dict) -> dict:
             # r15), but only its stable window-op series — the wire-leg
             # probes (drain_stream) jitter 2x run to run and stay info
             continue
+        if name.startswith("slo."):
+            # slo.* (r21, SLO engine counters from the churned serving
+            # run) is INFO-ONLY: run-length-dependent counts, not rates;
+            # per the stable-series rule they could only ever graduate
+            # as derived rates, two stable rounds from now at the
+            # earliest
+            continue
         if name.startswith("serve."):
             # serve.* GATES since r20 (two stable rounds elapsed since
             # r18 introduced the serving plane, per the stable-series
@@ -193,8 +210,11 @@ def gating(metrics: dict) -> dict:
             # The LATENCY rows (p50/p99 ms) stay info-only: they are
             # lower-better, and compare()'s band is higher-is-better —
             # they would need inverting (or replacing with a rate)
-            # before they could ever gate.
-            if name.endswith("_ms"):
+            # before they could ever gate. serve.trace.* (r21 phase
+            # attribution) is info-only for the same lower-better
+            # reason, plus quick-mode phase tails jitter far beyond the
+            # band.
+            if name.endswith("_ms") or name.startswith("serve.trace."):
                 continue
             keep[name] = v
             continue

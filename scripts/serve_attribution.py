@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Per-request serving-latency attribution (docs/slo.md).
+
+Answers "where did the request's milliseconds go?" from the request-path
+traces the serving plane records when ``BLUEFOG_TRACE_SERVE=1``: every
+request's admission, queue wait, batch linger, decode, and reply phases
+plus the poller's stripe-group pulls, carved into disjoint buckets (the
+queue time a swap pull was blocking is attributed to ``swap_blocked``,
+not ``queue``).
+
+Two modes:
+
+* **dump mode** (``--dump FILE_OR_DIR ...``): replay flight-recorder
+  dump files (``flight_<r>.json`` from ``bfrun --dump``, or local
+  ``bf_flight_<rank>.json``) through the span analyzer and print one
+  attribution table per dump that recorded requests.
+* **live mode** (``--cp HOST:PORT[,...]``): read the serve clients'
+  published time-series streams (``bf.ts.<4096 + cid>``) and the
+  serving plane's lineage records over a raw control-plane client — no
+  jax, no mesh join — and print the current phase percentiles, SLO
+  burn-rate state, and the committed snapshot's provenance.
+
+``--json`` emits one machine-readable document (``schema_version: 1``)
+instead of the tables.
+
+Usage:
+    python scripts/serve_attribution.py --dump ./flight_dump/
+    python scripts/serve_attribution.py --cp 127.0.0.1:45607 [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bluefog_tpu.runtime import flight  # noqa: E402
+
+
+def _dump_paths(specs):
+    out = []
+    for spec in specs:
+        p = Path(spec)
+        if p.is_dir():
+            out.extend(sorted(p.glob("flight_*.json")))
+            out.extend(sorted(p.glob("bf_flight_*.json")))
+        elif p.exists():
+            out.append(p)
+        else:
+            print(f"serve_attribution: no such dump: {spec}",
+                  file=sys.stderr)
+    return out
+
+
+def analyze_dumps(paths):
+    """-> [(path, rank, report)] for every dump that recorded requests."""
+    reports = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"serve_attribution: unreadable dump {path} ({exc})",
+                  file=sys.stderr)
+            continue
+        rep = flight.analyze_serve(doc)
+        if rep is not None:
+            reports.append((str(path), doc.get("meta", {}).get("rank"),
+                            rep))
+    return reports
+
+
+def live_report(cl):
+    """The live view: per-client phase gauges + SLO state from the
+    published streams, plus the committed snapshot's lineage record."""
+    from bluefog_tpu.runtime import timeseries as ts
+    from bluefog_tpu.serving import snapshot as snap
+
+    out = {"clients": [], "lineage": None, "serve": None}
+    try:
+        st = snap.read_serve_status(cl)
+    except (OSError, RuntimeError):
+        st = None
+    if st:
+        out["serve"] = st
+        lin = snap.read_lineage(cl, st["version"])
+        if lin:
+            out["lineage"] = lin
+    acc = ts.HistoryAccumulator()
+    for cid in snap.live_client_ids(cl):
+        r = ts.SERVE_TS_RANK_BASE + cid
+        doc = ts.read_rank(cl, r)
+        if doc is None:
+            continue
+        acc.update(r, doc)
+        row = {"cid": cid, "phases": {}, "slo": {}}
+        for p in flight.SERVE_PHASES:
+            p50 = acc.latest(r, f"slo.phase.{p}.p50_us")
+            p99 = acc.latest(r, f"slo.phase.{p}.p99_us")
+            if p99 is not None:
+                row["phases"][p] = {"p50_us": p50, "p99_us": p99}
+        for name in ("slo.request_p50_us", "slo.request_p99_us",
+                     "slo.staleness_p99_ver", "slo.requests.rate",
+                     "slo.shed.rate", "trace.requests"):
+            v = acc.latest(r, name)
+            if v is not None:
+                row[name] = v
+        for (rank, name) in sorted(acc.series):
+            if rank != r or not name.startswith("slo.budget."):
+                continue
+            kind = name[len("slo.budget."):]
+            row["slo"][kind] = {
+                "budget_remaining": acc.latest(r, name),
+                "burn_fast": acc.latest(r, f"slo.burn.{kind}.fast"),
+                "burn_slow": acc.latest(r, f"slo.burn.{kind}.slow"),
+            }
+        out["clients"].append(row)
+    return out
+
+
+def _print_report(rep, title):
+    print(title)
+    print(f"  {rep['requests']} request(s), req p50/p99 "
+          f"{rep['p50_us']:.0f}/{rep['p99_us']:.0f} us, "
+          f"{rep['pulls']} snapshot pull(s), "
+          f"{rep['failovers']} failover(s)")
+    print(f"  {'phase':>14} {'p50 us':>10} {'p99 us':>10} {'mean us':>10}")
+    for p in flight.SERVE_PHASES:
+        row = rep["phases"].get(p)
+        if row is None:
+            continue
+        print(f"  {p:>14} {row['p50_us']:>10.0f} {row['p99_us']:>10.0f} "
+              f"{row['mean_us']:>10.0f}")
+    for ep, row in sorted(rep.get("endpoints", {}).items()):
+        print(f"  endpoint {ep}: {row['pulls']} pull(s), "
+              f"{row['bytes'] / 1e6:.1f} MB, p50/p99 "
+              f"{row['p50_us']:.0f}/{row['p99_us']:.0f} us")
+
+
+def _print_live(doc):
+    st = doc.get("serve")
+    if st:
+        print(f"serving plane: snapshot v{st['version']} "
+              f"(step {st['pub_step']}), "
+              f"{st['clients_live']}/{st['clients_total']} client(s) live")
+    lin = doc.get("lineage")
+    if lin:
+        print(f"  lineage v{lin['ver']}: train step {lin['step']}, "
+              f"published by rank {lin['rank']}, codec "
+              f"{lin.get('codec') or 'none'}")
+    for row in doc.get("clients", []):
+        print(f"  client {row['cid']}: "
+              f"req p50/p99 {row.get('slo.request_p50_us') or 0:.0f}/"
+              f"{row.get('slo.request_p99_us') or 0:.0f} us, "
+              f"{row.get('trace.requests') or 0:.0f} traced")
+        if row["phases"]:
+            attr = "  ".join(
+                f"{p} {v['p50_us'] or 0:.0f}/{v['p99_us']:.0f}"
+                for p, v in row["phases"].items())
+            print(f"    phases p50/p99 us: {attr}")
+        for kind, s in sorted(row["slo"].items()):
+            b = s["budget_remaining"]
+            print(f"    {kind}: budget "
+                  f"{(b if b is not None else 1.0) * 100:.1f}%  burn "
+                  f"{s['burn_fast'] or 0:.2f}x/{s['burn_slow'] or 0:.2f}x")
+    if not doc.get("clients"):
+        print("  (no serve client is publishing SLO/trace series — set "
+              "BLUEFOG_TRACE_SERVE=1 / BLUEFOG_SLO on the client)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--dump", nargs="+", metavar="FILE_OR_DIR",
+                    help="flight dump file(s)/dir(s) to attribute")
+    ap.add_argument("--cp", type=str,
+                    default=os.environ.get("BLUEFOG_CP_HOSTS")
+                    or (f"{os.environ.get('BLUEFOG_CP_HOST')}:"
+                        f"{os.environ.get('BLUEFOG_CP_PORT')}"
+                        if os.environ.get("BLUEFOG_CP_HOST")
+                        and os.environ.get("BLUEFOG_CP_PORT") else None),
+                    help="control-plane endpoint(s) for live mode")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (schema_version 1)")
+    args = ap.parse_args(argv)
+
+    if args.dump:
+        reports = analyze_dumps(_dump_paths(args.dump))
+        if args.json:
+            print(json.dumps({
+                "schema_version": 1, "mode": "dump",
+                "reports": [{"path": p, "rank": r, **rep}
+                            for p, r, rep in reports]}))
+        else:
+            for p, r, rep in reports:
+                _print_report(rep, f"{p} (rank {r}):")
+        if not reports:
+            print("serve_attribution: no dump recorded request spans "
+                  "(was BLUEFOG_TRACE_SERVE=1 on the client?)",
+                  file=sys.stderr)
+            return 1
+        return 0
+
+    if not args.cp:
+        print("serve_attribution: pass --dump FILES or --cp HOST:PORT",
+              file=sys.stderr)
+        return 2
+    from bluefog_tpu.launcher import _raw_client
+    from bluefog_tpu.runtime.router import parse_endpoints
+
+    cl = _raw_client(parse_endpoints(args.cp), what="serve_attribution")
+    if cl is None:
+        return 1
+    try:
+        doc = live_report(cl)
+        if args.json:
+            print(json.dumps({"schema_version": 1, "mode": "live", **doc}))
+        else:
+            _print_live(doc)
+    finally:
+        cl.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

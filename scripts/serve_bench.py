@@ -261,6 +261,11 @@ class Publisher(threading.Thread):
 def bench_latency(args):
     os.environ.setdefault("BLUEFOG_CP_BACKOFF_MS", "20")
     os.environ["BLUEFOG_SERVE_POLL_S"] = "0.1"
+    # r21: the churn run doubles as the request-path attribution bench —
+    # tracing + a declared SLO produce the phase p50/p99 and slo.* rows
+    # that perf_gate collects INFO-ONLY (docs/slo.md)
+    os.environ["BLUEFOG_TRACE_SERVE"] = "1"
+    os.environ.setdefault("BLUEFOG_SLO", "serve_p99:50ms@1m,serve_avail:99@1m")
     keep = 3
     servers = [spawn_shard(i, 1, True) for i in range(2)]
     finish_shard_spawn(servers)
@@ -359,6 +364,27 @@ def bench_latency(args):
     verify_stop.set()
     vt.join(timeout=5)
     st = sc.stats()
+    # request-path attribution: replay the flight ring's request spans
+    # (client + in-process publisher share one ring here) into the
+    # per-phase percentile table
+    from bluefog_tpu.runtime import flight as flight_mod
+    from bluefog_tpu.runtime import metrics as metrics_mod
+    trace_rows: dict = {}
+    rep = flight_mod.serve_report()
+    if rep:
+        trace_rows["trace.requests"] = rep["requests"]
+        for p, prow in sorted(rep["phases"].items()):
+            trace_rows[f"trace.phase.{p}.p50_us"] = round(prow["p50_us"], 1)
+            trace_rows[f"trace.phase.{p}.p99_us"] = round(prow["p99_us"], 1)
+        attr = "  ".join(f"{p} {prow['p50_us']:.0f}/{prow['p99_us']:.0f}"
+                         for p, prow in sorted(rep["phases"].items()))
+        print(f"serve_bench: phase attribution over {rep['requests']} "
+              f"traced request(s), p50/p99 us: {attr}")
+    for name in ("slo.requests", "slo.shed", "slo.breach.serve_p99",
+                 "slo.breach.serve_avail"):
+        c = metrics_mod._REGISTRY._counters.get(name)
+        if c is not None:
+            trace_rows[name] = c.value()
     sc.close()
     try:
         pub_cl.close()
@@ -370,7 +396,7 @@ def bench_latency(args):
         lats = sorted(lat_ms)
     pct = lambda q: lats[min(len(lats) - 1, int(q * len(lats)))] \
         if lats else float("nan")  # noqa: E731
-    return {
+    out = {
         "p50_ms": round(pct(0.50), 3), "p99_ms": round(pct(0.99), 3),
         "completed": len(lats), "shed": shed[0] + int(st["shed"]),
         "swaps": st["swaps"], "pull_failures": st["pull_failures"],
@@ -378,6 +404,8 @@ def bench_latency(args):
         "torn_reads": torn[0], "stale_beyond_keep": stale_beyond_keep,
         "rejoined_new_port": rejoined,
     }
+    out.update(trace_rows)
+    return out
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""SLO/tracing smoke test (`make slo-smoke`, docs/slo.md).
+
+A trainer-stand-in publisher child + one traced serve client over a real
+control-plane shard server, asserting the request-path observability
+acceptance surface end to end:
+
+  * **overhead gate**: the full per-request trace record set (the ~10
+    slotted ring stores a traced request costs) stays under 2 us;
+  * **burn-rate red path**: with ``serve_staleness:1ver@5s`` declared,
+    arming the native fault injector (the runtime front-end of
+    ``BLUEFOG_CP_FAULT``) with a per-op delay in the CLIENT process
+    makes pulls crawl while the untouched publisher keeps committing —
+    staleness breaches push both burn windows over the threshold and
+    the ``slo.serve_staleness`` alert FIRES within the window;
+  * while red: ``bfrun --top --once`` renders the SERVING SLO section
+    and ``bfrun --status --strict`` exits 2 on budget exhaustion;
+  * **recovery**: disarming the injector clears the alert as soon as
+    the fast window recovers (and the published ``bf.alerts.<rank>``
+    blob empties);
+  * **merged trace**: the client's and the publisher's flight rings
+    merge into one chrome trace with at least one cross-process
+    publisher->client stripe flow pair, and the committed snapshot's
+    lineage record resolves to the exact producing train step.
+
+Exits non-zero (with a message) on any violated assertion.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import zlib
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("BLUEFOG_CP_BACKOFF_MS", "20")
+os.environ.update({
+    "BLUEFOG_TRACE_SERVE": "1",
+    "BLUEFOG_SLO": "serve_staleness:1ver@5s",
+    "BLUEFOG_SLO_BURN": "2.0",
+    "BLUEFOG_SERVE_POLL_S": "0.1",
+    "BLUEFOG_FLIGHT_CAPACITY": "32768",
+})
+
+import numpy as np  # noqa: E402
+
+from bluefog_tpu.runtime import flight  # noqa: E402
+from bluefog_tpu.runtime import native  # noqa: E402
+from bluefog_tpu.serving import snapshot as snap  # noqa: E402
+from bluefog_tpu.serving.client import ServeClient  # noqa: E402
+
+SHARD_SERVER = os.path.join(_ROOT, "bluefog_tpu", "runtime",
+                            "shard_server.py")
+PUB_CHILD = os.path.join(_ROOT, "tests", "_serve_pub_child.py")
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"slo-smoke FAILED: {msg}", file=sys.stderr)
+        sys.exit(1)
+
+
+def overhead_gate() -> float:
+    """Best-of-5 mean per-record cost of the traced-request pattern (us)
+    — the same < 2 us/record bar the obs-smoke ring gate holds."""
+    rec = flight.FlightRecorder(capacity=32768)
+    nids = [rec.intern(n) for n in
+            ("serve.req", "serve.admit", "serve.queue", "serve.linger",
+             "serve.decode")]
+    iters = 5000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter_ns()
+        for i in range(iters):
+            # the per-request pattern: req B, admit B/E, queue B/E,
+            # linger B/E, decode B/E, req E — 10 slotted stores
+            rec.rec(flight.SPAN_B, nids[0], 0.0, i)
+            rec.rec(flight.SPAN_B, nids[1], 0.0, i)
+            rec.rec(flight.SPAN_E, nids[1], 0.0, i)
+            rec.rec(flight.SPAN_B, nids[2], 0.0, i)
+            rec.rec(flight.SPAN_E, nids[2], 0.0, i)
+            rec.rec(flight.SPAN_B, nids[3], 0.0, i)
+            rec.rec(flight.SPAN_E, nids[3], 0.0, i)
+            rec.rec(flight.SPAN_B, nids[4], 0.0, i)
+            rec.rec(flight.SPAN_E, nids[4], 0.0, i)
+            rec.rec(flight.SPAN_E, nids[0], 7.0, i)
+        best = min(best, (time.perf_counter_ns() - t0) / (iters * 10) / 1e3)
+    return best
+
+
+def spawn_shard(port=0):
+    cmd = [sys.executable, SHARD_SERVER, "--port", str(port),
+           "--world", "1", "--shard", "0"]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline()
+    if not line.startswith("BF_SHARD_READY"):
+        raise RuntimeError(f"shard server failed to start: {line!r}")
+    return proc, int(line.split()[1])
+
+
+def main() -> int:
+    if native.load() is None:
+        print("slo-smoke: native runtime unavailable", file=sys.stderr)
+        return 1
+
+    # 0) overhead gate: the tracing hot path must stay microscopic
+    us = overhead_gate()
+    print(f"slo-smoke: per-request trace records cost {us:.2f} us each "
+          f"(~{us * 10:.1f} us per traced request; gate: < 2.0 us/record)")
+    check(us < 2.0, f"per-request trace record overhead {us:.2f} us "
+          ">= 2 us/record")
+
+    server, port = spawn_shard()
+    endpoints = [("127.0.0.1", port)]
+    os.environ.update({"BLUEFOG_CP_HOST": "127.0.0.1",
+                       "BLUEFOG_CP_PORT": str(port),
+                       "BLUEFOG_CP_WORLD": "1"})
+    tmp = tempfile.mkdtemp(prefix="slo_smoke_")
+    pub_dump = os.path.join(tmp, "pub_flight.json")
+    pub = subprocess.Popen(
+        [sys.executable, PUB_CHILD, "--port", str(port), "--shards", "4",
+         "--elems", "20000", "--period-ms", "150", "--keep", "4",
+         "--flight-dump", pub_dump, "--flight-rank", "1"],
+        stdout=subprocess.DEVNULL, env=dict(os.environ))
+
+    def model_fn(params, xs):
+        return xs + params[0][0]
+
+    sc = ServeClient(endpoints, model_fn=model_fn)
+    bfrun_env = dict(os.environ)
+    try:
+        check(sc.wait_ready(timeout=20),
+              "client never pulled a first snapshot")
+        check(sc._ts is not None, "BLUEFOG_SLO set but the client owns "
+              "no time-series store")
+
+        def drive(seconds, rate=40.0):
+            futs = []
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                try:
+                    futs.append(sc.submit(np.zeros(2, np.float32)))
+                except Exception:  # noqa: BLE001 — shed is fine here
+                    pass
+                time.sleep(1.0 / rate)
+            for f in futs:
+                try:
+                    f.result(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        # 1) green traffic: objective declared, no alert
+        drive(3.0)
+        st = sc._ts.slo_status()
+        check(st and st[0]["name"] == "serve_staleness",
+              f"slo_status missing the declared objective: {st}")
+        check(not st[0]["active"],
+              f"staleness alert active before any fault: {st}")
+
+        # 2) red path: per-op delay in THIS process only — pulls crawl,
+        # the publisher child keeps committing, staleness breaches
+        native.fault_arm(delay_ms=60)
+        fired = False
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline and not fired:
+            drive(1.0)
+            fired = any(o["active"] for o in sc._ts.slo_status())
+        check(fired, "staleness burn-rate alert never fired under a "
+              "60 ms/op pull delay (30 s deadline)")
+        st = [o for o in sc._ts.slo_status() if o["active"]][0]
+        print(f"slo-smoke: alert slo.{st['name']} FIRED (burn fast "
+              f"{st['burn_fast']:.1f}x / slow {st['burn_slow']:.1f}x, "
+              f"budget {st['budget_remaining']:.2f})")
+        check(st["budget_remaining"] is not None
+              and st["budget_remaining"] <= 0.0,
+              f"budget not exhausted while red: {st}")
+
+        # keep request traffic flowing while the external consumers are
+        # probed — with no requests in the fast window the error rate is
+        # 0 and the alert would (correctly) clear mid-check
+        red_stop = threading.Event()
+
+        def red_traffic():
+            while not red_stop.is_set():
+                try:
+                    sc.submit(np.zeros(2, np.float32))
+                except Exception:  # noqa: BLE001 — shed is fine here
+                    pass
+                red_stop.wait(0.03)
+
+        rt = threading.Thread(target=red_traffic, daemon=True)
+        rt.start()
+        # let a publication carry the alert out, then check the consumers
+        time.sleep(2.5)
+        out = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.launcher", "--top",
+             "--once", "--cp", f"127.0.0.1:{port}"],
+            env=bfrun_env, capture_output=True, text=True, timeout=120)
+        check(out.returncode == 0, f"bfrun --top --once failed: "
+              f"{out.stderr}")
+        check("SERVING SLO" in out.stdout and "serve_staleness"
+              in out.stdout,
+              f"--top missing the SERVING SLO section: {out.stdout!r}")
+        out = subprocess.run(
+            [sys.executable, "-m", "bluefog_tpu.launcher", "--status",
+             "--strict", "--cp", f"127.0.0.1:{port}"],
+            env=bfrun_env, capture_output=True, text=True, timeout=120)
+        check(out.returncode == 2,
+              f"--status --strict exit {out.returncode} != 2 with an "
+              f"exhausted budget: {out.stdout} {out.stderr}")
+        check("budget" in out.stderr,
+              f"--strict findings missing the budget line: {out.stderr!r}")
+        check(any(o["active"] for o in sc._ts.slo_status()),
+              "alert flapped off while traffic was still red")
+        alerts_blob = bytes(sc._cl.get_bytes(
+            f"bf.alerts.{4096 + sc._cid}"))
+        check(alerts_blob and b"serve_staleness"
+              in zlib.decompress(alerts_blob),
+              "published bf.alerts blob missing the active SLO alert")
+        red_stop.set()
+        rt.join(timeout=5)
+
+        # 3) recovery: disarm -> the fast window drains -> alert clears
+        native.fault_disarm()
+        cleared = False
+        deadline = time.perf_counter() + 25.0
+        while time.perf_counter() < deadline and not cleared:
+            drive(1.0)
+            cleared = not any(o["active"] for o in sc._ts.slo_status())
+        check(cleared, "alert never cleared within 25 s of disarming "
+              "the fault")
+        print("slo-smoke: alert CLEARED after recovery")
+        time.sleep(2.5)  # one more publication: the alerts blob empties
+        check(not bytes(sc._cl.get_bytes(f"bf.alerts.{4096 + sc._cid}")),
+              "bf.alerts blob not emptied after the alert cleared")
+
+        # 4) lineage: the committed version resolves to its train step
+        ver = sc.version()
+        lin = snap.read_lineage(sc._cl, ver)
+        check(lin is not None, f"no lineage record for v{ver}")
+        check(lin["step"] == ver and lin["ver"] == ver,
+              f"lineage v{ver} does not resolve to its step: {lin}")
+
+        # 5) merged trace: client + publisher rings -> one chrome trace
+        # with >= 1 cross-process stripe flow pair
+        drive(1.0)  # fresh pulls so both rings hold the same stripe keys
+        pub.terminate()
+        pub.wait(timeout=20)
+        check(os.path.exists(pub_dump), "publisher child wrote no "
+              "flight dump on SIGTERM")
+        with open(pub_dump) as f:
+            pub_doc = json.load(f)
+        client_doc = flight.build_dump("slo-smoke")
+        merged = flight.merge_dumps([client_doc, pub_doc])
+        starts, finishes = {}, {}
+        for e in merged:
+            if e.get("cat") != "bf.flow":
+                continue
+            (starts if e["ph"] == "s" else finishes)[e["id"]] = e["pid"]
+        pairs = [fid for fid, pid in starts.items()
+                 if fid in finishes and finishes[fid] != pid]
+        check(pairs, f"no cross-process stripe flow pair in the merged "
+              f"trace ({len(starts)} starts, {len(finishes)} finishes)")
+        rep = flight.analyze_serve(client_doc)
+        check(rep and rep["requests"] > 0,
+              "client ring holds no attributable request trace")
+        print(f"slo-smoke: merged trace has {len(pairs)} cross-process "
+              f"flow pair(s); {rep['requests']} request(s) attributed, "
+              f"req p99 {rep['p99_us']:.0f} us")
+    finally:
+        native.fault_disarm()
+        sc.close()
+        if pub.poll() is None:
+            pub.kill()
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+    print("slo-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,7 +13,9 @@ the accumulated history in one of two machine formats:
   terminated by ``# EOF``), ready for a backfill-capable scraper.
 
 Per-edge estimator summaries export as ``bf_edge_*`` samples labeled with
-the edge. ``--watch N`` keeps polling every N seconds and appending
+the edge. Serve clients' streams (``bf.ts.<4096 + cid>`` — the ``slo.*``
+burn-rate/budget gauges and ``trace.*`` request counters of docs/slo.md)
+ride along automatically; their rank label is ``4096 + cid``. ``--watch N`` keeps polling every N seconds and appending
 (jsonl only); the default is one pass over whatever history the ranks
 currently publish (late joiners still get the downsampled tiers — the
 publication carries them periodically).
@@ -50,6 +52,17 @@ def _poll(cl, acc: ts.HistoryAccumulator, world: int) -> None:
         doc = ts.read_rank(cl, r)
         if doc is not None:
             acc.update(r, doc)
+    # serve-client band (bf.ts.<SERVE_TS_RANK_BASE + cid>): the slo.* /
+    # trace.* request-path families publish here, not at trainer ranks
+    try:
+        from bluefog_tpu.serving.snapshot import live_client_ids
+        cids = live_client_ids(cl)
+    except (OSError, RuntimeError):
+        cids = []
+    for cid in cids:
+        doc = ts.read_rank(cl, ts.SERVE_TS_RANK_BASE + cid)
+        if doc is not None:
+            acc.update(ts.SERVE_TS_RANK_BASE + cid, doc)
 
 
 def _metric_name(series: str) -> str:

@@ -10,14 +10,23 @@ write but before the ``bf.serve.ver`` fence move.
 Lean bootstrap (no jax) — the publisher wire is numpy-only by contract.
 
     python tests/_serve_pub_child.py --host H --port P --start-ver V \
-        [--shards S] [--elems N] [--inter-shard-ms MS] [--codec C]
+        [--shards S] [--elems N] [--inter-shard-ms MS] [--codec C] \
+        [--period-ms MS] [--flight-dump PATH --flight-rank R]
 
 Prints ``PUB <ver>`` after each committed version; runs until killed.
+``--period-ms`` paces publishes (default: tight loop). ``--flight-dump``
+makes SIGTERM a clean exit that first writes this process's flight ring
+(request-path trace spans/flows when BLUEFOG_TRACE_SERVE=1) to PATH with
+``meta.rank`` overridden to ``--flight-rank``, so a parent can merge it
+with other processes' rings into one chrome trace.
 """
 
 import argparse
+import json
 import os
+import signal
 import sys
+import threading
 import types
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -44,7 +53,14 @@ def main() -> int:
     p.add_argument("--inter-shard-ms", type=float, default=0.0)
     p.add_argument("--codec", default=None)
     p.add_argument("--keep", type=int, default=2)
+    p.add_argument("--period-ms", type=float, default=0.0)
+    p.add_argument("--flight-dump", default=None)
+    p.add_argument("--flight-rank", type=int, default=1)
     args = p.parse_args()
+
+    stop = threading.Event()
+    if args.flight_dump:
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
 
     cl = ControlPlaneClient(args.host, args.port, 0,
                             secret=os.environ.get("BLUEFOG_CP_SECRET", ""),
@@ -55,12 +71,21 @@ def main() -> int:
                             keep=args.keep)
     pub._inter_shard_sleep = args.inter_shard_ms / 1e3
     ver = args.start_ver
-    while True:
+    while not stop.is_set():
         leaves = [np.full(args.elems, float(ver), np.float32),
                   np.full(args.elems // 3 + 1, float(ver), np.float32)]
         pub.publish(leaves, ver, step=ver)
         print(f"PUB {ver}", flush=True)
         ver += 1
+        if args.period_ms > 0:
+            stop.wait(args.period_ms / 1e3)
+    if args.flight_dump:
+        from bluefog_tpu.runtime import flight
+        doc = flight.build_dump("pub-exit")
+        doc["meta"]["rank"] = args.flight_rank
+        with open(args.flight_dump, "w") as f:
+            json.dump(doc, f)
+    return 0
 
 
 if __name__ == "__main__":

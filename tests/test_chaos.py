@@ -1911,6 +1911,78 @@ def test_repl_newline_key_survives_kill(repl_pair):
     sv.close()
 
 
+def test_repl_serve_trace_survives_shard_kill_failover(repl_pair,
+                                                       monkeypatch):
+    """r21 satellite: a TRACED serve client rides a shard SIGKILL ->
+    rejoin failover with an UNBROKEN request trace. Requests keep
+    completing against the held snapshot through the outage, the ring
+    records a ``serve.failover`` span (opened on the first failed stripe
+    pull, closed when the rejoined shard answers), the client swaps to
+    the post-failover version, and the snapshot lineage still resolves
+    to its exact producing train step."""
+    from bluefog_tpu.runtime import flight
+    from bluefog_tpu.runtime.router import ShardRouter
+    from bluefog_tpu.serving import snapshot as snap
+    from bluefog_tpu.serving.client import ServeClient
+
+    monkeypatch.setenv("BLUEFOG_TRACE_SERVE", "1")
+    monkeypatch.setenv("BLUEFOG_SERVE_POLL_S", "0.05")
+    flight.reset_for_job()
+    eps = _endpoints(repl_pair)
+    pub_r = ShardRouter(eps, 0, streams=1)
+    pub = snap.SnapshotPublisher(pub_r, shards=4)
+    pub.publish([np.full(500, 1.0, np.float32)], 1, step=1)
+    sc = ServeClient(eps, model_fn=lambda params, xs: xs + params[0][0])
+    try:
+        assert sc.wait_ready(timeout=15), "first snapshot never pulled"
+        out = sc.infer(np.zeros(2, np.float32), timeout=10)
+        np.testing.assert_array_equal(out, np.ones(2, np.float32))
+        proc, port = repl_pair[1]
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        # the WAL-replicated survivor keeps committing versions while
+        # half the client's stripe-pull groups point at a corpse
+        pub.publish([np.full(500, 2.0, np.float32)], 2, step=2)
+        deadline = time.monotonic() + 20
+        while sc.stats()["pull_failures"] == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sc.stats()["pull_failures"] >= 1, \
+            "the kill never surfaced as a failed stripe pull"
+        # trace continuity: requests complete on the held snapshot
+        # DURING the outage (same traced ring, no gap)
+        out = sc.infer(np.zeros(2, np.float32), timeout=10)
+        assert float(out[0]) >= 1.0
+        # rejoin in place: the bulk pullers re-dial, the open failover
+        # span closes on the next successful pull
+        nproc, nport = _spawn_shard_repl(1, port=port, rejoin=True)
+        repl_pair[1] = (nproc, nport)
+        ring = ",".join(f"127.0.0.1:{p}"
+                        for p in (repl_pair[0][1], port))
+        nproc.stdin.write(f"BF_SHARD_PEERS {ring}\n")
+        nproc.stdin.flush()
+        assert nproc.stdout.readline().startswith("BF_SHARD_READY")
+        pub.publish([np.full(500, 3.0, np.float32)], 3, step=3)
+        deadline = time.monotonic() + 25
+        while sc.version() < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sc.version() >= 3, "client never swapped past the failover"
+        out = sc.infer(np.zeros(2, np.float32), timeout=10)
+        assert float(out[0]) >= 3.0
+        rep = flight.serve_report()
+        assert rep is not None and rep["requests"] >= 3, \
+            "request traces broke across the kill"
+        assert rep["failovers"] >= 1, \
+            "no closed serve.failover span in the ring"
+        lin = snap.read_lineage(pub_r, 3)
+        assert lin is not None and lin["step"] == 3, \
+            "lineage must survive the failover and name the exact step"
+    finally:
+        sc.close()
+        pub_r.close()
+        flight.reset_for_job()
+
+
 def test_repl_published_row_survives_kill_mid_publish(repl_pair):
     """ISSUE r17 satellite: published window rows (raw byte values,
     ``kPutBytes``) ride the WAL now — SIGKILL the shard right after a

@@ -98,6 +98,12 @@ def test_gating_filter_keeps_stable_series_only():
         "serve.int8_wire_ratio": 4.0,
         "serve.p50_ms": 6.0,                 # latency: out
         "serve.p99_ms": 500.0,               # latency: out
+        # r21 request-path attribution: serve.trace.* and slo.* are
+        # INFO-ONLY (lower-better phase tails / run-length counters)
+        "serve.trace.requests": 400.0,       # out
+        "serve.trace.phase.queue.p99_us": 900.0,  # out
+        "slo.requests": 400.0,               # out
+        "slo.breach.serve_p99": 3.0,         # out
     }
     kept = pg.gating(metrics)
     assert set(kept) == {"win.f32.win_put.mbps", "win.f32.win_update.mbps",
@@ -150,6 +156,10 @@ def test_committed_baseline_is_sound():
     assert "serve.pull_scaling_x_net" in metrics
     assert "serve.int8_wire_ratio" in metrics
     assert not any(k.startswith("serve.") and k.endswith("_ms")
+                   for k in metrics)
+    # r21 request-path attribution rides along INFO-ONLY: no slo.* or
+    # serve.trace.* key may ever be baked into the committed baseline
+    assert not any(k.startswith(("slo.", "serve.trace."))
                    for k in metrics)
 
 

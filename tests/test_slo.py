@@ -1,0 +1,459 @@
+"""Request-path tracing + SLO plane (docs/slo.md).
+
+Covers the r21 observability surface end to end at unit granularity:
+the ``BLUEFOG_SLO`` grammar, the multi-window burn-rate engine fed by
+synthetic series, the per-request span analyzer's disjoint phase
+buckets, the heartbeat-slot reclaim that keeps ``bf.serve.client.<cid>``
+bounded, the zero-touch pin (knobs unset -> wire bytes and flight ring
+untouched), and — behind the native skipif — the acceptance demo: a
+served request whose client + publisher flight rings merge into ONE
+chrome trace with a cross-process stripe flow pair, phase buckets
+summing to the request latency, and the snapshot lineage resolving to
+its exact producing train step.
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from bluefog_tpu.runtime import flight
+from bluefog_tpu.runtime import native
+from bluefog_tpu.runtime import timeseries as ts
+from bluefog_tpu.serving import snapshot as snap
+
+TESTS = Path(__file__).resolve().parent
+PUB_CHILD = TESTS / "_serve_pub_child.py"
+
+needs_native = pytest.mark.skipif(
+    native.load() is None, reason="native runtime unavailable (no g++?)")
+
+
+class FakeKV:
+    """In-memory stand-in for the scalar+bytes KV surface the snapshot
+    protocol uses (same shape as test_serving's; wire-free unit tests)."""
+
+    def __init__(self):
+        self.b = {}
+        self.s = {}
+
+    def put_bytes(self, k, v):
+        self.b[k] = bytes(v)
+
+    def get_bytes(self, k):
+        return self.b.get(k, b"")
+
+    def bytes_len(self, k):
+        return len(self.b.get(k, b""))
+
+    def put_bytes_many(self, ks, vs):
+        for k, v in zip(ks, vs):
+            self.put_bytes(k, v)
+
+    def get_bytes_many(self, ks):
+        return [self.get_bytes(k) for k in ks]
+
+    def put(self, k, v):
+        self.s[k] = int(v)
+
+    def get(self, k):
+        return self.s.get(k, 0)
+
+    def put_max(self, k, v):
+        self.s[k] = max(self.s.get(k, 0), int(v))
+        return self.s[k]
+
+    def fetch_add(self, k, d=1):
+        old = self.s.get(k, 0)
+        self.s[k] = old + d
+        return old
+
+
+def _leaves():
+    rng = np.random.default_rng(5)
+    return [rng.standard_normal(400).astype(np.float32),
+            rng.standard_normal(77).astype(np.float32)]
+
+
+# ---------------------------------------------------------------------------
+# BLUEFOG_SLO grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_slos_grammar():
+    objs = ts.parse_slos(
+        "serve_p99:50ms@5m, serve_avail:99.9@1h,serve_staleness:3ver@5m")
+    assert [o.name for o in objs] == ["serve_p99", "serve_avail",
+                                      "serve_staleness"]
+    p99, avail, stale = objs
+    assert p99.target == pytest.approx(50000.0)     # microseconds
+    assert p99.window_s == pytest.approx(300.0)
+    assert p99.budget == pytest.approx(0.01)
+    assert avail.target == pytest.approx(99.9)
+    assert avail.window_s == pytest.approx(3600.0)
+    assert avail.budget == pytest.approx(1e-3)
+    assert stale.target == pytest.approx(3.0)       # snapshot versions
+    assert stale.budget == pytest.approx(0.01)
+
+
+def test_parse_slos_defaults_and_p50():
+    (obj,) = ts.parse_slos("serve_p50:2ms")
+    assert obj.window_s == pytest.approx(300.0)     # default fast window
+    assert obj.target == pytest.approx(2000.0)
+    assert obj.budget == pytest.approx(0.5)         # p50 -> 50% allowed
+
+
+def test_parse_slos_malformed_terms_never_raise():
+    assert ts.parse_slos(None) == ()
+    assert ts.parse_slos("") == ()
+    # unknown kind / unparseable target: warned and skipped, valid
+    # terms survive (telemetry config must never take a job down)
+    objs = ts.parse_slos("bogus:1@5m,serve_p99:zz@5m,serve_p99:9ms@10s")
+    assert len(objs) == 1
+    assert objs[0].target == pytest.approx(9000.0)
+    assert objs[0].window_s == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# multi-window burn-rate engine (synthetic series; no serving stack)
+# ---------------------------------------------------------------------------
+
+def _seeded_store(monkeypatch, spec, burn="2.0"):
+    monkeypatch.setenv("BLUEFOG_SLO", spec)
+    monkeypatch.setenv("BLUEFOG_SLO_BURN", burn)
+    return ts.TimeSeriesStore()
+
+
+def test_burn_rate_fires_on_both_windows_and_clears_on_fast(monkeypatch):
+    """Timeline clean -> storm -> clean over 1 s samples: the alert
+    fires only when BOTH the fast (10 s) and slow (120 s) burn rates
+    exceed the threshold, reports the exhausted budget, and clears as
+    soon as the fast window drains — no for_sec, the windows sustain."""
+    store = _seeded_store(monkeypatch, "serve_p99:50ms@10s")
+    req = store.series("slo.requests", "counter", "last")
+    err = store.series("slo.breach.serve_p99", "counter", "last")
+    t0 = 1000.0
+    nerr = 0
+    # clean minute: 10 req/s, zero breaches
+    for i in range(60):
+        req.add(t0 + i, 10.0 * i)
+        err.add(t0 + i, 0.0)
+    store._evaluate_slos(t0 + 59)
+    (st,) = store.slo_status()
+    assert st["name"] == "serve_p99" and not st["active"]
+    assert store.active_alerts() == []
+    # storm: every request breaches for 20 s
+    for i in range(60, 80):
+        nerr += 10
+        req.add(t0 + i, 10.0 * i)
+        err.add(t0 + i, float(nerr))
+    store._evaluate_slos(t0 + 79)
+    (st,) = store.slo_status()
+    assert st["active"], "both burn windows over threshold: must fire"
+    assert st["burn_fast"] >= 2.0 and st["burn_slow"] >= 2.0
+    assert st["budget_remaining"] <= 0.0, \
+        "a full-window 100% breach storm must exhaust the budget"
+    alerts = store.active_alerts()
+    assert any(a["name"] == "slo.serve_p99" for a in alerts)
+    # the published ts doc carries the alert to --top / bf.alerts.<rank>
+    doc = store.build_doc(4096, 0, t0 + 79, 1.0)
+    assert any(a["name"] == "slo.serve_p99" for a in doc["alerts"])
+    # recovery: requests keep flowing, breaches stop; the fast window
+    # drains and the alert clears even while the slow window still burns
+    for i in range(80, 100):
+        req.add(t0 + i, 10.0 * i)
+        err.add(t0 + i, float(nerr))
+    store._evaluate_slos(t0 + 99)
+    (st,) = store.slo_status()
+    assert not st["active"], "fast-window recovery must clear the alert"
+    assert st["burn_fast"] == pytest.approx(0.0)
+
+
+def test_burn_rate_fast_only_spike_does_not_page(monkeypatch):
+    """A short spike saturates the fast window but not the 12x slow
+    window: no alert (the classic multi-window guarantee)."""
+    store = _seeded_store(monkeypatch, "serve_p99:50ms@10s")
+    req = store.series("slo.requests", "counter", "last")
+    err = store.series("slo.breach.serve_p99", "counter", "last")
+    t0 = 2000.0
+    # 10 clean minutes so the slow window is well covered...
+    for i in range(600):
+        req.add(t0 + i, 100.0 * i)
+        err.add(t0 + i, 0.0)
+    # ...then a 2 s total-breach spike
+    for i in range(600, 602):
+        req.add(t0 + i, 100.0 * i)
+        err.add(t0 + i, float((i - 599) * 100))
+    store._evaluate_slos(t0 + 601)
+    (st,) = store.slo_status()
+    assert st["burn_fast"] >= 2.0, "spike must saturate the fast window"
+    assert st["burn_slow"] < 2.0
+    assert not st["active"], "fast-only spike must not page"
+
+
+def test_serve_avail_burns_on_shed_series(monkeypatch):
+    """Availability objectives read ``slo.shed`` as the error series."""
+    store = _seeded_store(monkeypatch, "serve_avail:99@10s")
+    req = store.series("slo.requests", "counter", "last")
+    shed = store.series("slo.shed", "counter", "last")
+    t0 = 3000.0
+    for i in range(30):     # 10% of requests shed, budget is 1%
+        req.add(t0 + i, 10.0 * i)
+        shed.add(t0 + i, 1.0 * i)
+    store._evaluate_slos(t0 + 29)
+    (st,) = store.slo_status()
+    assert st["active"] and st["burn_fast"] == pytest.approx(10.0, rel=0.2)
+
+
+# ---------------------------------------------------------------------------
+# per-request span analyzer
+# ---------------------------------------------------------------------------
+
+def _doc(rows):
+    names, idx = [], {}
+    ev = {"kind": [], "name": [], "t_wall_us": [], "a": [], "b": []}
+    for k, name, t, a, b in rows:
+        if name not in idx:
+            idx[name] = len(names)
+            names.append(name)
+        ev["kind"].append(k)
+        ev["name"].append(idx[name])
+        ev["t_wall_us"].append(float(t))
+        ev["a"].append(float(a))
+        ev["b"].append(int(b))
+    return {"names": names, "events": ev}
+
+
+def test_analyze_serve_disjoint_phase_buckets():
+    """Hand-built trace: the queue time a swap pull was blocking is
+    carved into ``swap_blocked``, ``reply`` is the post-decode tail, and
+    the six buckets sum exactly to the request duration."""
+    B, E = flight.SPAN_B, flight.SPAN_E
+    rep = flight.analyze_serve(_doc([
+        (B, "serve.req", 1000, 0, 7),
+        (B, "serve.admit", 1000, 0, 7), (E, "serve.admit", 1010, 0, 7),
+        (B, "serve.queue", 1010, 0, 7),
+        (B, "serve.pull", 1200, 0, 3),
+        (B, "serve.pull.ep", 1210, 0, 0),
+        (B, "serve.failover", 1300, 0, 3),
+        (E, "serve.failover", 1400, 0, 3),
+        (E, "serve.pull.ep", 1390, 12345, 0),
+        (E, "serve.pull", 1400, 1, 3),
+        (E, "serve.queue", 1500, 0, 7),
+        (B, "serve.linger", 1500, 0, 7), (E, "serve.linger", 1600, 0, 7),
+        (B, "serve.decode", 1600, 0, 7), (E, "serve.decode", 1900, 0, 7),
+        (E, "serve.req", 2000, 5, 7),
+        (B, "serve.req", 5000, 0, 8),   # incomplete: ignored
+    ]))
+    assert rep["requests"] == 1
+    (tr,) = rep["traces"]
+    assert tr["tid"] == 7 and tr["ver"] == 5 and tr["dur_us"] == 1000
+    ph = tr["phases"]
+    assert ph["admit"] == pytest.approx(10.0)
+    assert ph["swap_blocked"] == pytest.approx(200.0)  # queue ∩ pull
+    assert ph["queue"] == pytest.approx(290.0)         # 490 - blocked
+    assert ph["linger"] == pytest.approx(100.0)
+    assert ph["decode"] == pytest.approx(300.0)
+    assert ph["reply"] == pytest.approx(100.0)         # decode end -> req end
+    assert sum(ph.values()) == pytest.approx(tr["dur_us"])
+    assert tr["coverage"] == pytest.approx(1.0)
+    assert rep["pulls"] == 1 and rep["failovers"] == 1
+    assert rep["endpoints"]["0"]["pulls"] == 1
+    assert rep["endpoints"]["0"]["bytes"] == pytest.approx(12345.0)
+
+
+def test_analyze_serve_none_without_request_spans():
+    assert flight.analyze_serve(_doc([])) is None
+    B = flight.SPAN_B
+    assert flight.analyze_serve(
+        _doc([(B, "serve.req", 100, 0, 1)])) is None  # never completed
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-slot reclaim: bf.serve.client.<cid> keys stay bounded
+# ---------------------------------------------------------------------------
+
+def test_client_slots_reclaimed_not_grown_forever(monkeypatch):
+    """The r18 regression: every client generation used to fetch_add a
+    fresh cid, so ``bf.serve.client.<cid>`` keys were never reclaimed.
+    Now a clean release frees the slot immediately and a crashed
+    client's slot expires through the TTL."""
+    monkeypatch.setenv("BLUEFOG_SERVE_CLIENT_TTL_S", "30")
+    cl = FakeKV()
+    assert snap.claim_client_slot(cl) == 0
+    assert snap.claim_client_slot(cl) == 1
+    assert cl.s[snap.CLIENTS_KEY] == 2
+    # clean close -> immediate reuse, the key set stays at the peak
+    snap.release_client_slot(cl, 0)
+    assert snap.claim_client_slot(cl) == 0
+    assert cl.s[snap.CLIENTS_KEY] == 2
+    # a crashed client (stale beat) expires through the TTL
+    snap._put_float(cl, snap.CLIENT_HB_FMT.format(cid=1),
+                    time.time() - 120.0)
+    assert snap.claim_client_slot(cl) == 1
+    assert cl.s[snap.CLIENTS_KEY] == 2
+
+
+def test_client_slot_ttl_zero_disables_stale_reuse(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_SERVE_CLIENT_TTL_S", "0")
+    cl = FakeKV()
+    assert snap.claim_client_slot(cl) == 0
+    snap._put_float(cl, snap.CLIENT_HB_FMT.format(cid=0),
+                    time.time() - 1e6)  # ancient but non-zero beat
+    assert snap.claim_client_slot(cl) == 1, \
+        "TTL 0 must never reclaim a live-looking slot"
+    # ...while an explicit release still frees it
+    snap.release_client_slot(cl, 0)
+    assert snap.claim_client_slot(cl) == 0
+
+
+def test_live_client_ids_tracks_beats(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_SERVE_CLIENT_TTL_S", "30")
+    cl = FakeKV()
+    a = snap.claim_client_slot(cl)
+    b = snap.claim_client_slot(cl)
+    assert snap.live_client_ids(cl, hb_window_s=5.0) == [a, b]
+    snap.release_client_slot(cl, b)
+    assert snap.live_client_ids(cl, hb_window_s=5.0) == [a]
+    snap._put_float(cl, snap.CLIENT_HB_FMT.format(cid=a),
+                    time.time() - 60.0)
+    assert snap.live_client_ids(cl, hb_window_s=5.0) == []
+
+
+# ---------------------------------------------------------------------------
+# the zero-touch pin: knobs unset -> wire and ring byte-identical
+# ---------------------------------------------------------------------------
+
+def test_untraced_publish_touches_neither_wire_nor_ring(monkeypatch):
+    monkeypatch.delenv("BLUEFOG_TRACE_SERVE", raising=False)
+    rec = flight.recorder()
+    before = rec.snapshot()["recorded"]
+    cl = FakeKV()
+    pub = snap.SnapshotPublisher(cl, shards=3)
+    pub.publish(_leaves(), 1, step=7)
+    pub.publish(_leaves(), 2, step=8)
+    assert rec.snapshot()["recorded"] == before, \
+        "untraced publish must not record a single ring event"
+    assert not any(k.startswith("bf.serve.lineage.") for k in cl.b), \
+        "untraced publish must not stamp lineage sidecars"
+    for k, blob in cl.b.items():
+        if k.startswith("bf.serve.snap."):
+            assert blob[5] == 0, f"{k}: flags byte set without tracing"
+
+
+def test_traced_publish_differs_only_in_flags_plus_lineage(monkeypatch):
+    """Same leaves published traced and untraced: the shard payloads are
+    byte-identical except the header flags byte, and only the traced run
+    stamps a lineage record resolving to the exact producing step."""
+    leaves = _leaves()
+    monkeypatch.delenv("BLUEFOG_TRACE_SERVE", raising=False)
+    plain = FakeKV()
+    snap.SnapshotPublisher(plain, shards=3).publish(leaves, 1, step=41)
+    monkeypatch.setenv("BLUEFOG_TRACE_SERVE", "1")
+    traced = FakeKV()
+    snap.SnapshotPublisher(traced, shards=3).publish(leaves, 1, step=41)
+    for k in plain.b:
+        if not k.startswith("bf.serve.snap."):
+            continue
+        a, b = plain.b[k], traced.b[k]
+        assert len(a) == len(b)
+        assert a[:5] == b[:5] and a[6:] == b[6:], f"{k}: payload drifted"
+        assert a[5] == 0 and b[5] == snap.FLAG_LINEAGE
+    lin = snap.read_lineage(traced, 1)
+    assert lin is not None
+    assert lin["ver"] == 1 and lin["step"] == 41 and lin["fmt"] == 1
+    assert snap.read_lineage(plain, 1) is None
+
+
+def test_lineage_gc_rides_the_keep_window(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_TRACE_SERVE", "1")
+    cl = FakeKV()
+    pub = snap.SnapshotPublisher(cl, shards=2, keep=2)
+    for v in range(1, 5):
+        pub.publish(_leaves(), v, step=v)
+    assert snap.read_lineage(cl, 1) is None, "GC'd with its version"
+    assert snap.read_lineage(cl, 2) is None
+    assert snap.read_lineage(cl, 4)["step"] == 4
+
+
+# ---------------------------------------------------------------------------
+# acceptance demo: ONE merged chrome trace across client + publisher
+# ---------------------------------------------------------------------------
+
+def _flow_pairs_across_pids(merged):
+    starts, ends = {}, {}
+    for e in merged:
+        if e.get("cat") == "bf.flow":
+            (starts if e["ph"] == "s" else ends).setdefault(
+                e["id"], set()).add(e["pid"])
+    return [fid for fid, sp in starts.items() if ends.get(fid, set()) - sp]
+
+
+@needs_native
+def test_e2e_merged_trace_lineage_and_phase_sum(monkeypatch, tmp_path):
+    """THE acceptance demo, pinned: serve requests against a live
+    publisher child, then merge the two processes' flight rings into one
+    chrome trace — at least one stripe-pull flow pair must connect the
+    publisher's FLOW_S to this process's FLOW_F, the phase buckets must
+    sum to the request latency within 10%, and the answering snapshot's
+    lineage must resolve to its exact producing train step."""
+    from bluefog_tpu.serving.client import ServeClient
+
+    monkeypatch.setenv("BLUEFOG_SERVE_POLL_S", "0.05")
+    monkeypatch.setenv("BLUEFOG_TRACE_SERVE", "1")
+    flight.reset_for_job()
+    dump = tmp_path / "pub_flight.json"
+    try:
+        with native.ControlPlaneServer(world=2) as srv:
+            proc = subprocess.Popen(
+                [sys.executable, str(PUB_CHILD), "--port", str(srv.port),
+                 "--shards", "4", "--elems", "4000", "--period-ms", "100",
+                 "--keep", "4", "--flight-dump", str(dump),
+                 "--flight-rank", "1"],
+                stdout=subprocess.DEVNULL)
+            cl = native.ControlPlaneClient("127.0.0.1", srv.port, 0)
+            sc = ServeClient([("127.0.0.1", srv.port)],
+                             model_fn=lambda params, xs: xs + params[0][0])
+            try:
+                assert sc.wait_ready(timeout=15), "no snapshot pulled"
+                for _ in range(5):
+                    lo = sc.version()
+                    out = sc.infer(np.zeros(3, np.float32), timeout=10)
+                    # the child publishes all-equal-to-version leaves
+                    assert float(lo) <= float(out[0]), \
+                        "answer older than the already-seen fence"
+                ver = sc.version()
+                lin = snap.read_lineage(cl, ver)
+                assert lin is not None, "traced publish without lineage"
+                assert lin["ver"] == ver and lin["fmt"] == 1
+                assert lin["step"] == ver, \
+                    "lineage must name the exact producing train step"
+                time.sleep(0.5)   # fresh paced publishes -> fresh pulls
+                proc.terminate()  # SIGTERM: child writes its ring, exits 0
+                assert proc.wait(timeout=15) == 0
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+                sc.close()
+                cl.close()
+        client_doc = flight.build_dump("slo-e2e-test")
+        pub_doc = json.loads(dump.read_text())
+        assert pub_doc["meta"]["rank"] == 1
+        merged = flight.merge_dumps([client_doc, pub_doc])
+        pids = {e["pid"] for e in merged}
+        assert len(pids) >= 2, "merged trace must span both processes"
+        assert _flow_pairs_across_pids(merged), \
+            "no cross-process stripe flow pair in the merged trace"
+        rep = flight.analyze_serve(client_doc)
+        assert rep is not None and rep["requests"] >= 5
+        covs = sorted(t["coverage"] for t in rep["traces"])
+        assert 0.9 <= covs[len(covs) // 2] <= 1.1, \
+            f"phase buckets must sum to the request latency (got {covs})"
+        assert all(t["ver"] >= 1 for t in rep["traces"]), \
+            "every trace must carry its answering snapshot version"
+    finally:
+        flight.reset_for_job()
